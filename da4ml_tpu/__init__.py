@@ -1,0 +1,22 @@
+"""da4ml_tpu — a TPU-native distributed-arithmetic compiler for quantized NNs.
+
+A ground-up JAX/XLA re-design of the capabilities of calad0i/da4ml: symbolic
+fixed-point tracing to the DAIS IR, a CMVM adder-graph optimizer whose
+candidate search runs batched on TPU, bit-exact interpreters (numpy / XLA /
+native C++), and Verilog/VHDL/HLS code generation.
+"""
+
+from .ir import CombLogic, LookupTable, Op, Pipeline, Precision, QInterval, minimal_kif
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'CombLogic',
+    'Pipeline',
+    'Op',
+    'QInterval',
+    'Precision',
+    'LookupTable',
+    'minimal_kif',
+    '__version__',
+]

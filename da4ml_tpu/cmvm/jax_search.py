@@ -1,0 +1,12 @@
+"""JAX/TPU CMVM search backend (the performance path).
+
+Re-expresses the decompose-dc sweep + greedy CSE scoring as batched,
+fixed-shape tensor programs vmapped over candidates and sharded over the
+device mesh. Under construction — ``solve_jax`` currently raises.
+"""
+
+from __future__ import annotations
+
+
+def solve_jax(kernel, **kwargs):
+    raise NotImplementedError('The JAX CMVM search backend is not implemented yet; use backend="cpu".')

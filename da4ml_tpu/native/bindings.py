@@ -1,0 +1,26 @@
+"""ctypes bindings for the native library (placeholder until the C++ core lands).
+
+The native sources live in da4ml_tpu/native/src; ``python -m
+da4ml_tpu.native.build`` compiles them with g++ -fopenmp into
+_da4ml_native.so next to this file.
+"""
+
+from __future__ import annotations
+
+
+def load_lib():
+    return None
+
+
+def run_binary(binary, data, n_threads: int = 0):
+    raise NotImplementedError(
+        'Native DAIS interpreter is not built. Run `python -m da4ml_tpu.native.build` '
+        "or use backend='numpy' / backend='jax'."
+    )
+
+
+def solve_native(kernel, **kwargs):
+    raise NotImplementedError(
+        'Native CMVM solver is not built. Run `python -m da4ml_tpu.native.build` '
+        "or use backend='cpu' / backend='jax'."
+    )

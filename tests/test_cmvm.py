@@ -1,0 +1,67 @@
+"""Solver unit tests, mirroring reference tests/test_cmvm.py:
+CSD reconstruction identity, kernel_decompose product identity, and the full
+solve oracle ``Pipeline.kernel == kernel`` over the method/dc config matrix.
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm import csd_decompose, kernel_decompose, solve
+
+
+def random_kernel(rng: np.random.Generator, n_dim: int, bits: int) -> np.ndarray:
+    mag = rng.integers(0, 2**bits, (n_dim, n_dim)).astype(np.float64)
+    sign = rng.choice([-1.0, 1.0], (n_dim, n_dim))
+    scale = 2.0 ** rng.integers(-4, 4, (n_dim,))
+    return mag * sign * scale
+
+
+@pytest.mark.parametrize('n_dim', [2, 4, 8])
+@pytest.mark.parametrize('bits', [2, 4, 8])
+def test_csd_decompose(rng, n_dim, bits):
+    kernel = random_kernel(rng, n_dim, bits)
+    csd, shift0, shift1 = csd_decompose(kernel)
+    n_bits = csd.shape[2]
+    powers = 2.0 ** np.arange(n_bits)
+    recon = (csd.astype(np.float64) * powers).sum(axis=2)
+    recon = recon * 2.0 ** shift0.astype(np.float64)[:, None] * 2.0 ** shift1.astype(np.float64)[None, :]
+    np.testing.assert_array_equal(recon, kernel)
+
+
+@pytest.mark.parametrize('dc', [-2, -1, 0, 1, 2])
+def test_kernel_decompose(rng, dc):
+    kernel = random_kernel(rng, 6, 4)
+    m0, m1 = kernel_decompose(kernel, dc)
+    np.testing.assert_allclose(m0 @ m1, kernel, rtol=0, atol=0)
+
+
+@pytest.mark.parametrize('method0', ['mc', 'wmc'])
+@pytest.mark.parametrize('method1', ['mc', 'wmc', 'auto'])
+@pytest.mark.parametrize('hard_dc', [0, 2, -1])
+@pytest.mark.parametrize('decompose_dc', [0, -1, -2])
+@pytest.mark.parametrize('search_all', [False, True])
+def test_solve(rng, method0, method1, hard_dc, decompose_dc, search_all):
+    kernel = random_kernel(rng, 4, 4)
+    sol = solve(
+        kernel,
+        method0=method0,
+        method1=method1,
+        hard_dc=hard_dc,
+        decompose_dc=decompose_dc,
+        search_all_decompose_dc=search_all,
+    )
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+@pytest.mark.parametrize('n_dim', [2, 8, 16])
+@pytest.mark.parametrize('bits', [2, 8])
+def test_solve_sizes(rng, n_dim, bits):
+    kernel = random_kernel(rng, n_dim, bits)
+    sol = solve(kernel)
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+    assert sol.cost > 0 or np.all(kernel == 0)
+
+
+def test_solve_zero_kernel():
+    sol = solve(np.zeros((4, 3)))
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), np.zeros((4, 3)))

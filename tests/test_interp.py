@@ -1,0 +1,87 @@
+"""Interpreter oracle chain on solver outputs:
+
+float matmul golden == CombLogic float replay == numpy DAIS interpreter
+== jitted JAX executor — all exact (assert_array_equal), mirroring the
+reference's bit-exactness test pattern (tests/test_ops.py).
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm import solve
+from da4ml_tpu.ir import CombLogic, QInterval
+
+
+def random_case(rng, n_in=6, n_out=5, bits=4):
+    kernel = rng.integers(-(2 ** (bits - 1)), 2 ** (bits - 1), (n_in, n_out)).astype(np.float64)
+    qints = [QInterval(-8.0, 7.0, 1.0)] * n_in
+    sol = solve(kernel, qintervals=qints)
+    x = rng.integers(-8, 8, (64, n_in)).astype(np.float64)
+    return kernel, sol, x
+
+
+def test_predict_matches_matmul(rng):
+    kernel, sol, x = random_case(rng)
+    golden = x @ kernel
+    for stage_in, stage in zip([x, x @ sol.stages[0].kernel], sol.stages):
+        out_np = stage.predict(stage_in, backend='numpy')
+        np.testing.assert_array_equal(out_np, stage_in @ np.asarray(stage.kernel, np.float64))
+    out = sol.predict(x, backend='numpy')
+    np.testing.assert_array_equal(out, golden)
+
+
+def test_replay_matches_predict(rng):
+    _, sol, x = random_case(rng)
+    stage = sol.stages[0]
+    out_pred = stage.predict(x, backend='numpy')
+    out_replay = np.stack([stage(row) for row in x])
+    np.testing.assert_array_equal(out_pred, out_replay)
+
+
+def test_jax_matches_numpy(rng):
+    _, sol, x = random_case(rng)
+    for stage in sol.stages:
+        out_np = stage.predict(x, backend='numpy')
+        out_jax = stage.predict(x, backend='jax')
+        np.testing.assert_array_equal(out_np, out_jax)
+        x = out_np
+
+
+def test_binary_roundtrip(rng):
+    from da4ml_tpu.ir.dais_binary import decode
+
+    _, sol, _ = random_case(rng)
+    stage = sol.stages[0]
+    binary = stage.to_binary()
+    prog = decode(binary)
+    assert prog.n_in == stage.shape[0]
+    assert prog.n_out == stage.shape[1]
+    assert prog.n_ops == len(stage.ops)
+    prog.validate()
+
+
+def test_json_roundtrip(tmp_path, rng):
+    _, sol, x = random_case(rng)
+    path = tmp_path / 'pipeline.json'
+    sol.save(path)
+    from da4ml_tpu.ir import Pipeline
+
+    sol2 = Pipeline.load(path)
+    assert sol2 == sol
+    np.testing.assert_array_equal(sol.predict(x, backend='numpy'), sol2.predict(x, backend='numpy'))
+
+
+@pytest.mark.parametrize('seed', [0, 1, 2])
+def test_fuzz_bits_shapes(seed):
+    rng = np.random.default_rng(seed)
+    n_in = int(rng.integers(2, 12))
+    n_out = int(rng.integers(1, 12))
+    bits = int(rng.integers(2, 7))
+    kernel = rng.integers(-(2**bits), 2**bits, (n_in, n_out)).astype(np.float64)
+    qb = int(rng.integers(2, 6))
+    qints = [QInterval(-(2.0 ** (qb - 1)), 2.0 ** (qb - 1) - 1, 1.0)] * n_in
+    sol = solve(kernel, qintervals=qints)
+    x = rng.integers(-(2 ** (qb - 1)), 2 ** (qb - 1), (32, n_in)).astype(np.float64)
+    golden = x @ kernel
+    np.testing.assert_array_equal(sol.predict(x, backend='numpy'), golden)
+    np.testing.assert_array_equal(sol.stages[0].predict(x, backend='jax'), x @ np.asarray(sol.stages[0].kernel, np.float64))
