@@ -42,6 +42,14 @@ def minimal_latency(
     return max_lat
 
 
+def stage_feed(sol: CombLogic) -> tuple[list[QInterval], list[float]]:
+    """Inter-stage intervals/latencies: the *output* qints (out_shift/neg
+    applied) so downstream DAIS execution stays exact. The reference passes
+    raw buffer qints here (api.cc:100-115), which only supports symbolic
+    replay. Zero outputs (out_idx == -1) feed a zero interval."""
+    return sol.out_qint, sol.out_latency
+
+
 def _default_qint_lat(kernel, qintervals, latencies):
     n_in = kernel.shape[0]
     if not qintervals:
@@ -97,25 +105,8 @@ def _solve(
         mat0, mat1 = kernel_decompose(kernel, decompose_dc)
         sol0 = solve_single(mat0, method0, qintervals, latencies, adder_size, carry_size)
 
-        # Inter-stage intervals use the *output* qints (out_shift/neg applied)
-        # so stage-1 DAIS execution is exact. The reference passes raw buffer
-        # qints here (api.cc:100-115), which only supports symbolic replay.
-        latencies0: list[float] = []
-        qintervals0: list[QInterval] = []
-        max_lat0 = 0.0
-        for j, idx in enumerate(sol0.out_idxs):
-            lat = sol0.ops[idx].latency if idx >= 0 else 0.0
-            latencies0.append(lat)
-            max_lat0 = max(max_lat0, lat)
-            if idx >= 0:
-                lo, hi, step = sol0.ops[idx].qint
-                sf = 2.0 ** sol0.out_shifts[j]
-                lo, hi, step = lo * sf, hi * sf, step * sf
-                if sol0.out_negs[j]:
-                    lo, hi = -hi, -lo
-                qintervals0.append(QInterval(lo, hi, step))
-            else:
-                qintervals0.append(QInterval(0.0, 0.0, inf))
+        qintervals0, latencies0 = stage_feed(sol0)
+        max_lat0 = max(latencies0, default=0.0)
 
         if max_lat0 > latency_allowed:
             if not (method0 == 'wmc-dc' and method1 == 'wmc-dc') or decompose_dc >= 0:

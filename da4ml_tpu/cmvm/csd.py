@@ -34,10 +34,24 @@ def int_arr_to_csd(x: NDArray) -> NDArray[np.int8]:
     return out
 
 
+def lsb_loc_arr(x: NDArray) -> NDArray[np.int8]:
+    """Vectorized lsb_loc: exponent of the lowest set bit of each float32 value."""
+    x32 = np.abs(np.asarray(x, dtype=np.float32)).astype(np.float64)
+    m, ex = np.frexp(x32)
+    mi = (m * (1 << 24)).astype(np.int64)
+    tz = np.zeros_like(mi)
+    nz = mi != 0
+    low = mi[nz] & -mi[nz]
+    # bit_length - 1 via float log2 is exact for powers of two < 2**53
+    tz[nz] = np.log2(low.astype(np.float64)).astype(np.int64)
+    out = (ex - 24 + tz).astype(np.int8)
+    out[~nz] = 127  # zero sentinel
+    return out
+
+
 def shift_amount(arr: NDArray, axis: int) -> NDArray[np.int8]:
     """Per-row/col min power-of-2 exponent (for factoring out shifts)."""
-    lsb = np.vectorize(lsb_loc, otypes=[np.int8])(arr)
-    return lsb.min(axis=axis).astype(np.int8)
+    return lsb_loc_arr(arr).min(axis=axis).astype(np.int8)
 
 
 def center(arr: NDArray) -> tuple[NDArray, NDArray[np.int8], NDArray[np.int8]]:

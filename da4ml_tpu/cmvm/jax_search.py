@@ -1,12 +1,601 @@
-"""JAX/TPU CMVM search backend (the performance path).
+"""JAX/TPU CMVM search backend — the performance path.
 
-Re-expresses the decompose-dc sweep + greedy CSE scoring as batched,
-fixed-shape tensor programs vmapped over candidates and sharded over the
-device mesh. Under construction — ``solve_jax`` currently raises.
+The reference parallelizes the adder-graph search with OpenMP over
+decompose-dc candidates (api.cc:208-238) and leaves the greedy CSE loop
+scalar. Here the whole search is re-expressed as fixed-shape tensor programs:
+
+- A CSD expression set is a dense int8 tensor ``E[slot, out, bit]`` with
+  digits in {-1, 0, +1}; slot = input or CSE intermediate.
+- One CSE iteration counts *all* candidate pairs ``a ± (b << s)`` at once via
+  shifted correlations (einsums on the MXU), scores them (mc / wmc / dc
+  variants, vectorized over the slot metadata), picks the argmax, and
+  substitutes densely. ``lax.while_loop`` drives the greedy iterations.
+- Lanes = (matrix, dc candidate, method) triples, batched with ``vmap`` and
+  shardable over a device mesh — each TPU core scores thousands of candidate
+  substitutions in parallel.
+
+Host does the cheap, shape-dynamic ends: CSD/kernel decomposition, adder-tree
+emission (to_solution), and candidate argmin.
+
+Determinism: ties in the argmax resolve by flattened index — deterministic,
+but not necessarily the same op choice as the host/C++ scan order. The
+contract is the oracle used by tests/bench: ``Pipeline.kernel == kernel``
+exactly, at equal-or-better total cost.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from functools import lru_cache
+from math import ceil, inf, log2
 
-def solve_jax(kernel, **kwargs):
-    raise NotImplementedError('The JAX CMVM search backend is not implemented yet; use backend="cpu".')
+import jax
+import jax.numpy as jnp
+import numpy as np
+from numpy.typing import NDArray
+
+from ..ir.comb import CombLogic, Pipeline
+from ..ir.types import QInterval
+from .core import to_solution
+from .csd import csd_decompose
+from .state import DAState, Op, encode_digit
+from . import api as _host_api
+
+_METHOD_CODES = {'mc': 0, 'mc-dc': 1, 'mc-pdc': 2, 'wmc': 3, 'wmc-dc': 4, 'wmc-pdc': 5, 'dummy': 6}
+
+
+# --------------------------------------------------------------------------
+# device kernel
+# --------------------------------------------------------------------------
+
+
+def _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, shift_pow, sub, adder_size: int, carry_size: int):
+    """Vectorized cost_add (cost.py / state_opr.cc:31-67). shift_pow = 2.0**shift."""
+    if adder_size < 0 and carry_size < 0:
+        one = jnp.ones_like(lo0)
+        return one, one
+    a_sz = 65535.0 if adder_size < 0 else float(adder_size)
+    c_sz = 65535.0 if carry_size < 0 else float(carry_size)
+    # sub swaps the endpoints WITHOUT negation (reference state_opr.cc:48-49)
+    min1 = jnp.where(sub, hi1, lo1)
+    max1 = jnp.where(sub, lo1, hi1)
+    min1, max1, st1s = min1 * shift_pow, max1 * shift_pow, st1 * shift_pow
+    max0 = hi0 + st0
+    max1 = max1 + st1s
+    f = -jnp.log2(jnp.maximum(st0, st1s))
+    i = jnp.ceil(jnp.log2(jnp.maximum(jnp.maximum(jnp.abs(lo0), jnp.abs(min1)), jnp.maximum(jnp.abs(max0), jnp.abs(max1)))))
+    k = ((lo0 < 0) | (lo1 < 0)).astype(f.dtype)
+    n_accum = k + i + f
+    return jnp.ceil(n_accum / c_sz), jnp.ceil(n_accum / a_sz)
+
+
+def _iceil_log2(x):
+    return jnp.where(x > 0, jnp.ceil(jnp.log2(jnp.maximum(x, 1e-37))), 0.0)
+
+
+def _overlap_vec(lo0, hi0, st0, lo1, hi1, st1):
+    """Vectorized overlap_and_accum -> n_overlap (indexers.cc:36-56)."""
+    max0 = hi0 + st0
+    max1 = hi1 + st1
+    f = -_iceil_log2(jnp.maximum(st0, st1))
+    i_low = _iceil_log2(jnp.minimum(jnp.maximum(jnp.abs(lo0), jnp.abs(max0)), jnp.maximum(jnp.abs(lo1), jnp.abs(max1))))
+    k = ((lo0 < 0) | (lo1 < 0)).astype(f.dtype)
+    return k + i_low + f
+
+
+@dataclass(frozen=True)
+class _KernelSpec:
+    P: int  # total slots (inputs + max CSE intermediates)
+    O: int  # outputs
+    B: int  # CSD bit planes
+    n_iters: int  # max CSE iterations (P - n_in_max)
+    adder_size: int
+    carry_size: int
+
+
+@lru_cache(maxsize=64)
+def _build_cse_fn(spec: _KernelSpec):
+    """Build the vmapped+jitted greedy-CSE device function for a shape class.
+
+    Lane inputs:  E0 [P,O,B] int8, qmeta0 [P,3] f32 (lo,hi,step), lat0 [P] f32,
+                  method [] int32
+    Lane outputs: E_final, op records [n_iters x (id0,id1,sub,shift)] int32,
+                  op qints [n_iters,3] f32, op lat/cost [n_iters] f32,
+                  n_added [] int32
+    """
+    P, O, B, n_iters = spec.P, spec.O, spec.B, spec.n_iters
+    adder_size, carry_size = spec.adder_size, spec.carry_size
+    rank_max = (P * P * 2 + 1) * (2 * B + 1) + 2 * B
+    if rank_max >= 2**31:
+        raise ValueError(
+            f'Problem too large for the device search (P={P}, B={B} overflows the int32 tie rank); use backend="cpu".'
+        )
+
+    def pair_counts(E):
+        """C_same/C_diff [S=B, P, P]: matches of row-i bit b with row-j bit b+s."""
+        Ep = (E > 0).astype(jnp.bfloat16)
+        Em = (E < 0).astype(jnp.bfloat16)
+        # shifted stacks: sh[s, p, o, b] = X[p, o, b + s] (zero beyond B)
+        pad = jnp.pad(E, ((0, 0), (0, 0), (0, B)))
+        idx = jnp.arange(B)[:, None] + jnp.arange(B)[None, :]  # [s, b] -> b+s
+        sh = pad[:, :, idx]  # [P, O, S, B]
+        shp = (sh > 0).astype(jnp.bfloat16)
+        shm = (sh < 0).astype(jnp.bfloat16)
+        C_same = jnp.einsum('iob,josb->sij', Ep, shp, preferred_element_type=jnp.float32) + jnp.einsum(
+            'iob,josb->sij', Em, shm, preferred_element_type=jnp.float32
+        )
+        C_diff = jnp.einsum('iob,josb->sij', Ep, shm, preferred_element_type=jnp.float32) + jnp.einsum(
+            'iob,josb->sij', Em, shp, preferred_element_type=jnp.float32
+        )
+        return C_same.astype(jnp.int32), C_diff.astype(jnp.int32)
+
+    sub_np = np.arange(2, dtype=np.int64)[:, None, None, None]
+    s_np = np.arange(B, dtype=np.int64)[None, :, None, None]
+    i_np = np.arange(P, dtype=np.int64)[None, None, :, None]
+    j_np = np.arange(P, dtype=np.int64)[None, None, None, :]
+    # Tie rank (host scan order, heuristics.py): largest (id1, id0, sub, shift)
+    # wins among equal scores. Pure function of the static axes -> constant.
+    _c0 = np.minimum(i_np, j_np)
+    _c1 = np.maximum(i_np, j_np)
+    _cs = np.where(i_np < j_np, s_np, -s_np)
+    RANK = jnp.asarray((((_c1 * P + _c0) * 2 + sub_np) * (2 * B + 1) + (_cs + B)).astype(np.int32))
+    S0_MASK = jnp.asarray((s_np > 0) | (i_np < j_np))
+
+    def select_pair(C, qmeta, lat, method):
+        """Masked scoring + argmax over the [2, S, P, P] candidate tensor."""
+        count = C.astype(jnp.float32)
+        valid = C >= 2
+        # s == 0: only i < j (i == j is self-pairing; i > j duplicates i < j)
+        valid &= S0_MASK
+
+        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
+        # canonical id0/id1: (i, j) if i <= j else (j, i) — metadata symmetric
+        n_ov = _overlap_vec(lo[:, None], hi[:, None], st[:, None], lo[None, :], hi[None, :], st[None, :])
+        dlat = jnp.abs(lat[:, None] - lat[None, :])
+
+        base_mc = count
+        base_wmc = count * n_ov[None, None]
+        pen_dc = dlat[None, None]
+        score = jnp.where(
+            method == 0,
+            base_mc,
+            jnp.where(
+                method == 1,
+                base_mc - 1e9 * pen_dc,
+                jnp.where(
+                    method == 2,
+                    base_mc - 1e9 * pen_dc,
+                    jnp.where(method == 3, base_wmc, base_wmc - 256.0 * pen_dc),
+                ),
+            ),
+        )
+        # variants whose host scan starts at max_score = 0 require score >= 0
+        absolute = (method == 1) | (method == 3) | (method == 4)
+        valid &= jnp.where(absolute, score >= 0, True)
+        score = jnp.where(valid, score, -jnp.inf)
+        best = jnp.max(score)
+        rank = jnp.where(score == best, RANK, -1)
+        flat = jnp.argmax(rank)
+        any_valid = jnp.any(valid)
+        sub, rem = jnp.divmod(flat, B * P * P)
+        s, rem = jnp.divmod(rem, P * P)
+        i, j = jnp.divmod(rem, P)
+        return any_valid, sub.astype(jnp.int32), s.astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
+
+    b_idx = jnp.arange(B)
+
+    def substitute(E, sub, s, i, j):
+        """Dense substitution of pair (row i bit b) + ±(row j bit b+s).
+
+        Returns (E_updated, new_row [O,B] placed at anchor bits, n_matched).
+        For i == j a sequential scan over bits reproduces the host's
+        ascending-bit greedy chain matching (state_opr.cc:249-280).
+        """
+        row_i = E[i]  # [O, B]
+        row_j = E[j]
+        # row_j shifted down by s: val at bit b+s -> position b
+        shifted_j = jnp.where((b_idx[None, :] + s) < B, jnp.take(row_j, jnp.minimum(b_idx + s, B - 1), axis=1), 0)
+        target = jnp.where(sub == 1, -1, 1)
+        sign_ok = (row_i != 0) & (shifted_j != 0) & (row_i * shifted_j == target)
+
+        def chain_scan(_):
+            # i == j: digits can chain (b, b+s, b+2s); greedily match ascending
+            def body(b, carry):
+                avail, matched = carry
+                ok = sign_ok[:, b] & avail[:, b] & jnp.where(b + s < B, avail[:, jnp.minimum(b + s, B - 1)], False)
+                avail = avail.at[:, b].set(avail[:, b] & ~ok)
+                avail = avail.at[:, jnp.minimum(b + s, B - 1)].set(
+                    jnp.where(b + s < B, avail[:, jnp.minimum(b + s, B - 1)] & ~ok, avail[:, jnp.minimum(b + s, B - 1)])
+                )
+                matched = matched.at[:, b].set(ok)
+                return avail, matched
+
+            avail0 = E[i] != 0
+            matched0 = jnp.zeros((O, B), dtype=bool)
+            _, matched = jax.lax.fori_loop(0, B, body, (avail0, matched0))
+            return matched
+
+        M = jax.lax.cond(i == j, chain_scan, lambda _: sign_ok, None)
+
+        # clear matched digits: row i at b, row j at b+s
+        M_up = jnp.zeros((O, B), dtype=bool)
+        M_up = jnp.where((b_idx[None, :] - s >= 0), jnp.take(M, jnp.maximum(b_idx - s, 0), axis=1), M_up)
+        new_row_i = jnp.where(M, 0, row_i)
+        E = E.at[i].set(new_row_i)
+        row_j2 = E[j]  # re-read: if i == j this is already-cleared row
+        E = E.at[j].set(jnp.where(M_up, 0, row_j2))
+
+        # anchor: original id0 = i if i < j (digit at b), else j (digit at b+s).
+        # i == j uses the high-bit anchor (negative-shift convention), matching
+        # the host's same-row pair generation (state.py _row_pairs).
+        anchor_lo = M * row_i  # digits of row i at matched positions
+        anchor_hi = M_up * row_j  # digits of row j at matched positions (bit b+s)
+        new_row = jnp.where(i < j, anchor_lo, anchor_hi).astype(jnp.int8)
+        return E, new_row, M.sum()
+
+    def lane_fn(E0, qmeta0, lat0, method):
+        op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
+
+        def cond(state):
+            E, qmeta, lat, cur, _, go = state
+            return go & (cur < P)
+
+        def body(state):
+            E, qmeta, lat, cur, op_rec, _ = state
+            C_same, C_diff = pair_counts(E)
+            C = jnp.stack([C_same, C_diff])  # [2, S, P, P]
+            any_valid, sub, s, i, j = select_pair(C, qmeta, lat, method)
+
+            def do_update(args):
+                E, qmeta, lat, cur, op_rec = args
+                E2, new_row, _ = substitute(E, sub, s, i, j)
+                E2 = E2.at[cur].set(new_row)
+
+                id0 = jnp.minimum(i, j)
+                id1 = jnp.maximum(i, j)
+                shift = jnp.where(i < j, s, -s)
+                sp = jnp.exp2(shift.astype(jnp.float32))
+                lo0, hi0, st0 = qmeta[id0, 0], qmeta[id0, 1], qmeta[id0, 2]
+                lo1, hi1, st1 = qmeta[id1, 0], qmeta[id1, 1], qmeta[id1, 2]
+                is_sub = sub == 1
+                dlat, _ = _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, sp, is_sub, adder_size, carry_size)
+                nlat = jnp.maximum(lat[id0], lat[id1]) + dlat
+                # qint_add(q0, q1, shift, sub0=False, sub1=sub) — f32 for
+                # scoring only; the host re-derives op metadata in f64
+                min1 = jnp.where(is_sub, -hi1, lo1) * sp
+                max1 = jnp.where(is_sub, -lo1, hi1) * sp
+                qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
+                lat = lat.at[cur].set(nlat)
+                op_rec = op_rec.at[cur - (P - n_iters)].set(jnp.stack([id0, id1, sub, shift]))
+                return E2, qmeta, lat, cur + 1, op_rec
+
+            def no_update(args):
+                return args
+
+            args = (E, qmeta, lat, cur, op_rec)
+            E, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
+            return E, qmeta, lat, cur, op_rec, any_valid
+
+        cur0 = jnp.int32(P - n_iters)
+        state = (E0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
+        E, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
+        return E, op_rec, cur - (P - n_iters)
+
+    return jax.jit(jax.vmap(lane_fn))
+
+
+# --------------------------------------------------------------------------
+# host driver
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Lane:
+    kernel: NDArray
+    qintervals: list[QInterval]
+    latencies: list[float]
+    method: str
+    # filled by preparation
+    csd: NDArray | None = None
+    shift0: NDArray | None = None
+    shift1: NDArray | None = None
+
+
+def _prepare_lane(lane: _Lane) -> None:
+    csd, shift0, shift1 = csd_decompose(lane.kernel)
+    for i, q in enumerate(lane.qintervals):
+        if q.min == 0.0 and q.max == 0.0:
+            csd[i] = 0
+    lane.csd, lane.shift0, lane.shift1 = csd, shift0, shift1
+
+
+def _lane_initial_digits(lane: _Lane) -> int:
+    return int((lane.csd != 0).sum())
+
+
+def solve_single_lanes(
+    lanes: list[_Lane],
+    adder_size: int,
+    carry_size: int,
+    max_iters: int | None = None,
+    _budget_level: int = 0,
+) -> list[CombLogic]:
+    """Solve a batch of independent CMVM instances on device, emit on host.
+
+    Runs with a tight iteration budget first (smaller P -> quadratically
+    cheaper selection tensors); lanes that exhaust a budget escalate through
+    digits//4 -> digits//2 -> digits (the true worst case: every substitution
+    removes at least one digit net), so quality never degrades.
+    """
+    _BUDGET_DENOMS = (4, 2, 1)
+
+    for lane in lanes:
+        if lane.csd is None:
+            _prepare_lane(lane)
+
+    dummy_idx = [k for k, ln in enumerate(lanes) if ln.method == 'dummy']
+    results: dict[int, CombLogic] = {}
+    for k in dummy_idx:
+        ln = lanes[k]
+        state = _host_state_from(ln, np.zeros((0, 4), np.int32), ln.csd, 0, adder_size, carry_size)
+        results[k] = to_solution(state, adder_size, carry_size)
+
+    active = [k for k in range(len(lanes)) if k not in results]
+    if active:
+        n_in_max = max(lanes[k].csd.shape[0] for k in active)
+        O = max(lanes[k].csd.shape[1] for k in active)
+        B = max(lanes[k].csd.shape[2] for k in active)
+        digits_max = max(_lane_initial_digits(lanes[k]) for k in active)
+        full_iters = max(digits_max, 1)
+        denom = _BUDGET_DENOMS[min(_budget_level, len(_BUDGET_DENOMS) - 1)]
+        n_iters = min(max(digits_max // denom, 16), full_iters)
+        if max_iters is not None:
+            n_iters = min(n_iters, max_iters)
+        P = n_in_max + n_iters
+
+        E0 = np.zeros((len(active), P, O, B), dtype=np.int8)
+        qmeta0 = np.zeros((len(active), P, 3), dtype=np.float32)
+        lat0 = np.zeros((len(active), P), dtype=np.float32)
+        mcodes = np.zeros((len(active),), dtype=np.int32)
+        for a, k in enumerate(active):
+            ln = lanes[k]
+            ni, no, nb = ln.csd.shape
+            E0[a, :ni, :no, :nb] = ln.csd
+            for i in range(ni):
+                sf = 2.0 ** float(ln.shift0[i])
+                q = ln.qintervals[i]
+                lo, hi, st = q.min * sf, q.max * sf, q.step * sf
+                # all-zero rows carry the lsb sentinel shift (2**127) and/or an
+                # inf step; they are never selected — store benign metadata
+                if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, st)):
+                    lo, hi, st = 0.0, 0.0, 1.0
+                qmeta0[a, i] = (lo, hi, st)
+                lat0[a, i] = ln.latencies[i]
+            qmeta0[a, ni:, 2] = 1.0  # benign step for unused slots
+            mcodes[a] = _METHOD_CODES[ln.method]
+
+        # pad the lane axis to a power-of-two bucket so repeated calls with
+        # nearby batch sizes reuse the compiled program (dummy lanes are all
+        # zeros -> no valid pair -> exit on the first iteration)
+        n_lanes = len(active)
+        bucket = 1 << (n_lanes - 1).bit_length()
+        if bucket > n_lanes:
+            pad = bucket - n_lanes
+            E0 = np.concatenate([E0, np.zeros((pad,) + E0.shape[1:], E0.dtype)])
+            qmeta0 = np.concatenate([qmeta0, np.ones((pad,) + qmeta0.shape[1:], qmeta0.dtype)])
+            lat0 = np.concatenate([lat0, np.zeros((pad,) + lat0.shape[1:], lat0.dtype)])
+            mcodes = np.concatenate([mcodes, np.zeros((pad,), mcodes.dtype)])
+
+        fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size))
+        E_f, op_rec, n_added = (
+            np.asarray(jax.device_get(t))[:n_lanes] for t in fn(jnp.asarray(E0), jnp.asarray(qmeta0), jnp.asarray(lat0), jnp.asarray(mcodes))
+        )
+
+        # lanes that exhausted the budget escalate to the next level
+        if max_iters is None and n_iters < full_iters:
+            capped = [k for a, k in enumerate(active) if int(n_added[a]) >= n_iters]
+            if capped:
+                redo = solve_single_lanes([lanes[k] for k in capped], adder_size, carry_size, _budget_level=_budget_level + 1)
+                for k, sol in zip(capped, redo):
+                    results[k] = sol
+
+        for a, k in enumerate(active):
+            if k in results:
+                continue
+            ln = lanes[k]
+            ni, no, nb = ln.csd.shape
+            n_add = int(n_added[a])
+            # slots in the device tensor: [0, n_in_max) inputs, [n_in_max, ...) new.
+            # remap device slot index -> host op index (inputs of THIS lane first)
+            E_lane = np.concatenate([E_f[a, :ni, :no, :nb], E_f[a, n_in_max : n_in_max + n_add, :no, :nb]], axis=0)
+            rec = op_rec[a, :n_add].copy()
+            remap = lambda idx: idx if idx < ni else idx - (n_in_max - ni)  # noqa: E731
+            rec[:, 0] = [remap(v) for v in rec[:, 0]]
+            rec[:, 1] = [remap(v) for v in rec[:, 1]]
+            state = _host_state_from(ln, rec, E_lane, n_add, adder_size, carry_size)
+            results[k] = to_solution(state, adder_size, carry_size)
+
+    return [results[k] for k in range(len(lanes))]
+
+
+def _host_state_from(ln: _Lane, rec, E_lane, n_add: int, adder_size: int, carry_size: int) -> DAState:
+    """Rebuild the DAState from the device op records.
+
+    Op metadata (qint/latency/cost) is re-derived here in float64 from the
+    recorded (id0, id1, sub, shift) decisions — the device's f32 metadata is
+    used for scoring only, so recorded intervals are never narrowed by f32
+    rounding.
+    """
+    from .cost import cost_add
+    from ..ir.types import qint_add
+
+    ni, no, nb = ln.csd.shape
+    ops: list[Op] = []
+    for i in range(ni):
+        sf = 2.0 ** float(ln.shift0[i])
+        q = ln.qintervals[i]
+        ops.append(Op(i, -1, -1, 0, QInterval(q.min * sf, q.max * sf, q.step * sf), ln.latencies[i], 0.0))
+    for t in range(n_add):
+        id0, id1, sub, shift = (int(v) for v in rec[t])
+        q0, q1 = ops[id0].qint, ops[id1].qint
+        dlat, dcost = cost_add(q0, q1, shift, bool(sub), adder_size, carry_size)
+        lat = max(ops[id0].latency, ops[id1].latency) + dlat
+        ops.append(Op(id0, id1, int(sub), shift, qint_add(q0, q1, shift, False, bool(sub)), lat, dcost))
+
+    expr: list[list[list[int]]] = [[[] for _ in range(no)] for _ in range(ni + n_add)]
+    for p, o, b in zip(*np.nonzero(E_lane)):
+        expr[p][o].append(encode_digit(int(b), int(E_lane[p, o, b])))
+    return DAState(
+        shift0=ln.shift0,
+        shift1=ln.shift1,
+        expr=expr,
+        n_bits=nb,
+        ops=ops,
+        freq_stat={},
+        kernel=np.asarray(ln.kernel, dtype=np.float64),
+        n_out=no,
+    )
+
+
+# --------------------------------------------------------------------------
+# public API: full two-stage solve with dc sweep on device
+# --------------------------------------------------------------------------
+
+
+def _resolve_methods(method0: str, method1: str, hard_dc: int) -> tuple[str, str]:
+    if method1 == 'auto':
+        method1 = method0 if (hard_dc >= 6 or method0.endswith('dc')) else method0 + '-dc'
+    if hard_dc == 0 and not method0.endswith('dc'):
+        method0 = method0 + '-dc'
+    return method0, method1
+
+
+def _lane_method(method: str, dc: int, hard_dc_eff: int) -> str:
+    """The host forces wmc-dc for dc < 0 candidates under a latency budget
+    (api.py _solve / api.cc:84-93); mirror that per lane."""
+    if dc < 0 and hard_dc_eff >= 0 and method != 'dummy':
+        return 'wmc-dc'
+    return method
+
+
+def solve_jax(
+    kernel: NDArray,
+    method0: str = 'wmc',
+    method1: str = 'auto',
+    hard_dc: int = -1,
+    decompose_dc: int = -2,
+    qintervals: list[QInterval] | None = None,
+    latencies: list[float] | None = None,
+    adder_size: int = -1,
+    carry_size: int = -1,
+    search_all_decompose_dc: bool = True,
+) -> Pipeline:
+    """Drop-in `solve` with the candidate search running on TPU."""
+    return solve_jax_many(
+        [kernel],
+        method0=method0,
+        method1=method1,
+        hard_dc=hard_dc,
+        decompose_dc=decompose_dc,
+        qintervals_list=[qintervals] if qintervals else None,
+        latencies_list=[latencies] if latencies else None,
+        adder_size=adder_size,
+        carry_size=carry_size,
+        search_all_decompose_dc=search_all_decompose_dc,
+    )[0]
+
+
+def solve_jax_many(
+    kernels: list[NDArray],
+    method0: str = 'wmc',
+    method1: str = 'auto',
+    hard_dc: int = -1,
+    decompose_dc: int = -2,
+    qintervals_list: list[list[QInterval] | None] | None = None,
+    latencies_list: list[list[float] | None] | None = None,
+    adder_size: int = -1,
+    carry_size: int = -1,
+    search_all_decompose_dc: bool = True,
+) -> list[Pipeline]:
+    """Batched CMVM solve: all (matrix × dc candidate) stage-0 searches run as
+    one device batch, then all stage-1 searches. The argmin over dc candidates
+    per matrix happens on host."""
+    from .decompose import kernel_decompose
+
+    kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
+    n_mat = len(kernels)
+    qintervals_list = qintervals_list or [None] * n_mat
+    latencies_list = latencies_list or [None] * n_mat
+
+    # In sweep mode the host driver resolves methods against the effective
+    # budget 10^9 when hard_dc < 0 (api.py solve -> _solve), which turns
+    # 'auto' into method0 itself rather than its -dc variant.
+    _hard_eff = 10**9 if (search_all_decompose_dc and hard_dc < 0) else hard_dc
+    m0, m1 = _resolve_methods(method0, method1, _hard_eff)
+
+    # enumerate candidate (matrix, dc) lanes
+    jobs: list[tuple[int, int]] = []  # (matrix idx, dc)
+    for mi, kern in enumerate(kernels):
+        n_in = kern.shape[0]
+        log2_n = int(ceil(log2(max(n_in, 1))))
+        if search_all_decompose_dc:
+            _hard = hard_dc if hard_dc >= 0 else 10**9
+            dcs = list(range(-1, min(_hard, log2_n) + 1))
+        else:
+            dc = min(hard_dc, log2_n, decompose_dc) if decompose_dc != -2 else min(hard_dc, log2_n)
+            dcs = [dc]
+        jobs.extend((mi, dc) for dc in dcs)
+
+    # stage-0 lanes
+    lanes0: list[_Lane] = []
+    mats1: list[NDArray] = []
+    for mi, dc in jobs:
+        kern = kernels[mi]
+        qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
+        lats = latencies_list[mi] or [0.0] * kern.shape[0]
+        mat0, mat1 = kernel_decompose(kern, dc)
+        lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(m0, dc, _hard_eff)))
+        mats1.append(mat1)
+    sols0 = solve_single_lanes(lanes0, adder_size, carry_size)
+
+    # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
+    lanes1: list[_Lane] = []
+    for (mi, dc), sol0, mat1 in zip(jobs, sols0, mats1):
+        qints1, lats1 = _host_api.stage_feed(sol0)
+        lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(m1, dc, _hard_eff)))
+    sols1 = solve_single_lanes(lanes1, adder_size, carry_size)
+
+    # candidate filtering (latency budget) + argmin per matrix
+    results: list[Pipeline | None] = [None] * n_mat
+    best_cost = [inf] * n_mat
+    for (mi, dc), sol0, sol1 in zip(jobs, sols0, sols1):
+        pipe = Pipeline(stages=(sol0, sol1))
+        if hard_dc >= 0:
+            kern = kernels[mi]
+            qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
+            lats = latencies_list[mi] or [0.0] * kern.shape[0]
+            min_lat = _host_api.minimal_latency(kern, list(qints), list(lats), carry_size, adder_size)
+            allowed = hard_dc + min_lat
+            max_lat = max((lt for s in pipe.stages for lt in s.out_latency), default=0.0)
+            if max_lat > allowed:
+                continue
+        c = float(sum(op.cost for s in pipe.stages for op in s.ops))
+        if c < best_cost[mi]:
+            best_cost[mi] = c
+            results[mi] = pipe
+
+    # fallback: no candidate met the latency budget -> host retry logic
+    for mi in range(n_mat):
+        if results[mi] is None:
+            results[mi] = _host_api._solve(
+                kernels[mi],
+                method0,
+                method1,
+                hard_dc,
+                decompose_dc,
+                qintervals_list[mi],
+                latencies_list[mi],
+                adder_size,
+                carry_size,
+            )
+    return results  # type: ignore[return-value]

@@ -1,0 +1,66 @@
+"""JAX search backend: exactness oracle + cost quality vs the host solver."""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm import solve
+from da4ml_tpu.cmvm.jax_search import solve_jax, solve_jax_many
+from da4ml_tpu.ir import QInterval
+
+
+def random_kernel(rng, n_dim, bits):
+    mag = rng.integers(0, 2**bits, (n_dim, n_dim)).astype(np.float64)
+    sign = rng.choice([-1.0, 1.0], (n_dim, n_dim))
+    return mag * sign
+
+
+@pytest.mark.parametrize('n_dim', [4, 8])
+@pytest.mark.parametrize('bits', [2, 4])
+@pytest.mark.parametrize('method0', ['mc', 'wmc'])
+def test_jax_solve_exact(rng, n_dim, bits, method0):
+    kernel = random_kernel(rng, n_dim, bits)
+    sol = solve_jax(kernel, method0=method0)
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+@pytest.mark.parametrize('hard_dc', [0, 2, -1])
+def test_jax_solve_hard_dc(rng, hard_dc):
+    kernel = random_kernel(rng, 6, 4)
+    sol = solve_jax(kernel, hard_dc=hard_dc)
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+def test_jax_solve_no_search(rng):
+    kernel = random_kernel(rng, 8, 4)
+    sol = solve_jax(kernel, search_all_decompose_dc=False)
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+def test_jax_many(rng):
+    kernels = [random_kernel(rng, n, b) for n, b in [(4, 2), (8, 4), (6, 3)]]
+    sols = solve_jax_many(kernels)
+    for k, s in zip(kernels, sols):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+
+
+def test_jax_cost_quality(rng):
+    """Avg cost over a batch within 10% of the host solver's (same heuristic)."""
+    kernels = [random_kernel(rng, 8, 4) for _ in range(8)]
+    jax_sols = solve_jax_many(kernels)
+    host_costs = [solve(k).cost for k in kernels]
+    jax_costs = [s.cost for s in jax_sols]
+    assert np.mean(jax_costs) <= np.mean(host_costs) * 1.10, (jax_costs, host_costs)
+
+
+def test_jax_predict_bit_exact(rng):
+    kernel = random_kernel(rng, 8, 4)
+    qints = [QInterval(-8.0, 7.0, 1.0)] * 8
+    sol = solve_jax(kernel, qintervals=qints)
+    x = rng.integers(-8, 8, (64, 8)).astype(np.float64)
+    np.testing.assert_array_equal(sol.predict(x, backend='numpy'), x @ kernel)
+
+
+def test_backend_dispatch(rng):
+    kernel = random_kernel(rng, 4, 3)
+    sol = solve(kernel, backend='jax')
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
