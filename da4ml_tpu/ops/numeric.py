@@ -11,7 +11,7 @@ src/da4ml/trace/ops/bit_oprs.py, trace/fixed_variable.py:235-261.
 
 from __future__ import annotations
 
-from math import floor
+from math import floor, log2
 
 import numpy as np
 
@@ -74,7 +74,10 @@ def numeric_binary_bit_op(a: float, b: float, op: int, qint0: QInterval, qint1: 
 def apply_unary_bit_op(v, op: int, qint_from: QInterval, qint_to: QInterval | None = None):
     if isinstance(v, _NUMERIC):
         return numeric_unary_bit_op(float(v), op, qint_from, qint_to)
-    return v.unary_bit_op({0: 'not', 1: 'any', 2: 'all'}[op])
+    if op == 0:
+        assert qint_to is not None
+        return (~v) << round(log2(qint_to.step / qint_from.step))
+    return v.unary_bit_op({1: 'any', 2: 'all'}[op])
 
 
 def apply_binary_bit_op(v0, v1, op: int, qint0: QInterval, qint1: QInterval, qint: QInterval):

@@ -91,10 +91,10 @@ def run_program(prog: DaisProgram, data: NDArray[np.float64]) -> NDArray[np.floa
             buf[i] = _quantize(v, int(prog.fractionals[i0]), sg, w, f)
         elif oc == 4:
             shift = f - int(prog.fractionals[i0])
-            const = (np.int64(dhi) << 32) | np.int64(np.uint32(dlo))
+            const = (np.int64(dhi) << 32) | np.int64(dlo & 0xFFFFFFFF)
             buf[i] = _shl(buf[i0], shift) + const
         elif oc == 5:
-            buf[i] = (np.int64(dhi) << 32) | np.int64(np.uint32(dlo))
+            buf[i] = (np.int64(dhi) << 32) | np.int64(dlo & 0xFFFFFFFF)
         elif oc in (6, -6):
             ic = dlo
             f0, f1 = int(prog.fractionals[i0]), int(prog.fractionals[i1])

@@ -1,0 +1,6 @@
+from .einsum_utils import einsum
+from .quantization import fixed_quantize, quantize, relu
+from .reduce_utils import reduce
+from .sorting import sort
+
+__all__ = ['einsum', 'quantize', 'relu', 'reduce', 'sort', 'fixed_quantize']

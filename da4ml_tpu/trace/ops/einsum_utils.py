@@ -1,0 +1,167 @@
+"""Two-operand einsum over symbolic arrays.
+
+The einsum string is validated and lowered to a recipe of axis
+transpositions plus a loop of ``A @ B`` slices, so constant-side operands hit
+the CMVM matmul path (reference trace/ops/einsum_utils.py; note the
+multiplication order is reversed relative to np.einsum — irrelevant for the
+commutative ops traced here).
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import TypedDict
+
+import numpy as np
+
+_ALPHABET = 'abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ'
+
+
+class EinsumRecipe(TypedDict):
+    direct_sum_axis: tuple[tuple[int, ...], tuple[int, ...]]
+    in_transpose_idxs: tuple[tuple[int, ...], tuple[int, ...]]
+    L0: int
+    L1: int
+    I: int
+    C: int
+    out_interpert_shape: tuple[int, ...]
+    out_transpose_idxs: tuple[int, ...]
+
+
+def _validate_einsum_expr(fn: str, shape0: tuple[int, ...], shape1: tuple[int, ...]):
+    """Validate + resolve '...' broadcasting; returns (normalized string, out shape)."""
+    inp, out = map(str.strip, fn.split('->'))
+    in0, in1 = map(str.strip, inp.split(','))
+    s_alpha = set(_ALPHABET)
+
+    if not (s_alpha >= set(in0.replace('...', '') + in1.replace('...', '') + out.replace('...', ''))):
+        raise ValueError(f"einsum string {fn} is invalid: subscripts must be [a-zA-Z] and '...'")
+
+    in0, in1, out = in0.replace('...', '0'), in1.replace('...', '0'), out.replace('...', '0')
+    ax_in0, ax_in1, ax_out = list(in0), list(in1), list(out)
+    sax_in0, sax_in1, sax_out = set(ax_in0), set(ax_in1), set(ax_out)
+    free = ''.join(sorted(s_alpha - sax_in0 - sax_in1 - sax_out))
+
+    for name, axes, sax in (('input0', ax_in0, sax_in0), ('input1', ax_in1, sax_in1), ('output', ax_out, sax_out)):
+        if len(sax) != len(axes):
+            dup = next(a for a in axes if axes.count(a) > 1)
+            dup = dup if dup != '0' else '...'
+            raise ValueError(f"einsum string {fn} is invalid: {name} includes '{dup}' multiple times")
+
+    if '0' in sax_in0 or '0' in sax_in1 or '0' in sax_out:
+        if '0' not in sax_out:
+            raise ValueError(f'einsum string {fn} is invalid: inputs broadcast but output does not')
+        if '0' not in sax_in0 and '0' not in sax_in1:
+            raise ValueError(f'einsum string {fn} is invalid: output broadcasts but inputs do not')
+    if remaining := sax_out - sax_in0 - sax_in1:
+        raise ValueError(f'einsum string {fn} is invalid: output subscripts {remaining} not found in inputs')
+
+    if '0' in sax_in0 and '0' in sax_in1:
+        nb0 = len(shape0) - len(sax_in0) + 1
+        nb1 = len(shape1) - len(sax_in1) + 1
+        assert nb0 == nb1, f"'...' expands to {nb0} and {nb1} axes in the two inputs"
+        in0 = in0.replace('0', free[:nb0])
+        in1 = in1.replace('0', free[:nb1])
+        out = out.replace('0', free[:nb0])
+    else:
+        if '0' in sax_in0:
+            if len(sax_in0) - 1 > len(shape0):
+                raise ValueError(f'Input0 requires at least {len(sax_in0) - 1} dims, got {len(shape0)}')
+            nb = len(shape0) - len(sax_in0) + 1
+            in0 = in0.replace('0', free[:nb])
+            out = out.replace('0', free[:nb])
+        elif len(sax_in0) != len(shape0):
+            raise ValueError(f'Input0 requires {len(sax_in0)} dims, got {len(shape0)}')
+        if '0' in sax_in1:
+            if len(sax_in1) - 1 > len(shape1):
+                raise ValueError(f'Input1 requires at least {len(sax_in1) - 1} dims, got {len(shape1)}')
+            nb = len(shape1) - len(sax_in1) + 1
+            in1 = in1.replace('0', free[:nb])
+            out = out.replace('0', free[:nb])
+        elif len(sax_in1) != len(shape1):
+            raise ValueError(f'Input1 requires {len(sax_in1)} dims, got {len(shape1)}')
+
+    ax_in0, ax_in1, ax_out = list(in0), list(in1), list(out)
+    for a in set(ax_in0) & set(ax_in1):
+        d0, d1 = shape0[ax_in0.index(a)], shape1[ax_in1.index(a)]
+        if d0 != d1:
+            raise ValueError(f"Dimension mismatch for subscript '{a}': {d0} vs {d1}")
+
+    out_shape = tuple(shape0[ax_in0.index(a)] if a in ax_in0 else shape1[ax_in1.index(a)] for a in ax_out)
+    return f'{in0},{in1}->{out}', out_shape
+
+
+def parse_einsum(fn: str, input_shape0: tuple[int, ...], input_shape1: tuple[int, ...]) -> EinsumRecipe:
+    fn, _ = _validate_einsum_expr(fn, input_shape0, input_shape1)
+    _in, _out = fn.split('->')
+    _in0, _in1 = _in.split(',')
+    in0, in1, out = list(_in0), list(_in1), list(_out)
+    s_in0, s_in1, s_out = set(in0), set(in1), set(out)
+    common = s_in0 & s_in1
+    contract = sorted(common - s_out, key=in1.index)
+    inplace = sorted(common & s_out, key=in1.index)
+    invariant0 = sorted((s_out - common) & s_in0, key=in0.index)
+    invariant1 = sorted((s_out - common) & s_in1, key=in1.index)
+    direct_sum_axis = (
+        tuple(sorted(in0.index(x) for x in s_in0 - s_out - common)),
+        tuple(sorted(in1.index(x) for x in s_in1 - s_out - common)),
+    )
+
+    contract_idxs = tuple(map(in0.index, contract)), tuple(map(in1.index, contract))
+    inplace_idxs = tuple(map(in0.index, inplace)), tuple(map(in1.index, inplace))
+    invariant_idxs = tuple(map(in0.index, invariant0)), tuple(map(in1.index, invariant1))
+
+    inplace_shape = tuple(input_shape0[i] for i in inplace_idxs[0])
+    invariant_shape0 = tuple(input_shape0[i] for i in invariant_idxs[0])
+    invariant_shape1 = tuple(input_shape1[i] for i in invariant_idxs[1])
+
+    out_transpose = tuple(int(i) for i in np.argsort(tuple(map(out.index, inplace + invariant0 + invariant1))))
+
+    return EinsumRecipe(
+        direct_sum_axis=direct_sum_axis,
+        in_transpose_idxs=(
+            inplace_idxs[0] + invariant_idxs[0] + contract_idxs[0],
+            inplace_idxs[1] + invariant_idxs[1] + contract_idxs[1],
+        ),
+        out_interpert_shape=inplace_shape + invariant_shape0 + invariant_shape1,
+        out_transpose_idxs=out_transpose,
+        L0=prod(invariant_shape0),
+        L1=prod(invariant_shape1),
+        I=prod(inplace_shape),
+        C=prod(input_shape0[i] for i in contract_idxs[0]),
+    )
+
+
+def _exec_einsum(recipe: EinsumRecipe, input0: np.ndarray, input1: np.ndarray) -> np.ndarray:
+    sum0, sum1 = recipe['direct_sum_axis']
+    if sum0:
+        input0 = np.sum(input0, axis=sum0)
+    if sum1:
+        input1 = np.sum(input1, axis=sum1)
+    input0 = input0.transpose(recipe['in_transpose_idxs'][0]).ravel()
+    input1 = input1.transpose(recipe['in_transpose_idxs'][1]).ravel()
+    out_dtype = object if input0.dtype == object or input1.dtype == object else np.float64
+    L0, L1, I, C = recipe['L0'], recipe['L1'], recipe['I'], recipe['C']
+    output = np.zeros(L0 * L1 * I, dtype=out_dtype)
+
+    for l0 in range(L0):
+        for i in range(I):
+            A = input1[i * L1 * C : (i + 1) * L1 * C].reshape((L1, C))
+            B = input0[(i * L0 + l0) * C : (i * L0 + l0 + 1) * C]
+            output[(i * L0 + l0) * L1 : (i * L0 + l0 + 1) * L1] = A @ B
+    return output.reshape(recipe['out_interpert_shape']).transpose(recipe['out_transpose_idxs'])
+
+
+def einsum(fn: str, input0, input1):
+    """Einsum over two operands; symbolic arrays route through the CMVM matmul."""
+    from ..fixed_variable_array import FixedVariableArray
+
+    fg0 = isinstance(input0, FixedVariableArray)
+    fg1 = isinstance(input1, FixedVariableArray)
+    recipe = parse_einsum(fn, input0.shape, input1.shape)
+    r = _exec_einsum(recipe, input0, input1)
+    if fg0:
+        return FixedVariableArray(r, input0.solver_options)
+    if fg1:
+        return FixedVariableArray(r, input1.solver_options)
+    return r

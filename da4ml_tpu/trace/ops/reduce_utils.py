@@ -1,0 +1,99 @@
+"""Heap-balanced reductions producing latency-optimal adder trees.
+
+Elements are combined cheapest-first via a min-heap ordered by (latency,
+factor sign, integer bits) so late-arriving values merge last (reference
+trace/ops/reduce_utils.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections.abc import Callable, Sequence
+from math import prod
+
+import numpy as np
+
+from ..fixed_variable import FixedVariable
+
+
+class _Packet:
+    __slots__ = ('value',)
+
+    def __init__(self, v):
+        self.value = v
+
+    def __gt__(self, other: '_Packet') -> bool:
+        a, b = self.value, other.value
+        if isinstance(a, FixedVariable):
+            if isinstance(b, FixedVariable):
+                if b.latency > a.latency:
+                    return False
+                if b.latency < a.latency:
+                    return True
+                if b._factor > 0 and a._factor < 0:
+                    return False
+                if b._factor < 0 and a._factor > 0:
+                    return True
+                return sum(a.kif[:2]) > sum(b.kif[:2])
+            return True
+        return False
+
+    def __lt__(self, other: '_Packet') -> bool:
+        return not self.__gt__(other)
+
+
+def _reduce(operator: Callable, arr: Sequence):
+    if isinstance(arr, np.ndarray):
+        arr = list(arr.ravel())
+    assert len(arr) > 0, 'Array must not be empty'
+    if len(arr) == 1:
+        return arr[0]
+    if not isinstance(arr[0], FixedVariable):
+        r = operator(arr[0], arr[1])
+        for i in range(2, len(arr)):
+            r = operator(r, arr[i])
+        return r
+
+    heap = [_Packet(v) for v in arr]
+    heapq.heapify(heap)
+    while len(heap) > 1:
+        v1 = heapq.heappop(heap).value
+        v2 = heapq.heappop(heap).value
+        heapq.heappush(heap, _Packet(operator(v1, v2)))
+    return heap[0].value
+
+
+def reduce(operator: Callable, x, axis=None, keepdims: bool = False):
+    """Reduce over the given axes with balanced (heap) combination order."""
+    from ..fixed_variable_array import FixedVariableArray
+
+    if isinstance(x, FixedVariableArray):
+        solver_options = x.solver_options
+        arr = x._vars
+    else:
+        solver_options = None
+        arr = x
+
+    all_axis = tuple(range(arr.ndim))
+    axis = axis if axis is not None else all_axis
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    axis = tuple(a if a >= 0 else a + arr.ndim for a in axis)
+
+    xpose_axis = sorted(all_axis, key=lambda a: (a in axis) * 1000 + a)
+    if keepdims:
+        target_shape = tuple(d if ax not in axis else 1 for ax, d in enumerate(arr.shape))
+    else:
+        target_shape = tuple(d for ax, d in enumerate(arr.shape) if ax not in axis)
+
+    dim_contract = prod(arr.shape[a] for a in axis)
+    arr = np.transpose(arr, xpose_axis)
+    flat = arr.reshape(-1, dim_contract)
+    out = np.array([_reduce(operator, flat[i]) for i in range(flat.shape[0])])
+    r = out.reshape(target_shape)
+
+    if isinstance(x, FixedVariableArray):
+        r = FixedVariableArray(r, solver_options, hwconf=x.hwconf)
+        if r.shape == ():
+            return r._vars.item()
+        return r
+    return r if r.shape != () or keepdims else r.item()

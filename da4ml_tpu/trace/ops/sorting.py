@@ -1,0 +1,114 @@
+"""Hardware sorting networks: compare-swap cells built from MSB muxes.
+
+Supports Batcher odd-even mergesort (default) and bitonic sort; non-pow2
+lengths are padded with out-of-range sentinels, and an optional payload
+(``aux_value``) rides along for argsort-style gathers
+(reference trace/ops/sorting.py).
+"""
+
+from __future__ import annotations
+
+from math import ceil, log2
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ..fixed_variable import FixedVariable
+
+
+def cmp_swap(a, b, ascending: bool):
+    """Sort rows a, b by their first element; the rest is payload."""
+    ka, kb = a[0], b[0]
+    k = ka <= kb
+    a, b = zip(*[(k.msb_mux(va, vb, zt_sensitive=False), k.msb_mux(vb, va, zt_sensitive=False)) for va, vb in zip(a, b)])
+    if not ascending:
+        return b, a
+    return a, b
+
+
+def _bitonic_merge(a: NDArray, ascending: bool):
+    if len(a) <= 1:
+        return
+    half = len(a) // 2
+    for i in range(half):
+        a[i], a[i + half] = cmp_swap(a[i], a[i + half], ascending)
+    _bitonic_merge(a[:half], ascending)
+    _bitonic_merge(a[half:], ascending)
+
+
+def _bitonic_sort(a: NDArray, ascending: bool):
+    if len(a) <= 1:
+        return
+    half = len(a) // 2
+    _bitonic_sort(a[:half], True)
+    _bitonic_sort(a[half:], False)
+    _bitonic_merge(a, ascending)
+
+
+def batcher_odd_even_merge_sort(a: NDArray, ascending: bool):
+    """Batcher odd-even mergesort network (standard formulation)."""
+    n = a.shape[0]
+    for _p in range(ceil(log2(n))):
+        p = 2**_p
+        for _k in range(_p, -1, -1):
+            k = 2**_k
+            for j in range(k % p, n - k, 2 * k):
+                for i in range(min(k, n - j - k)):
+                    if (i + j) // (2 * p) == (i + j + k) // (2 * p):
+                        a[i + j], a[i + j + k] = cmp_swap(a[i + j], a[i + j + k], ascending)
+
+
+def _pad_to_pow2(a):
+    """Pad the sort axis to a power of two with below-min / above-max sentinels."""
+    assert a.ndim == 3
+    size = a.shape[-2]
+    n_pad = 2 ** ceil(log2(size)) - size
+    n_pad_low, n_pad_high = n_pad // 2, n_pad - n_pad // 2
+    low, high, _ = a.lhs
+    low_pad = FixedVariable.from_const(float(np.min(low)) - 1, hwconf=a.hwconf)
+    high_pad = FixedVariable.from_const(float(np.max(high)) + 1, hwconf=a.hwconf)
+    low_block = np.full((a.shape[0], n_pad_low, a.shape[-1]), low_pad)
+    high_block = np.full((a.shape[0], n_pad_high, a.shape[-1]), high_pad)
+    return np.concatenate([low_block, a, high_block], axis=-2), n_pad_low, n_pad_high
+
+
+def sort(a, axis: int | None = None, kind: str = 'batcher', aux_value=None):
+    from ..fixed_variable_array import FixedVariableArray
+
+    if isinstance(a, np.ndarray):
+        return np.sort(a, axis=axis)
+    if axis is None:
+        axis = -1
+    axis = axis % a.ndim
+
+    if aux_value is not None:
+        assert a.ndim == 1, f'aux_value requires 1D keys, got a.ndim={a.ndim}'
+        assert a.shape[0] == aux_value.shape[0], f'length mismatch: {a.shape} vs {aux_value.shape}'
+        if aux_value.shape == a.shape:
+            aux_value = aux_value[..., None]
+        assert aux_value.ndim - a.ndim == 1 and aux_value.shape[:-1] == a.shape
+        a = np.concatenate([a[..., None], aux_value], axis=-1)
+    else:
+        a = a[..., None]
+
+    sort_dim = a.shape[axis]
+    r = np.moveaxis(a, axis, -2).copy()
+    shape = r.shape
+    r = r.reshape(-1, sort_dim, r.shape[-1])
+    r, n_pad_low, n_pad_high = _pad_to_pow2(r)
+
+    kind = kind.lower()
+    for i in range(len(r)):
+        if kind == 'bitonic':
+            _bitonic_sort(r._vars[i], ascending=True)
+        elif kind == 'batcher':
+            batcher_odd_even_merge_sort(r._vars[i], ascending=True)
+        else:
+            raise ValueError(f'Unsupported sorting algorithm: {kind}')
+
+    r = r[:, n_pad_low : r.shape[1] - n_pad_high, :].reshape(shape)
+    r = np.moveaxis(r, -2, axis)
+    if aux_value is not None:
+        return r[..., 0], r[..., 1:]
+    assert r.shape[-1] == 1
+    return r[..., 0]

@@ -1,0 +1,59 @@
+"""to_pipeline stage splitting + retiming: stage latency bounds and exactness."""
+
+import numpy as np
+
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+from da4ml_tpu.trace.ops.quantization import fixed_quantize
+
+N = 8
+
+
+def build_comb(latency_cutoff=-1):
+    rng = np.random.default_rng(3)
+    inp = FixedVariableArrayInput(N, hwconf=HWConfig(1, -1, latency_cutoff))
+    q = inp.quantize(np.ones(N), np.full(N, 3), np.full(N, 2))
+    w1 = rng.integers(-8, 8, (N, 6)).astype(np.float64)
+    w2 = rng.integers(-8, 8, (6, 4)).astype(np.float64)
+    h = (q @ w1).relu()
+    out = h @ w2
+    return inp, out, comb_trace(inp, out)
+
+
+def test_to_pipeline_exact():
+    _, _, comb = build_comb(latency_cutoff=4)
+    pipe = to_pipeline(comb, 4, retiming=False)
+    assert len(pipe.stages) >= 2
+    rng = np.random.default_rng(0)
+    data = rng.uniform(-8, 8, (256, N))
+    golden = comb.predict(data, backend='numpy')
+    np.testing.assert_array_equal(pipe.predict(data, backend='numpy'), golden)
+    # replay path as well
+    qdata = fixed_quantize(data, 1, 3, 2)
+    rep = np.stack([np.asarray(pipe(row), dtype=np.float64) for row in qdata[:32]])
+    np.testing.assert_array_equal(rep, golden[:32])
+
+
+def test_to_pipeline_stage_latency_bound():
+    _, _, comb = build_comb(latency_cutoff=4)
+    pipe = to_pipeline(comb, 4, retiming=False)
+    for i, stage in enumerate(pipe.stages):
+        assert max(stage.out_latency) <= 4 * (i + 1) + 1e-9
+
+
+def test_retiming_preserves_function():
+    _, _, comb = build_comb(latency_cutoff=5)
+    pipe = to_pipeline(comb, 5, retiming=True, verbose=False)
+    rng = np.random.default_rng(1)
+    data = rng.uniform(-8, 8, (128, N))
+    golden = comb.predict(data, backend='numpy')
+    np.testing.assert_array_equal(pipe.predict(data, backend='numpy'), golden)
+
+
+def test_pipeline_json_roundtrip(tmp_path):
+    from da4ml_tpu.ir import Pipeline
+
+    _, _, comb = build_comb(latency_cutoff=4)
+    pipe = to_pipeline(comb, 4, retiming=False)
+    pipe.save(tmp_path / 'p.json')
+    pipe2 = Pipeline.load(tmp_path / 'p.json')
+    assert pipe == pipe2
