@@ -1,0 +1,78 @@
+"""Benchmark: CMVM DA-search throughput, JAX/TPU backend vs host baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config (BASELINE.md config 1/3): random 16x16 4-bit kernels, batch solve on
+the TPU backend vs the best available host backend (native C++ solver when
+built, else the sequential Python reference). Acceptance: every JAX solution
+is exact (Pipeline.kernel == kernel) and total cost <= host's.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _gen_kernels(n, dim=16, bits=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, 2**bits, (dim, dim)) * rng.choice([-1.0, 1.0], (dim, dim))).astype(np.float64) for _ in range(n)
+    ]
+
+
+def main():
+    from da4ml_tpu.cmvm import solve
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    kernels = _gen_kernels(n)
+
+    # host baseline: native C++ if built, else sequential Python reference
+    try:
+        from da4ml_tpu.native import is_available
+
+        host_backend = 'cpp' if is_available() else 'cpu'
+    except Exception:
+        host_backend = 'cpu'
+
+    t0 = time.time()
+    host_sols = [solve(k, backend=host_backend) for k in kernels]
+    host_time = time.time() - t0
+    host_rate = n / host_time
+
+    solve_jax_many(kernels[: min(n, 8)])  # warm compile
+    t0 = time.time()
+    jax_sols = solve_jax_many(kernels)
+    jax_time = time.time() - t0
+    jax_rate = n / jax_time
+
+    n_exact = sum(int(np.array_equal(np.asarray(s.kernel, np.float64), k)) for k, s in zip(kernels, jax_sols))
+    host_cost = float(np.mean([s.cost for s in host_sols]))
+    jax_cost = float(np.mean([s.cost for s in jax_sols]))
+
+    print(
+        json.dumps(
+            {
+                'metric': 'cmvm_solve_throughput_16x16_int4',
+                'value': round(jax_rate, 3),
+                'unit': 'matrices/s/chip',
+                'vs_baseline': round(jax_rate / host_rate, 3),
+                'detail': {
+                    'host_backend': host_backend,
+                    'host_rate': round(host_rate, 3),
+                    'batch': n,
+                    'exact': f'{n_exact}/{n}',
+                    'mean_cost_jax': jax_cost,
+                    'mean_cost_host': host_cost,
+                },
+            }
+        )
+    )
+
+
+if __name__ == '__main__':
+    main()

@@ -318,6 +318,7 @@ def solve_single_lanes(
     adder_size: int,
     carry_size: int,
     max_iters: int | None = None,
+    mesh=None,
     _budget_level: int = 0,
 ) -> list[CombLogic]:
     """Solve a batch of independent CMVM instances on device, emit on host.
@@ -379,6 +380,10 @@ def solve_single_lanes(
         # zeros -> no valid pair -> exit on the first iteration)
         n_lanes = len(active)
         bucket = 1 << (n_lanes - 1).bit_length()
+        if mesh is not None:
+            nd = mesh.devices.size
+            bucket = max(bucket, nd)
+            bucket = ((bucket + nd - 1) // nd) * nd
         if bucket > n_lanes:
             pad = bucket - n_lanes
             E0 = np.concatenate([E0, np.zeros((pad,) + E0.shape[1:], E0.dtype)])
@@ -387,15 +392,24 @@ def solve_single_lanes(
             mcodes = np.concatenate([mcodes, np.zeros((pad,), mcodes.dtype)])
 
         fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size))
-        E_f, op_rec, n_added = (
-            np.asarray(jax.device_get(t))[:n_lanes] for t in fn(jnp.asarray(E0), jnp.asarray(qmeta0), jnp.asarray(lat0), jnp.asarray(mcodes))
-        )
+        args = (jnp.asarray(E0), jnp.asarray(qmeta0), jnp.asarray(lat0), jnp.asarray(mcodes))
+        if mesh is not None:
+            # shard the lane axis over the mesh: each device runs its share of
+            # the candidate searches; no cross-device communication is needed
+            # until the host-side argmin
+            from ..parallel import batch_sharding
+
+            sh = batch_sharding(mesh, mesh.axis_names[0])
+            args = tuple(jax.device_put(a, sh) for a in args)
+        E_f, op_rec, n_added = (np.asarray(jax.device_get(t))[:n_lanes] for t in fn(*args))
 
         # lanes that exhausted the budget escalate to the next level
         if max_iters is None and n_iters < full_iters:
             capped = [k for a, k in enumerate(active) if int(n_added[a]) >= n_iters]
             if capped:
-                redo = solve_single_lanes([lanes[k] for k in capped], adder_size, carry_size, _budget_level=_budget_level + 1)
+                redo = solve_single_lanes(
+                    [lanes[k] for k in capped], adder_size, carry_size, mesh=mesh, _budget_level=_budget_level + 1
+                )
                 for k, sol in zip(capped, redo):
                     results[k] = sol
 
@@ -516,10 +530,11 @@ def solve_jax_many(
     adder_size: int = -1,
     carry_size: int = -1,
     search_all_decompose_dc: bool = True,
+    mesh=None,
 ) -> list[Pipeline]:
     """Batched CMVM solve: all (matrix × dc candidate) stage-0 searches run as
     one device batch, then all stage-1 searches. The argmin over dc candidates
-    per matrix happens on host."""
+    per matrix happens on host. ``mesh`` shards the lane axis over devices."""
     from .decompose import kernel_decompose
 
     kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
@@ -556,14 +571,14 @@ def solve_jax_many(
         mat0, mat1 = kernel_decompose(kern, dc)
         lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(m0, dc, _hard_eff)))
         mats1.append(mat1)
-    sols0 = solve_single_lanes(lanes0, adder_size, carry_size)
+    sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
     lanes1: list[_Lane] = []
     for (mi, dc), sol0, mat1 in zip(jobs, sols0, mats1):
         qints1, lats1 = _host_api.stage_feed(sol0)
         lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(m1, dc, _hard_eff)))
-    sols1 = solve_single_lanes(lanes1, adder_size, carry_size)
+    sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh)
 
     # candidate filtering (latency budget) + argmin per matrix
     results: list[Pipeline | None] = [None] * n_mat

@@ -182,6 +182,14 @@ class DaisExecutor:
         out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
         return out * self._out_scale()
 
+    def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:
+        """Batch inference with the sample axis sharded over a device mesh."""
+        from ..parallel import shard_batch
+
+        x, _ = shard_batch(self._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
+        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        return out[: len(data)] * self._out_scale()
+
 
 _executor_cache: dict[bytes, DaisExecutor] = {}
 

@@ -6,10 +6,17 @@ without TPU hardware (set before any jax import).
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# The axon TPU plugin force-registers itself at interpreter start (overriding
+# the JAX_PLATFORMS env var); override via jax.config so tests run on the
+# virtual CPU mesh instead of contending for the real chip.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np
 import pytest
