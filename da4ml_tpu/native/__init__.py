@@ -18,6 +18,17 @@ def is_available() -> bool:
         return False
 
 
+def has_solver() -> bool:
+    """True when the native CMVM solver (cmvm_solve symbol) is built."""
+    try:
+        from .bindings import load_lib
+
+        lib = load_lib()
+        return lib is not None and hasattr(lib, 'cmvm_solve')
+    except Exception:
+        return False
+
+
 def run_binary(binary, data, n_threads: int = 0):
     from .bindings import run_binary as _run
 

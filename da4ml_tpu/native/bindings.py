@@ -1,26 +1,133 @@
-"""ctypes bindings for the native library (placeholder until the C++ core lands).
+"""ctypes bindings to the native library (_da4ml_native.so).
 
-The native sources live in da4ml_tpu/native/src; ``python -m
-da4ml_tpu.native.build`` compiles them with g++ -fopenmp into
-_da4ml_native.so next to this file.
+The native sources live in ``da4ml_tpu/native/src`` and are compiled with
+``g++ -fopenmp`` by :mod:`da4ml_tpu.native.build` (auto-invoked on first use
+unless ``DA4ML_NO_NATIVE_BUILD`` is set). Bindings use ctypes only — no
+pybind11/nanobind dependency.
+
+Reference parity: the nanobind modules src/da4ml/_binary/{dais,cmvm}/bindings.cc.
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
+import threading
 
-def load_lib():
-    return None
+import numpy as np
+from numpy.typing import NDArray
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed: str | None = None
+
+_ERR_LEN = 4096
 
 
-def run_binary(binary, data, n_threads: int = 0):
-    raise NotImplementedError(
-        'Native DAIS interpreter is not built. Run `python -m da4ml_tpu.native.build` '
-        "or use backend='numpy' / backend='jax'."
+def load_lib() -> ctypes.CDLL | None:
+    """Load (building on demand) the native library; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed is not None:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            from .build import LIB_PATH, build, needs_build
+
+            if needs_build():
+                if os.environ.get('DA4ML_NO_NATIVE_BUILD'):
+                    _lib_failed = 'native library not built (DA4ML_NO_NATIVE_BUILD set)'
+                    return None
+                build()
+            lib = ctypes.CDLL(str(LIB_PATH))
+        except Exception as e:  # toolchain missing, build error, bad .so
+            _lib_failed = str(e)
+            return None
+
+        lib.dais_run.restype = ctypes.c_int
+        lib.dais_run.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.dais_program_info.restype = ctypes.c_int
+        lib.dais_program_info.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.da4ml_native_abi_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def load_error() -> str | None:
+    return _lib_failed
+
+
+def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64], n_threads: int = 0) -> NDArray[np.float64]:
+    """Execute a serialized DAIS program over a (n_samples, n_in) batch."""
+    lib = load_lib()
+    if lib is None:
+        raise RuntimeError(f'Native DAIS interpreter unavailable: {_lib_failed}')
+    binary = np.ascontiguousarray(binary, dtype=np.int32)
+    n_in, n_out = int(binary[2]), int(binary[3])
+    data = np.ascontiguousarray(data, dtype=np.float64)
+    data = data.reshape(len(data), -1)
+    if data.shape[1] != n_in:
+        raise ValueError(f'Input size mismatch: expected {n_in}, got {data.shape[1]}')
+    n_samples = data.shape[0]
+    out = np.empty((n_samples, n_out), dtype=np.float64)
+    err = ctypes.create_string_buffer(_ERR_LEN)
+    if n_threads <= 0:
+        n_threads = int(os.environ.get('DA_DEFAULT_THREADS', 0) or 0)
+    rc = lib.dais_run(
+        binary.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        binary.size,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n_samples,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n_threads,
+        err,
+        _ERR_LEN,
     )
+    if rc != 0:
+        raise RuntimeError(f'dais_run failed: {err.value.decode(errors="replace")}')
+    return out
+
+
+def program_info(binary: NDArray[np.int32]) -> dict:
+    lib = load_lib()
+    if lib is None:
+        raise RuntimeError(f'Native DAIS interpreter unavailable: {_lib_failed}')
+    binary = np.ascontiguousarray(binary, dtype=np.int32)
+    vals = [ctypes.c_int64() for _ in range(4)]
+    err = ctypes.create_string_buffer(_ERR_LEN)
+    rc = lib.dais_program_info(
+        binary.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        binary.size,
+        *[ctypes.byref(v) for v in vals],
+        err,
+        _ERR_LEN,
+    )
+    if rc != 0:
+        raise RuntimeError(f'dais_program_info failed: {err.value.decode(errors="replace")}')
+    n_in, n_out, n_ops, max_width = (v.value for v in vals)
+    return {'n_in': n_in, 'n_out': n_out, 'n_ops': n_ops, 'max_width': max_width}
 
 
 def solve_native(kernel, **kwargs):
-    raise NotImplementedError(
-        'Native CMVM solver is not built. Run `python -m da4ml_tpu.native.build` '
-        "or use backend='cpu' / backend='jax'."
-    )
+    raise NotImplementedError('Native CMVM solver lands with the cmvm_core native module.')

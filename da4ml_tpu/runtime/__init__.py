@@ -16,7 +16,7 @@ def run_comb(comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: 
     binary = comb.to_binary()
     if backend == 'auto':
         try:
-            from .native import is_available
+            from ..native import is_available
 
             backend = 'cpp' if is_available() else 'numpy'
         except Exception:
@@ -26,7 +26,7 @@ def run_comb(comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: 
 
         return run_binary(binary, data)
     if backend == 'cpp':
-        from .native import run_binary
+        from ..native import run_binary
 
         return run_binary(binary, data, n_threads=n_threads)
     if backend == 'jax':

@@ -1,0 +1,70 @@
+"""Native C++ interpreter parity: cpp backend == numpy backend (bit-exact)
+over the full op matrix, plus batch-threading and error paths.
+
+Mirrors the reference's role for dais_bin (src/da4ml/_binary/dais) as the
+oracle executor; here the numpy backend is the golden semantics and the C++
+build must agree exactly.
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+from test_trace_ops import CASES, N
+
+native = pytest.importorskip('da4ml_tpu.native')
+
+if not native.is_available():
+    pytest.skip('native toolchain unavailable', allow_module_level=True)
+
+
+def _trace(op_sym, seed=42):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 2, N)
+    i = rng.integers(-2, 5, N)
+    f = np.maximum(rng.integers(-2, 5, N), 1 - k - i)
+    inp = FixedVariableArrayInput(N, hwconf=HWConfig(1, -1, -1))
+    out = op_sym(inp.quantize(k, i, f))
+    return comb_trace(inp, out)
+
+
+@pytest.mark.parametrize('name', sorted(CASES))
+def test_cpp_matches_numpy(name):
+    op_sym, _ = CASES[name]
+    comb = _trace(op_sym)
+    data = np.random.default_rng(3).uniform(-8, 8, (512, N))
+    np.testing.assert_array_equal(
+        comb.predict(data, backend='cpp'),
+        comb.predict(data, backend='numpy'),
+    )
+
+
+def test_cpp_lookup():
+    comb = _trace(lambda x: np.sin(x).quantize(np.ones(N), np.ones(N), np.full(N, 4)))
+    data = np.random.default_rng(4).uniform(-8, 8, (256, N))
+    np.testing.assert_array_equal(comb.predict(data, backend='cpp'), comb.predict(data, backend='numpy'))
+
+
+def test_cpp_multithreaded_large_batch():
+    comb = _trace(CASES['matmul_int'][0])
+    data = np.random.default_rng(5).uniform(-8, 8, (4096, N))
+    golden = comb.predict(data, backend='numpy')
+    for n_threads in (1, 2, 8):
+        np.testing.assert_array_equal(comb.predict(data, backend='cpp', n_threads=n_threads), golden)
+
+
+def test_program_info():
+    from da4ml_tpu.native.bindings import program_info
+
+    comb = _trace(CASES['sum'][0])
+    info = program_info(comb.to_binary())
+    assert info['n_in'] == N and info['n_out'] == 1
+    assert info['n_ops'] == len(comb.ops)
+    assert 0 < info['max_width'] <= 63
+
+
+def test_invalid_binary_rejected():
+    from da4ml_tpu.native.bindings import run_binary
+
+    with pytest.raises(RuntimeError, match='version mismatch'):
+        run_binary(np.array([9, 0, 1, 1, 0, 0], dtype=np.int32), np.zeros((1, 1)))
