@@ -129,5 +129,155 @@ def program_info(binary: NDArray[np.int32]) -> dict:
     return {'n_in': n_in, 'n_out': n_out, 'n_ops': n_ops, 'max_width': max_width}
 
 
-def solve_native(kernel, **kwargs):
-    raise NotImplementedError('Native CMVM solver lands with the cmvm_core native module.')
+def _declare_cmvm(lib: ctypes.CDLL) -> None:
+    if getattr(lib, '_cmvm_declared', False):
+        return
+    lib.cmvm_solve.restype = ctypes.c_void_p
+    lib.cmvm_solve.argtypes = [
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+    ]
+    lib.cmvm_stage_shape.restype = ctypes.c_int
+    lib.cmvm_stage_shape.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.cmvm_stage_fill.restype = ctypes.c_int
+    lib.cmvm_stage_fill.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.cmvm_free.restype = None
+    lib.cmvm_free.argtypes = [ctypes.c_void_p]
+    lib._cmvm_declared = True
+
+
+def _unpack_stage(lib: ctypes.CDLL, handle: int, stage: int):
+    from ..ir.comb import CombLogic
+    from ..ir.types import Op, QInterval
+
+    n_in, n_out, n_ops = (ctypes.c_int64() for _ in range(3))
+    rc = lib.cmvm_stage_shape(handle, stage, *(ctypes.byref(v) for v in (n_in, n_out, n_ops)))
+    if rc != 0:
+        raise RuntimeError('cmvm_stage_shape failed')
+    ops9 = np.empty((n_ops.value, 9), dtype=np.float64)
+    inp_shifts = np.empty(n_in.value, dtype=np.int32)
+    out_idxs = np.empty(n_out.value, dtype=np.int32)
+    out_shifts = np.empty(n_out.value, dtype=np.int32)
+    out_negs = np.empty(n_out.value, dtype=np.int32)
+    rc = lib.cmvm_stage_fill(
+        handle,
+        stage,
+        ops9.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        inp_shifts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_shifts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_negs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise RuntimeError('cmvm_stage_fill failed')
+    ops = [
+        Op(int(r[0]), int(r[1]), int(r[2]), int(r[3]), QInterval(r[4], r[5], r[6]), float(r[7]), float(r[8]))
+        for r in ops9
+    ]
+    return CombLogic(
+        shape=(n_in.value, n_out.value),
+        inp_shifts=[int(v) for v in inp_shifts],
+        out_idxs=[int(v) for v in out_idxs],
+        out_shifts=[int(v) for v in out_shifts],
+        out_negs=[bool(v) for v in out_negs],
+        ops=ops,
+        carry_size=-1,
+        adder_size=-1,
+    )
+
+
+def solve_native(
+    kernel,
+    method0: str = 'wmc',
+    method1: str = 'auto',
+    hard_dc: int = -1,
+    decompose_dc: int = -2,
+    qintervals=None,
+    latencies=None,
+    adder_size: int = -1,
+    carry_size: int = -1,
+    search_all_decompose_dc: bool = True,
+    n_threads: int = 0,
+):
+    """Full CMVM solve in the native library; returns an ir.Pipeline.
+
+    Decision-identical with the Python host solver (cmvm/api.py solve),
+    parallelized over decompose-depth candidates with OpenMP
+    (reference: api.cc:194-238).
+    """
+    from ..ir.comb import Pipeline
+    from ..ir.types import QInterval
+
+    lib = load_lib()
+    if lib is None:
+        raise RuntimeError(f'Native CMVM solver unavailable: {_lib_failed}')
+    _declare_cmvm(lib)
+
+    kernel = np.ascontiguousarray(kernel, dtype=np.float64)
+    if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
+        raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
+    n_in, n_out = kernel.shape
+    if not qintervals:
+        qintervals = [QInterval(-128.0, 127.0, 1.0)] * n_in
+    if not latencies:
+        latencies = [0.0] * n_in
+    qarr = np.ascontiguousarray([[q[0], q[1], q[2]] for q in qintervals], dtype=np.float64)
+    larr = np.ascontiguousarray(latencies, dtype=np.float64)
+    if len(qarr) != n_in or len(larr) != n_in:
+        raise ValueError('qintervals/latencies length must match kernel rows')
+
+    err = ctypes.create_string_buffer(_ERR_LEN)
+    handle = lib.cmvm_solve(
+        kernel.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n_in,
+        n_out,
+        method0.encode(),
+        method1.encode(),
+        hard_dc,
+        decompose_dc,
+        qarr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        larr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        adder_size,
+        carry_size,
+        int(search_all_decompose_dc),
+        n_threads,
+        err,
+        _ERR_LEN,
+    )
+    if not handle:
+        raise RuntimeError(f'cmvm_solve failed: {err.value.decode(errors="replace")}')
+    try:
+        sol0 = _unpack_stage(lib, handle, 0)
+        sol1 = _unpack_stage(lib, handle, 1)
+    finally:
+        lib.cmvm_free(handle)
+    sol0 = sol0._replace(carry_size=carry_size, adder_size=adder_size)
+    sol1 = sol1._replace(carry_size=carry_size, adder_size=adder_size)
+    return Pipeline(stages=(sol0, sol1))
