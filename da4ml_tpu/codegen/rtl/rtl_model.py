@@ -1,0 +1,434 @@
+"""RTL project writer: Verilog (and VHDL) emission, Verilator emulation
+binder, vendor build scripts, and bit-exact ``predict``.
+
+``RTLModel`` takes a CombLogic or Pipeline, optionally re-times it to a
+latency cutoff, and writes a self-contained project:
+
+    <path>/
+      src/            *.v stage modules + top + wrapper + primitives + .mem
+      binder/         Verilator C++ binder + Makefile (emulation .so)
+      tcl/            Vivado / Quartus out-of-context build scripts
+      constraints/    clock constraints (.xdc / .sdc)
+      model/          pipeline.json (reloadable IR)
+      metadata.json   cost / latency / io-map summary
+
+``predict`` runs the Verilator-compiled emulator when available
+(``compile()``; requires verilator in PATH) and falls back to the bit-exact
+DAIS interpreter with ``backend='interp'``.
+
+Parity target: reference src/da4ml/codegen/rtl/rtl_model.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import shutil
+import subprocess
+import uuid
+from pathlib import Path
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ...ir.comb import CombLogic, Pipeline
+from ...ir.types import minimal_kif
+from ..rtl.verilog.comb import VerilogCombEmitter
+from ..rtl.verilog.io_wrapper import emit_io_wrapper
+from ..rtl.verilog.pipeline import emit_pipeline
+
+_SRC_DIR = Path(__file__).parent / 'verilog' / 'source'
+_COMMON_DIR = Path(__file__).parent / 'common'
+
+PRIMITIVES = [
+    'shift_adder.v',
+    'negative.v',
+    'quantizer.v',
+    'relu.v',
+    'msb_mux.v',
+    'multiplier.v',
+    'lookup_table.v',
+    'bit_binop.v',
+    'bit_unary.v',
+]
+
+
+class RTLModel:
+    """Write, build and drive one RTL project for a DAIS program."""
+
+    flavor = 'verilog'
+
+    def __init__(
+        self,
+        solution: CombLogic | Pipeline,
+        name: str,
+        path: str | Path,
+        latency_cutoff: float = -1,
+        print_latency: bool = False,
+        part: str = 'xcvu13p-flga2577-2-e',
+        clock_period: float = 5.0,
+        clock_uncertainty: float = 0.1,
+        register_layers: int = 1,
+    ):
+        if isinstance(solution, CombLogic) and latency_cutoff > 0:
+            from ...trace.pipeline import to_pipeline
+
+            solution = to_pipeline(solution, latency_cutoff)
+        self.solution = solution
+        self.name = name
+        self.path = Path(path)
+        self.print_latency = print_latency
+        self.part = part
+        self.clock_period = clock_period
+        self.clock_uncertainty = clock_uncertainty
+        self.register_layers = register_layers
+        self._lib: ctypes.CDLL | None = None
+        self._lib_path: Path | None = None
+
+    # ----------------------------------------------------------- properties
+
+    @property
+    def is_pipeline(self) -> bool:
+        return isinstance(self.solution, Pipeline)
+
+    @property
+    def latency_ticks(self) -> int:
+        """Clock ticks from input to output (register layers between stages)."""
+        if not self.is_pipeline:
+            return 0
+        return (len(self.solution.stages) - 1) * max(self.register_layers, 1)
+
+    @property
+    def cost(self) -> float:
+        return self.solution.cost
+
+    # ------------------------------------------------------------ emission
+
+    def _emit(self) -> tuple[dict[str, str], dict]:
+        """Returns ({filename: text}, metadata)."""
+        files: dict[str, str] = {}
+        if self.is_pipeline:
+            top_text, mem_files, stage_texts = emit_pipeline(
+                self.solution, self.name, self.print_latency, self.register_layers
+            )
+            for si, text in enumerate(stage_texts):
+                files[f'{self.name}_s{si}.v'] = text
+            files[f'{self.name}.v'] = top_text
+            files.update(mem_files)
+            clocked = True
+        else:
+            em = VerilogCombEmitter(self.solution, self.name, self.print_latency)
+            files[f'{self.name}.v'] = em.emit()
+            files.update(em.mem_files)
+            clocked = False
+
+        wrapper_text, in_map, out_map = emit_io_wrapper(self.solution, f'{self.name}_wrapper', self.name, clocked)
+        files[f'{self.name}_wrapper.v'] = wrapper_text
+
+        inp_kifs = [tuple(int(v) for v in minimal_kif(q)) for q in self.solution.inp_qint]
+        out_kifs = [tuple(int(v) for v in minimal_kif(q)) for q in self.solution.out_qint]
+        lat_lo, lat_hi = self.solution.latency
+        metadata = {
+            'name': self.name,
+            'flavor': self.flavor,
+            'cost': self.solution.cost,
+            'latency': [lat_lo, lat_hi],
+            'latency_ticks': self.latency_ticks,
+            'clock_period': self.clock_period,
+            'clock_uncertainty': self.clock_uncertainty,
+            'part': self.part,
+            'pipelined': self.is_pipeline,
+            'n_stages': len(self.solution.stages) if self.is_pipeline else 1,
+            'reg_bits': self.solution.reg_bits if self.is_pipeline else 0,
+            'inp_kifs': inp_kifs,
+            'out_kifs': out_kifs,
+            'in_lane_width': in_map.lane_width,
+            'out_lane_width': out_map.lane_width,
+            'in_elems': in_map.elems,
+            'out_elems': out_map.elems,
+        }
+        return files, metadata
+
+    def write(self) -> 'RTLModel':
+        files, metadata = self._emit()
+        src = self.path / 'src'
+        src.mkdir(parents=True, exist_ok=True)
+        for fname, text in files.items():
+            (src / fname).write_text(text)
+        for prim in PRIMITIVES:
+            shutil.copy(_SRC_DIR / prim, src / prim)
+
+        (self.path / 'model').mkdir(exist_ok=True)
+        if self.is_pipeline:
+            self.solution.save(self.path / 'model' / 'pipeline.json')
+        else:
+            self.solution.save(self.path / 'model' / 'comb.json')
+
+        (self.path / 'metadata.json').write_text(json.dumps(metadata, indent=2))
+        self._write_constraints()
+        self._write_tcl()
+        self._write_binder(metadata)
+        return self
+
+    def _write_constraints(self):
+        cdir = self.path / 'constraints'
+        cdir.mkdir(exist_ok=True)
+        period = self.clock_period
+        xdc = (
+            f'create_clock -period {period} -name clk [get_ports clk]\n'
+            f'set_clock_uncertainty {self.clock_uncertainty * period:.3f} [get_clocks clk]\n'
+        )
+        sdc = f'create_clock -period {period} -name clk [get_ports clk]\n'
+        if self.is_pipeline:
+            (cdir / f'{self.name}.xdc').write_text(xdc)
+            (cdir / f'{self.name}.sdc').write_text(sdc)
+        else:
+            (cdir / f'{self.name}.xdc').write_text('# combinational block: no clock\n')
+
+    def _write_tcl(self):
+        tdir = self.path / 'tcl'
+        tdir.mkdir(exist_ok=True)
+        top = f'{self.name}_wrapper'
+        vivado = f"""# Out-of-context synthesis + implementation (Vivado)
+set top {top}
+create_project -in_memory -part {self.part}
+add_files [glob src/*.v]
+read_xdc -mode out_of_context constraints/{self.name}.xdc
+synth_design -top $top -mode out_of_context
+opt_design
+place_design
+route_design
+report_timing_summary -file timing_summary.rpt
+report_utilization -hierarchical -file utilization.rpt
+report_power -file power.rpt
+"""
+        quartus = f"""# Quartus compile flow
+project_new {self.name} -overwrite
+set_global_assignment -name TOP_LEVEL_ENTITY {top}
+foreach f [glob src/*.v] {{ set_global_assignment -name VERILOG_FILE $f }}
+set_global_assignment -name SDC_FILE constraints/{self.name}.sdc
+execute_flow -compile
+"""
+        (tdir / 'build_vivado.tcl').write_text(vivado)
+        (tdir / 'build_quartus.tcl').write_text(quartus)
+
+    # ------------------------------------------------------------- binder
+
+    def _write_binder(self, metadata: dict):
+        bdir = self.path / 'binder'
+        bdir.mkdir(exist_ok=True)
+        shutil.copy(_COMMON_DIR / 'binder_util.hh', bdir / 'binder_util.hh')
+
+        top = f'{self.name}_wrapper'
+        lw_in, lw_out = metadata['in_lane_width'], metadata['out_lane_width']
+        n_in, n_out = len(metadata['in_elems']), len(metadata['out_elems'])
+        in_signed = [int(s) for _, _, s, _ in metadata['in_elems']]
+        out_signed = [int(s) for _, _, s, _ in metadata['out_elems']]
+        in_widths = [w for _, w, _, _ in metadata['in_elems']]
+        out_widths = [w for _, w, _, _ in metadata['out_elems']]
+        lat = metadata['latency_ticks']
+        clocked = metadata['pipelined']
+
+        def arr(vals):
+            return '{' + ', '.join(str(v) for v in vals) + '}'
+
+        binder = f"""// Generated Verilator binder for {top}: int64 codes in/out, OpenMP batch.
+#include <omp.h>
+#include <vector>
+#include "V{top}.h"
+#include "binder_util.hh"
+
+using namespace da4ml_binder;
+
+static const int N_IN = {n_in}, N_OUT = {n_out};
+static const int LW_IN = {lw_in}, LW_OUT = {lw_out};
+static const int LAT = {lat};
+static const int IN_W[] = {arr(in_widths)};
+static const int OUT_W[] = {arr(out_widths)};
+static const int OUT_S[] = {arr(out_signed)};
+static const int IN_S[] = {arr(in_signed)};
+
+static void run_chunk(const int64_t* in, int64_t* out, long n) {{
+    VerilatedContext ctx;
+    V{top} top{{&ctx}};
+"""
+        if clocked:
+            binder += f"""    long total = n + LAT;
+    for (long t = 0; t < total; ++t) {{
+        if (t < n)
+            for (int e = 0; e < N_IN; ++e)
+                set_bits(top.inp, e * LW_IN, IN_W[e] ? IN_W[e] : 1, uint64_t(in[t * N_IN + e]));
+        top.clk = 0; top.eval();
+        if (t >= LAT) {{
+            long s = t - LAT;
+            for (int e = 0; e < N_OUT; ++e)
+                out[s * N_OUT + e] = sext(get_bits(top.out, e * LW_OUT, OUT_W[e] ? OUT_W[e] : 1), OUT_W[e], OUT_S[e]);
+        }}
+        top.clk = 1; top.eval();
+    }}
+"""
+        else:
+            binder += """    for (long s = 0; s < n; ++s) {
+        for (int e = 0; e < N_IN; ++e)
+            set_bits(top.inp, e * LW_IN, IN_W[e] ? IN_W[e] : 1, uint64_t(in[s * N_IN + e]));
+        top.eval();
+        for (int e = 0; e < N_OUT; ++e)
+            out[s * N_OUT + e] = sext(get_bits(top.out, e * LW_OUT, OUT_W[e] ? OUT_W[e] : 1), OUT_W[e], OUT_S[e]);
+    }
+"""
+        binder += """}
+
+extern "C" int inference(const int64_t* in, int64_t* out, long n_samples, int n_threads) {
+    if (n_threads <= 0) n_threads = omp_get_max_threads();
+    long chunk = (n_samples + n_threads - 1) / n_threads;
+    if (chunk < 32) chunk = 32;
+    long n_chunks = (n_samples + chunk - 1) / chunk;
+#pragma omp parallel for schedule(static) num_threads(n_threads)
+    for (long c = 0; c < n_chunks; ++c) {
+        long lo = c * chunk, hi = lo + chunk > n_samples ? n_samples : lo + chunk;
+        run_chunk(in + lo * N_IN, out + lo * N_OUT, hi - lo);
+    }
+    return 0;
+}
+"""
+        (bdir / 'binder.cc').write_text(binder)
+
+        makefile = f"""TOP = {top}
+VERILATOR ?= verilator
+VERILATOR_ROOT ?= $(shell $(VERILATOR) --getenv VERILATOR_ROOT)
+CXX ?= g++
+SO = lib$(TOP).so
+
+all: $(SO)
+
+obj_dir/V$(TOP)__ALL.a: ../src/*.v
+\t$(VERILATOR) --cc ../src/$(TOP).v -y ../src --Mdir obj_dir --build -j 0 -O3 --top-module $(TOP)
+
+$(SO): binder.cc obj_dir/V$(TOP)__ALL.a
+\t$(CXX) -O2 -fPIC -shared -fopenmp -std=c++17 -Iobj_dir -I$(VERILATOR_ROOT)/include \\
+\t  binder.cc obj_dir/V$(TOP)__ALL.a \\
+\t  $(VERILATOR_ROOT)/include/verilated.cpp $(VERILATOR_ROOT)/include/verilated_threads.cpp \\
+\t  -o $(SO)
+
+clean:
+\trm -rf obj_dir $(SO)
+"""
+        (bdir / 'Makefile').write_text(makefile)
+
+    # ------------------------------------------------------------- compile
+
+    @staticmethod
+    def emulation_available() -> bool:
+        return shutil.which('verilator') is not None
+
+    def compile(self, verbose: bool = False) -> 'RTLModel':
+        """Build the Verilator emulation .so (requires verilator in PATH)."""
+        if not self.emulation_available():
+            raise RuntimeError('verilator not found in PATH; RTL emulation unavailable (use predict backend="interp")')
+        bdir = self.path / 'binder'
+        # copy .mem files next to the obj_dir so $readmemh resolves
+        for mem in (self.path / 'src').glob('*.mem'):
+            shutil.copy(mem, bdir / mem.name)
+        env = os.environ.copy()
+        proc = subprocess.run(['make', '-C', str(bdir)], capture_output=True, text=True, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(f'RTL emulation build failed:\n{proc.stdout}\n{proc.stderr}')
+        built = bdir / f'lib{self.name}_wrapper.so'
+        stamped = bdir / f'lib{self.name}_{uuid.uuid4().hex[:8]}.so'
+        shutil.move(built, stamped)
+        self._lib_path = stamped
+        self._lib = None
+        if verbose:
+            print(f'built {stamped}')
+        return self
+
+    def _load_lib(self) -> ctypes.CDLL:
+        if self._lib is not None:
+            return self._lib
+        if self._lib_path is None:
+            libs = sorted((self.path / 'binder').glob(f'lib{self.name}_*.so'))
+            if not libs:
+                raise RuntimeError('emulator not compiled; call compile() first')
+            self._lib_path = libs[-1]
+        lib = ctypes.CDLL(str(self._lib_path))
+        lib.inference.restype = ctypes.c_int
+        lib.inference.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_long,
+            ctypes.c_int,
+        ]
+        self._lib = lib
+        return lib
+
+    # ------------------------------------------------------------- predict
+
+    def _to_codes(self, data: NDArray) -> NDArray[np.int64]:
+        """Float inputs -> integer codes at each input's (k, i, f)."""
+        codes = np.empty(data.shape, dtype=np.int64)
+        for e, qi in enumerate(self.solution.inp_qint):
+            k, i, f = minimal_kif(qi)
+            w = k + i + f
+            v = np.floor(data[:, e] * 2.0**f).astype(np.int64)
+            if w <= 0:
+                codes[:, e] = 0
+                continue
+            mod = np.int64(1) << w
+            int_min = -(np.int64(1) << (w - 1)) if k else np.int64(0)
+            codes[:, e] = (((v - int_min) % mod) + int_min) & (mod - 1)
+        return codes
+
+    def _from_codes(self, codes: NDArray[np.int64]) -> NDArray[np.float64]:
+        out = np.empty(codes.shape, dtype=np.float64)
+        for e, qi in enumerate(self.solution.out_qint):
+            _, _, f = minimal_kif(qi)
+            out[:, e] = codes[:, e].astype(np.float64) * 2.0**-f
+        return out
+
+    def predict(self, data: NDArray, backend: str = 'auto', n_threads: int = 0) -> NDArray[np.float64]:
+        """Bit-exact inference: 'emu' (Verilator .so), 'interp' (DAIS), 'auto'."""
+        data = np.asarray(data, dtype=np.float64).reshape(len(data), -1)
+        if backend == 'auto':
+            try:
+                self._load_lib()
+                backend = 'emu'
+            except RuntimeError:
+                backend = 'interp'
+        if backend == 'interp':
+            return self.solution.predict(data)
+        lib = self._load_lib()
+        codes = np.ascontiguousarray(self._to_codes(data))
+        out = np.empty((len(data), len(self.solution.out_qint)), dtype=np.int64)
+        if n_threads <= 0:
+            n_threads = int(os.environ.get('DA_DEFAULT_THREADS', 0) or 0)
+        rc = lib.inference(
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(data),
+            n_threads,
+        )
+        if rc != 0:
+            raise RuntimeError('RTL emulation inference failed')
+        return self._from_codes(out)
+
+    def __repr__(self) -> str:
+        lat_lo, lat_hi = self.solution.latency
+        kind = f'Pipeline[{len(self.solution.stages)}]' if self.is_pipeline else 'CombLogic'
+        return (
+            f'{type(self).__name__}({self.name}: {kind}, estimated cost {self.cost:.0f} LUTs, '
+            f'latency {lat_lo}-{lat_hi}, {self.latency_ticks} ticks @ {self.clock_period} ns)'
+        )
+
+
+class VerilogModel(RTLModel):
+    flavor = 'verilog'
+
+
+class VHDLModel(RTLModel):
+    """VHDL flavor (emitters land with the VHDL milestone)."""
+
+    flavor = 'vhdl'
+
+    def _emit(self):
+        raise NotImplementedError('VHDL emission lands with the VHDL codegen milestone')

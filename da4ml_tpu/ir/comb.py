@@ -279,7 +279,7 @@ class CombLogic(NamedTuple):
                 pad_left = self.lookup_tables[op.data].pads(self.ops[op.id0].qint)[0]
                 data_u64[0] = ((pad_left << 32) | op.data) & 0xFFFFFFFFFFFFFFFF
         data = np.concatenate([header, code.ravel()])
-        if self.lookup_tables is None:
+        if not self.lookup_tables:  # None or empty tuple: no table section
             return data
         tables = [t.table for t in self.lookup_tables]
         sizes = [len(t) for t in tables]

@@ -1,0 +1,109 @@
+"""Verilog codegen oracle chain: for each traced op, the emitted netlist —
+parsed and executed by the bundled netlist simulator — must agree exactly
+with the DAIS interpreter. Mirrors the reference's test_rtl_gen pattern
+(tests/test_ops.py:72-86 in the reference tree) with the netlist simulator
+standing in for Verilator when it is not installed.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.codegen import RTLModel, VerilogModel
+from da4ml_tpu.codegen.rtl.verilog.comb import VerilogCombEmitter
+from da4ml_tpu.codegen.rtl.verilog.netlist_sim import simulate_comb
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+from test_trace_ops import CASES, N
+
+
+def _trace(op_sym, seed=42):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 2, N)
+    i = rng.integers(-2, 5, N)
+    f = np.maximum(rng.integers(-2, 5, N), 1 - k - i)
+    inp = FixedVariableArrayInput(N, hwconf=HWConfig(1, -1, -1))
+    out = op_sym(inp.quantize(k, i, f))
+    return comb_trace(inp, out)
+
+
+@pytest.mark.parametrize('name', sorted(CASES))
+def test_verilog_netlist_exact(name):
+    op_sym, _ = CASES[name]
+    comb = _trace(op_sym)
+    data = np.random.default_rng(3).uniform(-8, 8, (128, N))
+    golden = comb.predict(data, backend='numpy')
+    np.testing.assert_array_equal(simulate_comb(comb, data=data), golden)
+
+
+def test_verilog_lookup_chain():
+    comb = _trace(lambda x: np.sin(x).quantize(np.ones(N), np.ones(N), np.full(N, 4)))
+    data = np.random.default_rng(4).uniform(-8, 8, (64, N))
+    np.testing.assert_array_equal(simulate_comb(comb, data=data), comb.predict(data, backend='numpy'))
+
+
+@pytest.mark.parametrize('cutoff', [0.5, 1.0, 2.0])
+def test_verilog_pipeline_stages_exact(cutoff):
+    comb = _trace(CASES['matmul_int'][0])
+    pipe = to_pipeline(comb, cutoff)
+    data = np.random.default_rng(6).uniform(-8, 8, (64, N))
+    cur = data
+    for si, stage in enumerate(pipe.stages):
+        ref = stage.predict(cur, backend='numpy')
+        np.testing.assert_array_equal(simulate_comb(stage, name=f's{si}', data=cur), ref)
+        cur = ref
+    np.testing.assert_array_equal(cur, comb.predict(data, backend='numpy'))
+
+
+def test_rtl_project_write(tmp_path):
+    comb = _trace(CASES['matmul_frac'][0])
+    pipe = to_pipeline(comb, 2.0)
+    model = RTLModel(pipe, 'prj', tmp_path).write()
+    src = tmp_path / 'src'
+    assert (src / 'prj.v').exists()
+    for si in range(len(pipe.stages)):
+        assert (src / f'prj_s{si}.v').exists()
+    assert (src / 'prj_wrapper.v').exists()
+    assert (src / 'shift_adder.v').exists()
+    meta = json.loads((tmp_path / 'metadata.json').read_text())
+    assert meta['cost'] == pipe.cost
+    assert meta['n_stages'] == len(pipe.stages)
+    assert (tmp_path / 'binder' / 'binder.cc').exists()
+    assert (tmp_path / 'binder' / 'Makefile').exists()
+    assert (tmp_path / 'tcl' / 'build_vivado.tcl').exists()
+    assert (tmp_path / 'constraints' / 'prj.xdc').exists()
+    # IR round-trips from the project dump
+    from da4ml_tpu.ir import Pipeline
+
+    pipe2 = Pipeline.load(tmp_path / 'model' / 'pipeline.json')
+    assert pipe2 == pipe
+    data = np.random.default_rng(1).uniform(-8, 8, (32, N))
+    np.testing.assert_array_equal(model.predict(data, backend='interp'), comb.predict(data, backend='numpy'))
+
+
+def test_rtl_comb_project_write(tmp_path):
+    comb = _trace(CASES['sum'][0])
+    model = VerilogModel(comb, 'prj', tmp_path).write()
+    assert (tmp_path / 'src' / 'prj.v').exists()
+    assert (tmp_path / 'model' / 'comb.json').exists()
+    text = (tmp_path / 'src' / 'prj.v').read_text()
+    assert 'module prj (' in text and 'endmodule' in text
+    assert model.latency_ticks == 0
+
+
+@pytest.mark.skipif(not RTLModel.emulation_available(), reason='verilator not installed')
+def test_rtl_verilator_emulation(tmp_path):
+    comb = _trace(CASES['matmul_int'][0])
+    model = RTLModel(to_pipeline(comb, 2.0), 'prj', tmp_path).write().compile()
+    data = np.random.default_rng(2).uniform(-8, 8, (256, N))
+    np.testing.assert_array_equal(model.predict(data, backend='emu'), comb.predict(data, backend='numpy'))
+
+
+def test_mem_file_x_entries():
+    comb = _trace(lambda x: np.sin(x).quantize(np.ones(N), np.ones(N), np.full(N, 4)))
+    em = VerilogCombEmitter(comb, 'm')
+    em.emit()
+    assert em.mem_files, 'lookup op must emit a .mem file'
+    for content in em.mem_files.values():
+        lines = content.strip().splitlines()
+        assert all(set(ln) <= set('0123456789abcdefx') for ln in lines)
