@@ -365,12 +365,13 @@ clean:
     # ------------------------------------------------------------- predict
 
     def _to_codes(self, data: NDArray) -> NDArray[np.int64]:
-        """Float inputs -> integer codes at each input's (k, i, f)."""
+        """Float inputs -> integer codes: wrap(floor(x * 2**(inp_shift + f)))."""
+        first = self.solution.stages[0] if self.is_pipeline else self.solution
         codes = np.empty(data.shape, dtype=np.int64)
         for e, qi in enumerate(self.solution.inp_qint):
             k, i, f = minimal_kif(qi)
             w = k + i + f
-            v = np.floor(data[:, e] * 2.0**f).astype(np.int64)
+            v = np.floor(data[:, e] * 2.0 ** (f + int(first.inp_shifts[e]))).astype(np.int64)
             if w <= 0:
                 codes[:, e] = 0
                 continue

@@ -279,7 +279,7 @@ def simulate_comb(comb, name: str = 'sim', data: NDArray | None = None) -> NDArr
             if w == 0:
                 continue
             k, i, f = inp_kifs[e]
-            v = int(np.floor(row[e] * 2.0**f))
+            v = int(np.floor(row[e] * 2.0 ** (f + int(comb.inp_shifts[e]))))
             bits |= (v & _mask(w)) << off
         out_bits = sim.run_sample(bits)
         for e, (off, w) in enumerate(out_lay):
