@@ -39,6 +39,7 @@ from ..rtl.verilog.io_wrapper import emit_io_wrapper
 from ..rtl.verilog.pipeline import emit_pipeline
 
 _SRC_DIR = Path(__file__).parent / 'verilog' / 'source'
+_VHDL_SRC_DIR = Path(__file__).parent / 'vhdl' / 'source'
 _COMMON_DIR = Path(__file__).parent / 'common'
 
 PRIMITIVES = [
@@ -51,6 +52,19 @@ PRIMITIVES = [
     'lookup_table.v',
     'bit_binop.v',
     'bit_unary.v',
+]
+
+VHDL_PRIMITIVES = [
+    'da4ml_util.vhd',
+    'shift_adder.vhd',
+    'negative.vhd',
+    'quantizer.vhd',
+    'relu.vhd',
+    'msb_mux.vhd',
+    'multiplier.vhd',
+    'lookup_table.vhd',
+    'bit_binop.vhd',
+    'bit_unary.vhd',
 ]
 
 
@@ -156,8 +170,10 @@ class RTLModel:
         src.mkdir(parents=True, exist_ok=True)
         for fname, text in files.items():
             (src / fname).write_text(text)
-        for prim in PRIMITIVES:
-            shutil.copy(_SRC_DIR / prim, src / prim)
+        prim_dir = _SRC_DIR if self.flavor == 'verilog' else _VHDL_SRC_DIR
+        prims = PRIMITIVES if self.flavor == 'verilog' else VHDL_PRIMITIVES
+        for prim in prims:
+            shutil.copy(prim_dir / prim, src / prim)
 
         (self.path / 'model').mkdir(exist_ok=True)
         if self.is_pipeline:
@@ -427,9 +443,96 @@ class VerilogModel(RTLModel):
 
 
 class VHDLModel(RTLModel):
-    """VHDL flavor (emitters land with the VHDL milestone)."""
+    """VHDL-2008 flavor: same project layout with .vhd sources.
+
+    The emulation path GHDL-synthesizes the VHDL to Verilog first (see the
+    binder Makefile); where GHDL is absent the bundled VHDL netlist
+    simulator (vhdl/netlist_sim.py) provides the generated-code oracle.
+    """
 
     flavor = 'vhdl'
 
     def _emit(self):
-        raise NotImplementedError('VHDL emission lands with the VHDL codegen milestone')
+        from .vhdl.comb import VHDLCombEmitter
+        from .vhdl.io_wrapper import emit_io_wrapper_vhdl
+        from .vhdl.pipeline import emit_pipeline_vhdl
+
+        files: dict[str, str] = {}
+        if self.is_pipeline:
+            top_text, mem_files, stage_texts = emit_pipeline_vhdl(
+                self.solution, self.name, self.print_latency, self.register_layers
+            )
+            for si, text in enumerate(stage_texts):
+                files[f'{self.name}_s{si}.vhd'] = text
+            files[f'{self.name}.vhd'] = top_text
+            files.update(mem_files)
+            clocked = True
+        else:
+            em = VHDLCombEmitter(self.solution, self.name, self.print_latency)
+            files[f'{self.name}.vhd'] = em.emit()
+            files.update(em.mem_files)
+            clocked = False
+
+        wrapper_text, in_map, out_map = emit_io_wrapper_vhdl(self.solution, f'{self.name}_wrapper', self.name, clocked)
+        files[f'{self.name}_wrapper.vhd'] = wrapper_text
+
+        inp_kifs = [tuple(int(v) for v in minimal_kif(q)) for q in self.solution.inp_qint]
+        out_kifs = [tuple(int(v) for v in minimal_kif(q)) for q in self.solution.out_qint]
+        lat_lo, lat_hi = self.solution.latency
+        metadata = {
+            'name': self.name,
+            'flavor': self.flavor,
+            'cost': self.solution.cost,
+            'latency': [lat_lo, lat_hi],
+            'latency_ticks': self.latency_ticks,
+            'clock_period': self.clock_period,
+            'clock_uncertainty': self.clock_uncertainty,
+            'part': self.part,
+            'pipelined': self.is_pipeline,
+            'n_stages': len(self.solution.stages) if self.is_pipeline else 1,
+            'reg_bits': self.solution.reg_bits if self.is_pipeline else 0,
+            'inp_kifs': inp_kifs,
+            'out_kifs': out_kifs,
+            'in_lane_width': in_map.lane_width,
+            'out_lane_width': out_map.lane_width,
+            'in_elems': in_map.elems,
+            'out_elems': out_map.elems,
+        }
+        return files, metadata
+
+    def _write_binder(self, metadata: dict):
+        super()._write_binder(metadata)
+        # GHDL-synthesize the VHDL to Verilog before the Verilator step
+        bdir = self.path / 'binder'
+        top = f'{self.name}_wrapper'
+        makefile = f"""TOP = {top}
+VERILATOR ?= verilator
+VERILATOR_ROOT ?= $(shell $(VERILATOR) --getenv VERILATOR_ROOT)
+GHDL ?= ghdl
+CXX ?= g++
+SO = lib$(TOP).so
+
+all: $(SO)
+
+$(TOP).v: ../src/*.vhd
+\t$(GHDL) -a --std=08 ../src/da4ml_util.vhd
+\t$(GHDL) -a --std=08 $(filter-out ../src/da4ml_util.vhd,$(wildcard ../src/*.vhd))
+\t$(GHDL) synth --std=08 --out=verilog $(TOP) > $(TOP).v
+
+obj_dir/V$(TOP)__ALL.a: $(TOP).v
+\t$(VERILATOR) --cc $(TOP).v --Mdir obj_dir --build -j 0 -O3 --top-module $(TOP)
+
+$(SO): binder.cc obj_dir/V$(TOP)__ALL.a
+\t$(CXX) -O2 -fPIC -shared -fopenmp -std=c++17 -Iobj_dir -I$(VERILATOR_ROOT)/include \\
+\t  binder.cc obj_dir/V$(TOP)__ALL.a \\
+\t  $(VERILATOR_ROOT)/include/verilated.cpp $(VERILATOR_ROOT)/include/verilated_threads.cpp \\
+\t  -o $(SO)
+
+clean:
+\trm -rf obj_dir $(SO) $(TOP).v work-obj08.cf
+"""
+        (bdir / 'Makefile').write_text(makefile)
+
+    @staticmethod
+    def emulation_available() -> bool:
+        return shutil.which('verilator') is not None and shutil.which('ghdl') is not None

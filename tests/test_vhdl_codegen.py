@@ -1,0 +1,71 @@
+"""VHDL codegen oracle chain: emitted VHDL netlists, executed by the bundled
+VHDL netlist simulator, must agree exactly with the DAIS interpreter —
+the GHDL-flavored twin of test_rtl_codegen.py.
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.codegen import VHDLModel
+from da4ml_tpu.codegen.rtl.vhdl.netlist_sim import simulate_comb_vhdl
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+from test_trace_ops import CASES, N
+
+
+def _trace(op_sym, seed=42):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 2, N)
+    i = rng.integers(-2, 5, N)
+    f = np.maximum(rng.integers(-2, 5, N), 1 - k - i)
+    inp = FixedVariableArrayInput(N, hwconf=HWConfig(1, -1, -1))
+    return comb_trace(inp, op_sym(inp.quantize(k, i, f)))
+
+
+DATA = np.random.default_rng(3).uniform(-8, 8, (64, N))
+
+
+@pytest.mark.parametrize('name', sorted(CASES))
+def test_vhdl_netlist_exact(name):
+    comb = _trace(CASES[name][0])
+    np.testing.assert_array_equal(simulate_comb_vhdl(comb, data=DATA), comb.predict(DATA, backend='numpy'))
+
+
+def test_vhdl_lookup():
+    comb = _trace(lambda x: np.sin(x).quantize(np.ones(N), np.ones(N), np.full(N, 4)))
+    np.testing.assert_array_equal(simulate_comb_vhdl(comb, data=DATA), comb.predict(DATA, backend='numpy'))
+
+
+def test_vhdl_solver_pipeline():
+    from da4ml_tpu.cmvm import solve
+    from da4ml_tpu.ir import QInterval
+
+    rng = np.random.default_rng(7)
+    kernel = rng.integers(-8, 8, (10, 6)).astype(np.float64)
+    sol = solve(kernel, qintervals=[QInterval(-8, 7, 1)] * 10)
+    x = rng.integers(-8, 8, (64, 10)).astype(np.float64)
+    cur = x
+    for si, stage in enumerate(sol.stages):
+        ref = stage.predict(cur, backend='numpy')
+        np.testing.assert_array_equal(simulate_comb_vhdl(stage, name=f's{si}', data=cur), ref)
+        cur = ref
+    np.testing.assert_array_equal(cur, x @ kernel)
+
+
+def test_vhdl_project_write(tmp_path):
+    comb = _trace(CASES['matmul_int'][0])
+    pipe = to_pipeline(comb, 2.0)
+    model = VHDLModel(pipe, 'vh', tmp_path).write()
+    src = tmp_path / 'src'
+    assert (src / 'vh.vhd').exists()
+    assert (src / 'vh_wrapper.vhd').exists()
+    assert (src / 'da4ml_util.vhd').exists()
+    assert (src / 'shift_adder.vhd').exists()
+    assert 'ghdl' in (tmp_path / 'binder' / 'Makefile').read_text().lower()
+    np.testing.assert_array_equal(model.predict(DATA, backend='interp'), comb.predict(DATA, backend='numpy'))
+
+
+@pytest.mark.skipif(not VHDLModel.emulation_available(), reason='verilator/ghdl not installed')
+def test_vhdl_ghdl_emulation(tmp_path):
+    comb = _trace(CASES['matmul_int'][0])
+    model = VHDLModel(to_pipeline(comb, 2.0), 'vh', tmp_path).write().compile()
+    np.testing.assert_array_equal(model.predict(DATA, backend='emu'), comb.predict(DATA, backend='numpy'))
