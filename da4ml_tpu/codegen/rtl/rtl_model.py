@@ -72,6 +72,9 @@ class RTLModel:
     """Write, build and drive one RTL project for a DAIS program."""
 
     flavor = 'verilog'
+    # HDL name of the wrapper's output port ('out' is reserved in VHDL, so
+    # the VHDL flavor renames it; the binder must address the same name).
+    _hdl_out_port = 'out'
 
     def __init__(
         self,
@@ -269,6 +272,7 @@ static void run_chunk(const int64_t* in, int64_t* out, long n) {{
     VerilatedContext ctx;
     V{top} top{{&ctx}};
 """
+        outp = self._hdl_out_port
         if clocked:
             binder += f"""    long total = n + LAT;
     for (long t = 0; t < total; ++t) {{
@@ -279,19 +283,19 @@ static void run_chunk(const int64_t* in, int64_t* out, long n) {{
         if (t >= LAT) {{
             long s = t - LAT;
             for (int e = 0; e < N_OUT; ++e)
-                out[s * N_OUT + e] = sext(get_bits(top.out, e * LW_OUT, OUT_W[e] ? OUT_W[e] : 1), OUT_W[e], OUT_S[e]);
+                out[s * N_OUT + e] = sext(get_bits(top.{outp}, e * LW_OUT, OUT_W[e] ? OUT_W[e] : 1), OUT_W[e], OUT_S[e]);
         }}
         top.clk = 1; top.eval();
     }}
 """
         else:
-            binder += """    for (long s = 0; s < n; ++s) {
+            binder += f"""    for (long s = 0; s < n; ++s) {{
         for (int e = 0; e < N_IN; ++e)
             set_bits(top.inp, e * LW_IN, IN_W[e] ? IN_W[e] : 1, uint64_t(in[s * N_IN + e]));
         top.eval();
         for (int e = 0; e < N_OUT; ++e)
-            out[s * N_OUT + e] = sext(get_bits(top.out, e * LW_OUT, OUT_W[e] ? OUT_W[e] : 1), OUT_W[e], OUT_S[e]);
-    }
+            out[s * N_OUT + e] = sext(get_bits(top.{outp}, e * LW_OUT, OUT_W[e] ? OUT_W[e] : 1), OUT_W[e], OUT_S[e]);
+    }}
 """
         binder += """}
 
@@ -451,6 +455,7 @@ class VHDLModel(RTLModel):
     """
 
     flavor = 'vhdl'
+    _hdl_out_port = 'out_port'
 
     def _emit(self):
         from .vhdl.comb import VHDLCombEmitter
@@ -505,18 +510,25 @@ class VHDLModel(RTLModel):
         # GHDL-synthesize the VHDL to Verilog before the Verilator step
         bdir = self.path / 'binder'
         top = f'{self.name}_wrapper'
+        # GHDL analyzes in command-line order: util + primitives first, then
+        # stages (instantiated by the top), then the top, then the wrapper.
+        srcs = ['da4ml_util.vhd'] + [p for p in VHDL_PRIMITIVES if p != 'da4ml_util.vhd']
+        if self.is_pipeline:
+            srcs += [f'{self.name}_s{si}.vhd' for si in range(len(self.solution.stages))]
+        srcs += [f'{self.name}.vhd', f'{self.name}_wrapper.vhd']
+        src_list = ' '.join(f'../src/{s}' for s in srcs)
         makefile = f"""TOP = {top}
 VERILATOR ?= verilator
 VERILATOR_ROOT ?= $(shell $(VERILATOR) --getenv VERILATOR_ROOT)
 GHDL ?= ghdl
 CXX ?= g++
 SO = lib$(TOP).so
+SRCS = {src_list}
 
 all: $(SO)
 
-$(TOP).v: ../src/*.vhd
-\t$(GHDL) -a --std=08 ../src/da4ml_util.vhd
-\t$(GHDL) -a --std=08 $(filter-out ../src/da4ml_util.vhd,$(wildcard ../src/*.vhd))
+$(TOP).v: $(SRCS)
+\t$(GHDL) -a --std=08 $(SRCS)
 \t$(GHDL) synth --std=08 --out=verilog $(TOP) > $(TOP).v
 
 obj_dir/V$(TOP)__ALL.a: $(TOP).v

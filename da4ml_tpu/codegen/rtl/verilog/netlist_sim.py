@@ -253,18 +253,14 @@ class VerilogNetlistSim:
         env[inst.ports['o']] = r & _mask(p['WO'])
 
 
-def simulate_comb(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:
-    """Emit `comb` to Verilog, simulate the netlist over `data`, return floats.
+def run_netlist(em, sim, comb, data: NDArray) -> NDArray[np.float64]:
+    """Pack samples into wrapper bit lanes, run `sim`, descale the outputs.
 
-    The returned values are descaled with the same output interpretation as
-    ``CombLogic.predict``, so results are directly comparable.
+    Shared by the Verilog and VHDL flavors; the returned values use the same
+    output interpretation as ``CombLogic.predict``, so results are directly
+    comparable.
     """
     from ....ir.types import minimal_kif
-    from .comb import VerilogCombEmitter
-
-    em = VerilogCombEmitter(comb, name)
-    text = em.emit()
-    sim = VerilogNetlistSim(text, em.mem_files)
 
     data = np.asarray(data, dtype=np.float64)
     in_lay = em.input_layout()
@@ -289,3 +285,12 @@ def simulate_comb(comb, name: str = 'sim', data: NDArray | None = None) -> NDArr
             raw = (out_bits >> off) & _mask(w)
             out[s, e] = float(_sext(raw, w) if k else raw) * 2.0**-f
     return out
+
+
+def simulate_comb(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:
+    """Emit `comb` to Verilog, simulate the netlist over `data`, return floats."""
+    from .comb import VerilogCombEmitter
+
+    em = VerilogCombEmitter(comb, name)
+    sim = VerilogNetlistSim(em.emit(), em.mem_files)
+    return run_netlist(em, sim, comb, data)

@@ -182,7 +182,7 @@ class VHDLCombEmitter(VerilogCombEmitter):
             off, w = out_lay[j]
             if w == 0:
                 continue
-            sl = f'out({off + w - 1} downto {off})'
+            sl = f'out_port({off + w - 1} downto {off})'
             if idx < 0 or self.widths[idx] == 0:
                 self._stmts.append(f"    {sl} <= (others => '0');")
                 continue
@@ -217,6 +217,4 @@ class VHDLCombEmitter(VerilogCombEmitter):
             '',
             f'architecture rtl of {self.name} is',
         ]
-        # 'out' is reserved in VHDL; rename port, alias internally
-        stmts = [s.replace('out(', 'out_port(') for s in self._stmts]
-        return '\n'.join(header + self._decls + ['begin'] + stmts + ['end architecture;']) + '\n'
+        return '\n'.join(header + self._decls + ['begin'] + self._stmts + ['end architecture;']) + '\n'

@@ -112,33 +112,9 @@ class VHDLNetlistSim(VerilogNetlistSim):
 
 def simulate_comb_vhdl(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:
     """Emit `comb` to VHDL, simulate the netlist over `data`, return floats."""
-    from ....ir.types import minimal_kif
+    from ..verilog.netlist_sim import run_netlist
     from .comb import VHDLCombEmitter
 
     em = VHDLCombEmitter(comb, name)
-    text = em.emit()
-    sim = VHDLNetlistSim(text, em.mem_files)
-
-    data = np.asarray(data, dtype=np.float64)
-    in_lay = em.input_layout()
-    out_lay = em.output_layout()
-    inp_kifs = [minimal_kif(q) for q in comb.inp_qint]
-    out_kifs = [minimal_kif(q) for q in comb.out_qint]
-
-    out = np.zeros((len(data), comb.shape[1]), dtype=np.float64)
-    for s, row in enumerate(data):
-        bits = 0
-        for e, (off, w) in enumerate(in_lay):
-            if w == 0:
-                continue
-            k, i, f = inp_kifs[e]
-            v = int(np.floor(row[e] * 2.0 ** (f + int(comb.inp_shifts[e]))))
-            bits |= (v & _mask(w)) << off
-        out_bits = sim.run_sample(bits)
-        for e, (off, w) in enumerate(out_lay):
-            if w == 0:
-                continue
-            k, i, f = out_kifs[e]
-            raw = (out_bits >> off) & _mask(w)
-            out[s, e] = float(_sext(raw, w) if k else raw) * 2.0**-f
-    return out
+    sim = VHDLNetlistSim(em.emit(), em.mem_files)
+    return run_netlist(em, sim, comb, data)

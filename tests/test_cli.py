@@ -1,0 +1,197 @@
+"""CLI tests: convert on saved IR, report on canned vendor report fixtures."""
+
+import json
+
+import numpy as np
+import pytest
+
+from da4ml_tpu._cli import main
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+VIVADO_TIMING = """\
+------------------------------------------------------------------------------------------------
+| Design Timing Summary
+| ---------------------
+------------------------------------------------------------------------------------------------
+
+    WNS(ns)      TNS(ns)  TNS Failing Endpoints  TNS Total Endpoints
+    -------      -------  ---------------------  -------------------
+      0.237        0.000                      0                 1924
+"""
+
+VIVADO_UTIL = """\
+| DSPs                   |    2 |     0 |          0 |     12288 |  0.02 |
+| LUT as Logic           | 1234 |     0 |          0 |   1728000 |  0.07 |
+| LUT as Memory          |   10 |     0 |          0 |    791040 |  0.00 |
+| CLB Registers          |  567 |     0 |          0 |   3456000 |  0.02 |
+| CARRY8                 |   89 |     0 |          0 |    216000 |  0.04 |
+| Register as Flip Flop  |  567 |     0 |          0 |   3456000 |  0.02 |
+| Register as Latch      |    0 |     0 |          0 |   3456000 |  0.00 |
+| RAMB18                 |    0 |     0 |          0 |      5376 |  0.00 |
+| URAM                   |    0 |     0 |          0 |      1280 |  0.00 |
+| Block RAM Tile         |    0 |     0 |          0 |      2688 |  0.00 |
+"""
+
+VIVADO_POWER = """\
+| Total On-Chip Power (W)  | 1.234        |
+| Dynamic (W)              | 0.900        |
+| Device Static (W)        | 0.334        |
+"""
+
+QUARTUS_STA = """\
+; Fmax Summary ;
++-----------+-----------------+------------+------+
+; 312.5 MHz ; 300.0 MHz       ; clk        ;      ;
++-----------+-----------------+------------+------+
+
++----------------------------------------------------------+
+; Setup Summary                                            ;
++------------+--------+---------------+---------------------+
+; Clock      ; Slack  ; End Point TNS ; Failing Endpoints   ;
++------------+--------+---------------+---------------------+
+; clk        ; 0.800  ; 0.000         ; 0                   ;
++------------+--------+---------------+---------------------+
+
++----------------------------------------------------------+
+; Hold Summary                                             ;
++------------+--------+---------------+---------------------+
+; Clock      ; Slack  ; End Point TNS ; Failing Endpoints   ;
++------------+--------+---------------+---------------------+
+; clk        ; 0.123  ; 0.000         ; 0                   ;
++------------+--------+---------------+---------------------+
+"""
+
+QUARTUS_FIT = """\
+; Logic utilization (in ALMs)           ; 1,024 / 933,120    ;
+; Total dedicated logic registers       ; 2,048              ;
+; Total block memory bits               ; 0 / 240,046,080    ;
+; Total RAM Blocks                      ; 0 / 11,721         ;
+; Total DSP Blocks                      ; 1 / 5,760          ;
+; Combinational ALUT usage for logic    ; 1,500              ;
+; Dedicated logic registers             ; 2,048              ;
+"""
+
+VITIS_CSYNTH = """\
+<?xml version="1.0"?>
+<profile>
+  <PerformanceEstimates>
+    <SummaryOfOverallLatency>
+      <Best-caseLatency>3</Best-caseLatency>
+      <Average-caseLatency>3</Average-caseLatency>
+      <Worst-caseLatency>3</Worst-caseLatency>
+    </SummaryOfOverallLatency>
+  </PerformanceEstimates>
+</profile>
+"""
+
+
+def _make_comb():
+    rng = np.random.default_rng(7)
+    inp = FixedVariableArrayInput(6, HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 3), np.full(6, 2))
+    w = rng.integers(-8, 8, (6, 4)).astype(np.float64)
+    return comb_trace(inp, (x @ w).relu(i=np.full(4, 6), f=np.full(4, 2)))
+
+
+@pytest.mark.parametrize('flavor', ['verilog', 'vhdl', 'vitis'])
+def test_convert_from_json(tmp_path, flavor):
+    comb = _make_comb()
+    model_json = tmp_path / 'comb.json'
+    comb.save(model_json)
+    outdir = tmp_path / f'prj_{flavor}'
+    rc = main(
+        ['convert', str(model_json), str(outdir), '--flavor', flavor, '-n', '64', '-lc', '3', '--validate-rtl', '-v', '0']
+    )
+    assert rc == 0
+    assert (outdir / 'metadata.json').exists()
+    meta = json.loads((outdir / 'metadata.json').read_text())
+    assert meta['flavor'] == flavor
+    assert meta['pipelined']
+
+
+def test_convert_comb_no_pipeline(tmp_path):
+    comb = _make_comb()
+    model_json = tmp_path / 'comb.json'
+    comb.save(model_json)
+    outdir = tmp_path / 'prj'
+    rc = main(['convert', str(model_json), str(outdir), '-lc', '-1', '-n', '32', '--validate-rtl', '-v', '0'])
+    assert rc == 0
+    assert not json.loads((outdir / 'metadata.json').read_text())['pipelined']
+
+
+def _fake_project(tmp_path, name, kind):
+    d = tmp_path / name
+    d.mkdir()
+    (d / 'metadata.json').write_text(
+        json.dumps({'name': 'model', 'flavor': 'verilog', 'cost': 100.0, 'latency_ticks': 4, 'clock_period': 5.0})
+    )
+    if kind == 'vivado':
+        (d / 'timing_summary.rpt').write_text(VIVADO_TIMING)
+        (d / 'utilization.rpt').write_text(VIVADO_UTIL)
+        (d / 'power.rpt').write_text(VIVADO_POWER)
+    elif kind == 'quartus':
+        (d / 'model.sta.rpt').write_text(QUARTUS_STA)
+        (d / 'model.fit.rpt').write_text(QUARTUS_FIT)
+    elif kind == 'vitis':
+        (d / 'csynth.xml').write_text(VITIS_CSYNTH)
+    return d
+
+
+def test_report_vivado(tmp_path):
+    from da4ml_tpu._cli.report import load_project
+
+    d = _fake_project(tmp_path, 'prj-bits=6-lc=2.5', 'vivado')
+    res = load_project(d)
+    assert res['WNS(ns)'] == 0.237
+    assert res['LUT'] == 1244
+    assert res['FF'] == 567
+    assert res['DSP'] == 2
+    assert res['Total On-Chip Power (W)'] == '1.234'
+    assert abs(res['actual_period'] - (5.0 - 0.237)) < 1e-9
+    assert abs(res['Fmax(MHz)'] - 1000.0 / (5.0 - 0.237)) < 1e-9
+    assert abs(res['latency(ns)'] - 4 * (5.0 - 0.237)) < 1e-9
+
+
+def test_report_quartus(tmp_path):
+    from da4ml_tpu._cli.report import load_project
+
+    d = _fake_project(tmp_path, 'q', 'quartus')
+    res = load_project(d)
+    assert res['Fmax(MHz)'] == 312.5
+    assert res['Setup Slack'] == 0.8
+    assert res['Hold Slack'] == 0.123
+    assert res['Setup Failing Endpoints'] == 0
+    assert res['ALM'] == 1024
+    assert res['LUT'] == 1500
+    assert res['FF'] == 2048
+    assert res['DSP'] == 1
+
+
+def test_report_vitis(tmp_path):
+    from da4ml_tpu._cli.report import load_project
+
+    d = _fake_project(tmp_path, 'v', 'vitis')
+    assert load_project(d)['latency'] == 3
+
+
+@pytest.mark.parametrize('ext', ['json', 'csv', 'tsv', 'md', 'html'])
+def test_report_outputs(tmp_path, ext, capsys):
+    d1 = _fake_project(tmp_path, 'a-bits=4', 'vivado')
+    d2 = _fake_project(tmp_path, 'b-bits=8', 'quartus')
+    out = tmp_path / f'out.{ext}'
+    rc = main(['report', str(d1), str(d2), '-o', str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert text
+    if ext == 'json':
+        vals = json.loads(text)
+        assert len(vals) == 2
+        assert {v['bits'] for v in vals} == {4, 8}
+
+
+def test_report_stdout(tmp_path, capsys):
+    d1 = _fake_project(tmp_path, 'a', 'vivado')
+    rc = main(['report', str(d1), '--full'])
+    assert rc == 0
+    cap = capsys.readouterr().out
+    assert 'LUT' in cap and 'cost' in cap

@@ -1,0 +1,52 @@
+"""Converter plugin system tests (parity: reference tests/test_plugin.py)."""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.converter import get_available_plugins, register_plugin, trace_model
+from da4ml_tpu.converter.example import ExampleModel, ExampleTracer, operation
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+
+@pytest.mark.parametrize('inputs_defined', [True, False])
+def test_example_plugin(inputs_defined):
+    model = ExampleModel(input_shape=(4, 5) if not inputs_defined else None)
+
+    if inputs_defined:
+        inputs = FixedVariableArrayInput((4, 5), HWConfig(1, -1, -1))
+        inp, out = trace_model(model, inputs=inputs)
+    else:
+        inp, out = trace_model(model)
+
+    comb = comb_trace(inp, out)
+
+    rng = np.random.default_rng(42)
+    data = rng.uniform(-128, 128, (1000, 4, 5))
+    golden = np.array([operation(x).ravel() for x in data])
+    pred = comb.predict(data.reshape(1000, -1), backend='numpy')
+    np.testing.assert_array_equal(pred, golden)
+
+
+def test_plugin_shape_inference_failure():
+    model = ExampleModel(input_shape=None)
+    with pytest.raises(ValueError, match='cannot determine input shapes'):
+        trace_model(model)
+
+
+def test_unknown_framework():
+    with pytest.raises(ValueError, match='No plugin found'):
+        trace_model(object())
+
+
+def test_register_plugin():
+    class Dummy:
+        pass
+
+    register_plugin('dummyfw', ExampleTracer)
+    try:
+        assert 'dummyfw' in get_available_plugins()
+        model = ExampleModel(input_shape=(4, 5))
+        inp, out = trace_model(model, framework='dummyfw')
+        assert inp.size == 20
+    finally:
+        get_available_plugins()  # registry is module state; leave the entry in place
