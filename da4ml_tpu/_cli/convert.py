@@ -22,8 +22,8 @@ def _load_solution(path: Path):
 
     blob = json.loads(Path(path).read_text())
     if isinstance(blob, dict) and 'stages' in blob:
-        return Pipeline.load(path)
-    return CombLogic.load(path)
+        return Pipeline.from_dict(blob)
+    return CombLogic.from_dict(blob)
 
 
 def _emulate(da_model, flavor: str, data: np.ndarray) -> np.ndarray:

@@ -282,14 +282,10 @@ def write_output(vals: list[dict[str, Any]], arr: list[list], output: str):
         if ext == '.json':
             json.dump(vals, f, indent=2)
         elif ext in ('.csv', '.tsv'):
-            sep = ',' if ext == '.csv' else '\t'
+            import csv
 
-            def esc(x: Any) -> str:
-                s = str(x)
-                return f'"{s}"' if sep in s else s
-
-            for row in arr:
-                f.write(sep.join(esc(x) for x in row) + '\n')
+            writer = csv.writer(f, delimiter=',' if ext == '.csv' else '\t')
+            writer.writerows(arr)
         elif ext == '.md':
             f.write('| ' + ' | '.join(map(str, arr[0])) + ' |\n')
             f.write('|' + '|'.join(['---'] * len(arr[0])) + '|\n')
@@ -321,7 +317,18 @@ def report_main(args: argparse.Namespace) -> int:
         return 1
 
     key = args.sort_by
-    vals.sort(key=lambda d: (d.get(key) is None, d.get(key, 0)))
+    # values for a key may differ in type across projects (e.g. filename tags
+    # parsed as int for one dir, left as str for another) — sort numerics
+    # first, then everything else by string form, so mixed types never raise
+    def _sort_key(d: dict):
+        v = d.get(key)
+        if v is None:
+            return (2, 0.0, '')
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return (0, float(v), '')
+        return (1, 0.0, str(v))
+
+    vals.sort(key=_sort_key)
     arr = _table(vals)
 
     if args.output == 'stdout':

@@ -88,7 +88,7 @@ class _KernelSpec:
     P: int  # total slots (inputs + max CSE intermediates)
     O: int  # outputs
     B: int  # CSD bit planes
-    n_iters: int  # max CSE iterations (P - n_in_max)
+    n_iters: int  # max CSE iterations this call may add
     adder_size: int
     carry_size: int
 
@@ -98,53 +98,46 @@ def _build_cse_fn(spec: _KernelSpec):
     """Build the vmapped+jitted greedy-CSE device function for a shape class.
 
     Lane inputs:  E0 [P,O,B] int8, qmeta0 [P,3] f32 (lo,hi,step), lat0 [P] f32,
-                  method [] int32
-    Lane outputs: E_final, op records [n_iters x (id0,id1,sub,shift)] int32,
-                  op qints [n_iters,3] f32, op lat/cost [n_iters] f32,
-                  n_added [] int32
+                  cur0 [] int32 (next free slot; resumable), method [] int32
+    Lane outputs: E_final, qmeta/lat final, op records
+                  [n_iters x (id0,id1,sub,shift)] int32, cur final [] int32.
+
+    The function is *resumable*: a lane capped at ``cur == P`` can be re-entered
+    with its final state padded into a larger P — early greedy iterations run
+    on small candidate tensors (cost is O(P^2) per iteration) and only the
+    stragglers pay for large ones.
     """
     P, O, B, n_iters = spec.P, spec.O, spec.B, spec.n_iters
     adder_size, carry_size = spec.adder_size, spec.carry_size
-    rank_max = (P * P * 2 + 1) * (2 * B + 1) + 2 * B
-    if rank_max >= 2**31:
-        raise ValueError(
-            f'Problem too large for the device search (P={P}, B={B} overflows the int32 tie rank); use backend="cpu".'
-        )
 
     def pair_counts(E):
-        """C_same/C_diff [S=B, P, P]: matches of row-i bit b with row-j bit b+s."""
-        Ep = (E > 0).astype(jnp.bfloat16)
-        Em = (E < 0).astype(jnp.bfloat16)
+        """C_same/C_diff [S=B, P, P]: matches of row-i bit b with row-j bit b+s.
+
+        Two MXU einsums via the identity same = (|a||b| + ab)/2,
+        diff = (|a||b| - ab)/2 over digits in {-1, 0, +1}.
+        """
+        Ef = E.astype(jnp.bfloat16)
         # shifted stacks: sh[s, p, o, b] = X[p, o, b + s] (zero beyond B)
-        pad = jnp.pad(E, ((0, 0), (0, 0), (0, B)))
+        pad = jnp.pad(Ef, ((0, 0), (0, 0), (0, B)))
         idx = jnp.arange(B)[:, None] + jnp.arange(B)[None, :]  # [s, b] -> b+s
         sh = pad[:, :, idx]  # [P, O, S, B]
-        shp = (sh > 0).astype(jnp.bfloat16)
-        shm = (sh < 0).astype(jnp.bfloat16)
-        C_same = jnp.einsum('iob,josb->sij', Ep, shp, preferred_element_type=jnp.float32) + jnp.einsum(
-            'iob,josb->sij', Em, shm, preferred_element_type=jnp.float32
-        )
-        C_diff = jnp.einsum('iob,josb->sij', Ep, shm, preferred_element_type=jnp.float32) + jnp.einsum(
-            'iob,josb->sij', Em, shp, preferred_element_type=jnp.float32
-        )
-        return C_same.astype(jnp.int32), C_diff.astype(jnp.int32)
+        A = jnp.einsum('iob,josb->sij', Ef, sh, preferred_element_type=jnp.float32)
+        D = jnp.einsum('iob,josb->sij', jnp.abs(Ef), jnp.abs(sh), preferred_element_type=jnp.float32)
+        return (D + A) * 0.5, (D - A) * 0.5
 
-    sub_np = np.arange(2, dtype=np.int64)[:, None, None, None]
     s_np = np.arange(B, dtype=np.int64)[None, :, None, None]
     i_np = np.arange(P, dtype=np.int64)[None, None, :, None]
     j_np = np.arange(P, dtype=np.int64)[None, None, None, :]
-    # Tie rank (host scan order, heuristics.py): largest (id1, id0, sub, shift)
-    # wins among equal scores. Pure function of the static axes -> constant.
-    _c0 = np.minimum(i_np, j_np)
-    _c1 = np.maximum(i_np, j_np)
-    _cs = np.where(i_np < j_np, s_np, -s_np)
-    RANK = jnp.asarray((((_c1 * P + _c0) * 2 + sub_np) * (2 * B + 1) + (_cs + B)).astype(np.int32))
     S0_MASK = jnp.asarray((s_np > 0) | (i_np < j_np))
 
     def select_pair(C, qmeta, lat, method):
-        """Masked scoring + argmax over the [2, S, P, P] candidate tensor."""
-        count = C.astype(jnp.float32)
-        valid = C >= 2
+        """Masked scoring + single-pass argmax over the [2, S, P, P] tensor.
+
+        Ties resolve by first flattened index — deterministic, though not the
+        host's scan order (the contract is exactness at comparable cost).
+        """
+        count = C
+        valid = C >= 2.0
         # s == 0: only i < j (i == j is self-pairing; i > j duplicates i < j)
         valid &= S0_MASK
 
@@ -173,10 +166,8 @@ def _build_cse_fn(spec: _KernelSpec):
         absolute = (method == 1) | (method == 3) | (method == 4)
         valid &= jnp.where(absolute, score >= 0, True)
         score = jnp.where(valid, score, -jnp.inf)
-        best = jnp.max(score)
-        rank = jnp.where(score == best, RANK, -1)
-        flat = jnp.argmax(rank)
-        any_valid = jnp.any(valid)
+        flat = jnp.argmax(score)
+        any_valid = jnp.max(score) != -jnp.inf
         sub, rem = jnp.divmod(flat, B * P * P)
         s, rem = jnp.divmod(rem, P * P)
         i, j = jnp.divmod(rem, P)
@@ -233,7 +224,7 @@ def _build_cse_fn(spec: _KernelSpec):
         new_row = jnp.where(i < j, anchor_lo, anchor_hi).astype(jnp.int8)
         return E, new_row, M.sum()
 
-    def lane_fn(E0, qmeta0, lat0, method):
+    def lane_fn(E0, qmeta0, lat0, cur0, method):
         op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
 
         def cond(state):
@@ -266,7 +257,7 @@ def _build_cse_fn(spec: _KernelSpec):
                 max1 = jnp.where(is_sub, -lo1, hi1) * sp
                 qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
                 lat = lat.at[cur].set(nlat)
-                op_rec = op_rec.at[cur - (P - n_iters)].set(jnp.stack([id0, id1, sub, shift]))
+                op_rec = op_rec.at[cur - cur0].set(jnp.stack([id0, id1, sub, shift]))
                 return E2, qmeta, lat, cur + 1, op_rec
 
             def no_update(args):
@@ -276,10 +267,9 @@ def _build_cse_fn(spec: _KernelSpec):
             E, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
             return E, qmeta, lat, cur, op_rec, any_valid
 
-        cur0 = jnp.int32(P - n_iters)
         state = (E0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
         E, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
-        return E, op_rec, cur - (P - n_iters)
+        return E, qmeta, lat, op_rec, cur
 
     return jax.jit(jax.vmap(lane_fn))
 
@@ -313,23 +303,39 @@ def _lane_initial_digits(lane: _Lane) -> int:
     return int((lane.csd != 0).sum())
 
 
+def _bucket_lanes(n: int, mesh) -> int:
+    """Pad the lane axis to a power-of-two (mesh-divisible) bucket so repeated
+    calls with nearby batch sizes reuse the compiled program."""
+    bucket = 1 << (max(n, 1) - 1).bit_length()
+    if mesh is not None:
+        nd = mesh.devices.size
+        bucket = max(bucket, nd)
+        bucket = ((bucket + nd - 1) // nd) * nd
+    return bucket
+
+
+def _as_comb(sol) -> CombLogic:
+    """Materialize a solution handle (native RawComb or CombLogic)."""
+    return sol if isinstance(sol, CombLogic) else sol.to_comb()
+
+
 def solve_single_lanes(
     lanes: list[_Lane],
     adder_size: int,
     carry_size: int,
-    max_iters: int | None = None,
     mesh=None,
-    _budget_level: int = 0,
+    step: int | None = None,
+    raw: bool = False,
 ) -> list[CombLogic]:
     """Solve a batch of independent CMVM instances on device, emit on host.
 
-    Runs with a tight iteration budget first (smaller P -> quadratically
-    cheaper selection tensors); lanes that exhaust a budget escalate through
-    digits//4 -> digits//2 -> digits (the true worst case: every substitution
-    removes at least one digit net), so quality never degrades.
+    The greedy search runs in *stages* of ``step`` iterations: per-iteration
+    selection cost is O(P^2) in the slot count P, so early iterations run with
+    small tensors and each stage re-enters the device function (state is
+    resumable) with P grown by ``step`` for only the lanes that are still
+    active — stragglers pay for large candidate tensors, finished lanes drop
+    out (compaction).
     """
-    _BUDGET_DENOMS = (4, 2, 1)
-
     for lane in lanes:
         if lane.csd is None:
             _prepare_lane(lane)
@@ -347,89 +353,143 @@ def solve_single_lanes(
         O = max(lanes[k].csd.shape[1] for k in active)
         B = max(lanes[k].csd.shape[2] for k in active)
         digits_max = max(_lane_initial_digits(lanes[k]) for k in active)
-        full_iters = max(digits_max, 1)
-        denom = _BUDGET_DENOMS[min(_budget_level, len(_BUDGET_DENOMS) - 1)]
-        n_iters = min(max(digits_max // denom, 16), full_iters)
-        if max_iters is not None:
-            n_iters = min(n_iters, max_iters)
-        P = n_in_max + n_iters
+        if step is None:
+            step = max(16, -(-digits_max // 8))
 
-        E0 = np.zeros((len(active), P, O, B), dtype=np.int8)
-        qmeta0 = np.zeros((len(active), P, 3), dtype=np.float32)
-        lat0 = np.zeros((len(active), P), dtype=np.float32)
-        mcodes = np.zeros((len(active),), dtype=np.int32)
+        n_act = len(active)
+        st_E: dict[int, NDArray] = {}  # final digit tensors, filled as lanes finish
+        st_cur = np.full((n_act,), n_in_max, dtype=np.int32)
+        mcodes = np.zeros((n_act,), dtype=np.int32)
+        recs: list[list[NDArray]] = [[] for _ in range(n_act)]
+
+        # initial host-side state upload (once — between stages the search
+        # state stays device-resident; only decisions and finished lanes'
+        # digit tensors come back to host)
+        Eb = np.zeros((n_act, n_in_max, O, B), dtype=np.int8)
+        qb = np.zeros((n_act, n_in_max, 3), dtype=np.float32)
+        qb[:, :, 2] = 1.0  # benign step for unused slots
+        lb = np.zeros((n_act, n_in_max), dtype=np.float32)
         for a, k in enumerate(active):
             ln = lanes[k]
             ni, no, nb = ln.csd.shape
-            E0[a, :ni, :no, :nb] = ln.csd
+            Eb[a, :ni, :no, :nb] = ln.csd
             for i in range(ni):
                 sf = 2.0 ** float(ln.shift0[i])
                 q = ln.qintervals[i]
-                lo, hi, st = q.min * sf, q.max * sf, q.step * sf
+                lo, hi, stp = q.min * sf, q.max * sf, q.step * sf
                 # all-zero rows carry the lsb sentinel shift (2**127) and/or an
                 # inf step; they are never selected — store benign metadata
-                if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, st)):
-                    lo, hi, st = 0.0, 0.0, 1.0
-                qmeta0[a, i] = (lo, hi, st)
-                lat0[a, i] = ln.latencies[i]
-            qmeta0[a, ni:, 2] = 1.0  # benign step for unused slots
+                if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, stp)):
+                    lo, hi, stp = 0.0, 0.0, 1.0
+                qb[a, i] = (lo, hi, stp)
+                lb[a, i] = ln.latencies[i]
             mcodes[a] = _METHOD_CODES[ln.method]
 
-        # pad the lane axis to a power-of-two bucket so repeated calls with
-        # nearby batch sizes reuse the compiled program (dummy lanes are all
-        # zeros -> no valid pair -> exit on the first iteration)
-        n_lanes = len(active)
-        bucket = 1 << (n_lanes - 1).bit_length()
+        sh = None
         if mesh is not None:
-            nd = mesh.devices.size
-            bucket = max(bucket, nd)
-            bucket = ((bucket + nd - 1) // nd) * nd
-        if bucket > n_lanes:
-            pad = bucket - n_lanes
-            E0 = np.concatenate([E0, np.zeros((pad,) + E0.shape[1:], E0.dtype)])
-            qmeta0 = np.concatenate([qmeta0, np.ones((pad,) + qmeta0.shape[1:], qmeta0.dtype)])
-            lat0 = np.concatenate([lat0, np.zeros((pad,) + lat0.shape[1:], lat0.dtype)])
-            mcodes = np.concatenate([mcodes, np.zeros((pad,), mcodes.dtype)])
-
-        fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size))
-        args = (jnp.asarray(E0), jnp.asarray(qmeta0), jnp.asarray(lat0), jnp.asarray(mcodes))
-        if mesh is not None:
-            # shard the lane axis over the mesh: each device runs its share of
-            # the candidate searches; no cross-device communication is needed
-            # until the host-side argmin
+            # shard the lane axis over the mesh: each device runs its share
+            # of the candidate searches; no cross-device communication is
+            # needed until the host-side argmin
             from ..parallel import batch_sharding
 
             sh = batch_sharding(mesh, mesh.axis_names[0])
-            args = tuple(jax.device_put(a, sh) for a in args)
-        E_f, op_rec, n_added = (np.asarray(jax.device_get(t))[:n_lanes] for t in fn(*args))
 
-        # lanes that exhausted the budget escalate to the next level
-        if max_iters is None and n_iters < full_iters:
-            capped = [k for a, k in enumerate(active) if int(n_added[a]) >= n_iters]
-            if capped:
-                redo = solve_single_lanes(
-                    [lanes[k] for k in capped], adder_size, carry_size, mesh=mesh, _budget_level=_budget_level + 1
-                )
-                for k, sol in zip(capped, redo):
-                    results[k] = sol
+        pend = list(range(n_act))
+        dE = jnp.asarray(Eb)
+        dq = jnp.asarray(qb)
+        dl = jnp.asarray(lb)
+        dc_ = jnp.full((n_act,), n_in_max, dtype=jnp.int32)
+        dm = jnp.asarray(mcodes)
+        while pend:
+            P = int(st_cur[pend].max()) + step
+            n_iters = P - n_in_max
+            n_pend = len(pend)
+            bucket = _bucket_lanes(n_pend, mesh)
+            pad_lane = (0, bucket - dE.shape[0])
+            pad_slot = (0, P - dE.shape[1])
+            dE = jnp.pad(dE, (pad_lane, pad_slot, (0, 0), (0, 0)))
+            dq = jnp.pad(dq, (pad_lane, pad_slot, (0, 0)))
+            dl = jnp.pad(dl, (pad_lane, pad_slot))
+            dc_ = jnp.pad(dc_, pad_lane, constant_values=n_in_max)
+            dm = jnp.pad(dm, pad_lane)
+            args = (dE, dq, dl, dc_, dm)
+            if sh is not None:
+                args = tuple(jax.device_put(a, sh) for a in args)
 
+            fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size))
+            dE, dq, dl, d_rec, dc_ = fn(*args)
+            cur_f = np.asarray(jax.device_get(dc_))[:n_pend]
+            op_rec = np.asarray(jax.device_get(d_rec))[:n_pend]
+
+            fin_pos, cont_pos, next_pend = [], [], []
+            for x, a in enumerate(pend):
+                c0, c1 = int(st_cur[a]), int(cur_f[x])
+                if c1 > c0:
+                    recs[a].append(op_rec[x, : c1 - c0].copy())
+                st_cur[a] = c1
+                if c1 >= P:  # budget exhausted -> resume with a larger P
+                    next_pend.append(a)
+                    cont_pos.append(x)
+                else:
+                    fin_pos.append(x)
+            if fin_pos:
+                E_fin = np.asarray(jax.device_get(jnp.take(dE, jnp.asarray(fin_pos), axis=0)))
+                for y, x in enumerate(fin_pos):
+                    st_E[pend[x]] = E_fin[y]
+            if next_pend:
+                keep = jnp.asarray(cont_pos)
+                dE = jnp.take(dE, keep, axis=0)
+                dq = jnp.take(dq, keep, axis=0)
+                dl = jnp.take(dl, keep, axis=0)
+                dc_ = jnp.take(dc_[:n_pend], keep, axis=0)
+                dm = jnp.take(dm[:n_pend], keep, axis=0)
+            pend = next_pend
+
+        emit_jobs: list[tuple[int, NDArray, NDArray]] = []  # (lane idx, E_lane, rec)
         for a, k in enumerate(active):
-            if k in results:
-                continue
             ln = lanes[k]
             ni, no, nb = ln.csd.shape
-            n_add = int(n_added[a])
+            n_add = int(st_cur[a]) - n_in_max
+            E_f = st_E[a]
             # slots in the device tensor: [0, n_in_max) inputs, [n_in_max, ...) new.
             # remap device slot index -> host op index (inputs of THIS lane first)
-            E_lane = np.concatenate([E_f[a, :ni, :no, :nb], E_f[a, n_in_max : n_in_max + n_add, :no, :nb]], axis=0)
-            rec = op_rec[a, :n_add].copy()
-            remap = lambda idx: idx if idx < ni else idx - (n_in_max - ni)  # noqa: E731
-            rec[:, 0] = [remap(v) for v in rec[:, 0]]
-            rec[:, 1] = [remap(v) for v in rec[:, 1]]
-            state = _host_state_from(ln, rec, E_lane, n_add, adder_size, carry_size)
-            results[k] = to_solution(state, adder_size, carry_size)
+            E_lane = np.concatenate([E_f[:ni, :no, :nb], E_f[n_in_max : n_in_max + n_add, :no, :nb]], axis=0)
+            rec = np.concatenate(recs[a], axis=0) if recs[a] else np.zeros((0, 4), np.int32)
+            shift_down = n_in_max - ni
+            if shift_down:
+                rec = rec.copy()
+                rec[:, 0] = np.where(rec[:, 0] >= ni, rec[:, 0] - shift_down, rec[:, 0])
+                rec[:, 1] = np.where(rec[:, 1] >= ni, rec[:, 1] - shift_down, rec[:, 1])
+            emit_jobs.append((k, E_lane, rec))
+
+        if _native_emit_available():
+            from ..native.bindings import emit_batch
+
+            lane_tuples = []
+            for k, E_lane, rec in emit_jobs:
+                ln = lanes[k]
+                qints = np.asarray([(q.min, q.max, q.step) for q in ln.qintervals], np.float64).reshape(-1, 3)
+                lats = np.asarray(ln.latencies, np.float64)
+                lane_tuples.append((ln.shift0, ln.shift1, qints, lats, E_lane, rec))
+            for (k, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
+                results[k] = sol
+        else:
+            for k, E_lane, rec in emit_jobs:
+                ln = lanes[k]
+                state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size)
+                results[k] = to_solution(state, adder_size, carry_size)
 
     return [results[k] for k in range(len(lanes))]
+
+
+@lru_cache(maxsize=1)
+def _native_emit_available() -> bool:
+    try:
+        from ..native.bindings import has_emit
+
+        return has_emit()
+    except Exception:
+        return False
 
 
 def _host_state_from(ln: _Lane, rec, E_lane, n_add: int, adder_size: int, carry_size: int) -> DAState:
@@ -561,43 +621,54 @@ def solve_jax_many(
             dcs = [dc]
         jobs.extend((mi, dc) for dc in dcs)
 
-    # stage-0 lanes
+    # stage-0 lanes (kernel decomposition batched through the native library
+    # when built — OpenMP over (matrix, dc) lanes)
+    if _native_emit_available():
+        from ..native.bindings import decompose_batch
+
+        splits = decompose_batch([kernels[mi] for mi, _ in jobs], [dc for _, dc in jobs])
+    else:
+        splits = [kernel_decompose(kernels[mi], dc) for mi, dc in jobs]
+
     lanes0: list[_Lane] = []
     mats1: list[NDArray] = []
-    for mi, dc in jobs:
+    for (mi, dc), (mat0, mat1) in zip(jobs, splits):
         kern = kernels[mi]
         qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
         lats = latencies_list[mi] or [0.0] * kern.shape[0]
-        mat0, mat1 = kernel_decompose(kern, dc)
         lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(m0, dc, _hard_eff)))
         mats1.append(mat1)
-    sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh)
+    sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
     lanes1: list[_Lane] = []
     for (mi, dc), sol0, mat1 in zip(jobs, sols0, mats1):
-        qints1, lats1 = _host_api.stage_feed(sol0)
+        qints1, lats1 = sol0.out_qint, sol0.out_latency
         lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(m1, dc, _hard_eff)))
-    sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh)
+    sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
 
-    # candidate filtering (latency budget) + argmin per matrix
+    # candidate filtering (latency budget) + argmin per matrix; only the
+    # winning candidates are materialized into full IR objects
     results: list[Pipeline | None] = [None] * n_mat
     best_cost = [inf] * n_mat
+    best_sols: list[tuple | None] = [None] * n_mat
     for (mi, dc), sol0, sol1 in zip(jobs, sols0, sols1):
-        pipe = Pipeline(stages=(sol0, sol1))
         if hard_dc >= 0:
             kern = kernels[mi]
             qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
             lats = latencies_list[mi] or [0.0] * kern.shape[0]
             min_lat = _host_api.minimal_latency(kern, list(qints), list(lats), carry_size, adder_size)
             allowed = hard_dc + min_lat
-            max_lat = max((lt for s in pipe.stages for lt in s.out_latency), default=0.0)
+            max_lat = max((lt for s in (sol0, sol1) for lt in s.out_latency), default=0.0)
             if max_lat > allowed:
                 continue
-        c = float(sum(op.cost for s in pipe.stages for op in s.ops))
+        c = float(sol0.cost) + float(sol1.cost)
         if c < best_cost[mi]:
             best_cost[mi] = c
-            results[mi] = pipe
+            best_sols[mi] = (sol0, sol1)
+    for mi, pair in enumerate(best_sols):
+        if pair is not None:
+            results[mi] = Pipeline(stages=(_as_comb(pair[0]), _as_comb(pair[1])))
 
     # fallback: no candidate met the latency budget -> host retry logic
     for mi in range(n_mat):

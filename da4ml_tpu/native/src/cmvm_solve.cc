@@ -806,3 +806,166 @@ DA4ML_API int cmvm_stage_fill(void* handle, int64_t stage, double* ops9, int32_t
 }
 
 DA4ML_API void cmvm_free(void* handle) { delete static_cast<da4ml_cmvm::PipeC*>(handle); }
+
+// ---------------------------------------------------- JAX-backend host side
+//
+// The device search (cmvm/jax_search.py) returns per-lane greedy *decisions*
+// (op records) and final CSD digit tensors; rebuilding f64 op metadata and
+// running the adder-tree emission (to_solution) is the host-side tail. These
+// batched entry points run that tail in C++ with OpenMP over lanes.
+
+// geo: n_lanes x 4 int64 = (ni, no, nb, n_add). Flat per-lane data follows
+// the same lane order with implicit prefix offsets:
+//   shift0s: ni int32        shift1s: no int32
+//   qints:   ni x 3 f64      lats:    ni f64
+//   E:       (ni+n_add) x no x nb int8 (digit in {-1,0,+1})
+//   recs:    n_add x 4 int32 = (id0, id1, sub, shift), lane-local ids
+// Returns an opaque std::vector<CombC>* (free with cmvm_emit_free).
+DA4ML_API void* cmvm_emit_batch(int64_t n_lanes, const int64_t* geo, const int32_t* shift0s, const int32_t* shift1s,
+                                const double* qints, const double* lats, const int8_t* E, const int32_t* recs,
+                                int64_t adder_size, int64_t carry_size, int64_t n_threads, char* err, int64_t err_len) {
+    using namespace da4ml_cmvm;
+    try {
+        std::vector<int64_t> off_in(n_lanes + 1, 0), off_out(n_lanes + 1, 0), off_E(n_lanes + 1, 0),
+            off_rec(n_lanes + 1, 0);
+        for (int64_t l = 0; l < n_lanes; ++l) {
+            int64_t ni = geo[l * 4], no = geo[l * 4 + 1], nb = geo[l * 4 + 2], na = geo[l * 4 + 3];
+            off_in[l + 1] = off_in[l] + ni;
+            off_out[l + 1] = off_out[l] + no;
+            off_E[l + 1] = off_E[l] + (ni + na) * no * nb;
+            off_rec[l + 1] = off_rec[l] + na;
+        }
+        auto* out = new std::vector<CombC>(size_t(n_lanes));
+        std::vector<std::string> errors(static_cast<size_t>(n_lanes));
+        int threads = n_threads > 0 ? int(n_threads) : omp_get_max_threads();
+#pragma omp parallel for schedule(dynamic) num_threads(threads)
+        for (int64_t l = 0; l < n_lanes; ++l) {
+            try {
+                int ni = int(geo[l * 4]), no = int(geo[l * 4 + 1]), nb = int(geo[l * 4 + 2]), na = int(geo[l * 4 + 3]);
+                DAStateC st;
+                st.n_in = ni;
+                st.n_out = no;
+                st.n_bits = nb;
+                st.shift0.assign(shift0s + off_in[l], shift0s + off_in[l] + ni);
+                st.shift1.assign(shift1s + off_out[l], shift1s + off_out[l] + no);
+                const double* q = qints + off_in[l] * 3;
+                const double* la = lats + off_in[l];
+                for (int i = 0; i < ni; ++i) {
+                    double sf = std::ldexp(1.0, st.shift0[i]);
+                    st.ops.push_back(
+                        OpC{i, -1, -1, 0, QInt{q[i * 3] * sf, q[i * 3 + 1] * sf, q[i * 3 + 2] * sf}, la[i], 0.0});
+                }
+                const int32_t* r = recs + off_rec[l] * 4;
+                for (int t = 0; t < na; ++t) {
+                    PairC p{r[t * 4], r[t * 4 + 1], r[t * 4 + 2] != 0, r[t * 4 + 3]};
+                    st.ops.push_back(pair_to_op(p, st, int(adder_size), int(carry_size)));
+                }
+                const int8_t* e = E + off_E[l];
+                st.expr.resize(size_t(ni + na));
+                for (int p = 0; p < ni + na; ++p) {
+                    st.expr[p].resize(no);
+                    for (int io = 0; io < no; ++io) {
+                        auto& digits = st.expr[p][io];
+                        for (int b = 0; b < nb; ++b) {
+                            int8_t v = e[(size_t(p) * no + io) * nb + b];
+                            if (v != 0) digits.push_back(encode_digit(b, v));
+                        }
+                    }
+                }
+                (*out)[l] = to_solution(st, int(adder_size), int(carry_size));
+            } catch (const std::exception& ex) {
+                errors[l] = ex.what();
+            }
+        }
+        for (const auto& e : errors)
+            if (!e.empty()) {
+                delete out;
+                copy_err(e, err, err_len);
+                return nullptr;
+            }
+        return out;
+    } catch (const std::exception& e) {
+        copy_err(e.what(), err, err_len);
+        return nullptr;
+    }
+}
+
+DA4ML_API int cmvm_emit_shape(void* handle, int64_t lane, int64_t* n_in, int64_t* n_out, int64_t* n_ops) {
+    if (!handle) return 1;
+    auto& v = *static_cast<std::vector<da4ml_cmvm::CombC>*>(handle);
+    if (lane < 0 || size_t(lane) >= v.size()) return 1;
+    *n_in = v[lane].n_in;
+    *n_out = v[lane].n_out;
+    *n_ops = int64_t(v[lane].ops.size());
+    return 0;
+}
+
+DA4ML_API int cmvm_emit_fill(void* handle, int64_t lane, double* ops9, int32_t* inp_shifts, int32_t* out_idxs,
+                             int32_t* out_shifts, int32_t* out_negs) {
+    if (!handle) return 1;
+    auto& v = *static_cast<std::vector<da4ml_cmvm::CombC>*>(handle);
+    if (lane < 0 || size_t(lane) >= v.size()) return 1;
+    const auto& s = v[lane];
+    for (size_t i = 0; i < s.ops.size(); ++i) {
+        const auto& op = s.ops[i];
+        double* row = ops9 + i * 9;
+        row[0] = op.id0;
+        row[1] = op.id1;
+        row[2] = op.opcode;
+        row[3] = double(op.data);
+        row[4] = op.qint.min;
+        row[5] = op.qint.max;
+        row[6] = op.qint.step;
+        row[7] = op.latency;
+        row[8] = op.cost;
+    }
+    std::copy(s.inp_shifts.begin(), s.inp_shifts.end(), inp_shifts);
+    std::copy(s.out_idxs.begin(), s.out_idxs.end(), out_idxs);
+    std::copy(s.out_shifts.begin(), s.out_shifts.end(), out_shifts);
+    std::copy(s.out_negs.begin(), s.out_negs.end(), out_negs);
+    return 0;
+}
+
+DA4ML_API void cmvm_emit_free(void* handle) { delete static_cast<std::vector<da4ml_cmvm::CombC>*>(handle); }
+
+// Batched kernel decomposition: lane l reads kernels[koff[l] .. koff[l]+ni*no)
+// (row-major ni x no) and writes m0 (ni x no) / m1 (no x no) at the same
+// layout into m0_out/m1_out (caller-allocated, same offsets / no*no offsets).
+DA4ML_API int cmvm_decompose_batch(int64_t n_lanes, const int64_t* geo /* n_lanes x 3: ni,no,dc */,
+                                   const double* kernels, double* m0_out, double* m1_out, int64_t n_threads, char* err,
+                                   int64_t err_len) {
+    using namespace da4ml_cmvm;
+    try {
+        std::vector<int64_t> off_k(n_lanes + 1, 0), off_m1(n_lanes + 1, 0);
+        for (int64_t l = 0; l < n_lanes; ++l) {
+            int64_t ni = geo[l * 3], no = geo[l * 3 + 1];
+            off_k[l + 1] = off_k[l] + ni * no;
+            off_m1[l + 1] = off_m1[l] + no * no;
+        }
+        std::vector<std::string> errors(static_cast<size_t>(n_lanes));
+        int threads = n_threads > 0 ? int(n_threads) : omp_get_max_threads();
+#pragma omp parallel for schedule(dynamic) num_threads(threads)
+        for (int64_t l = 0; l < n_lanes; ++l) {
+            try {
+                int ni = int(geo[l * 3]), no = int(geo[l * 3 + 1]), dc = int(geo[l * 3 + 2]);
+                std::vector<double> k(kernels + off_k[l], kernels + off_k[l + 1]);
+                std::vector<double> m0, m1;
+                int m0_cols = 0;
+                kernel_decompose(k, ni, no, dc, m0, m1, m0_cols);
+                std::copy(m0.begin(), m0.end(), m0_out + off_k[l]);
+                std::copy(m1.begin(), m1.end(), m1_out + off_m1[l]);
+            } catch (const std::exception& ex) {
+                errors[l] = ex.what();
+            }
+        }
+        for (const auto& e : errors)
+            if (!e.empty()) {
+                copy_err(e, err, err_len);
+                return 1;
+            }
+        return 0;
+    } catch (const std::exception& e) {
+        copy_err(e.what(), err, err_len);
+        return 1;
+    }
+}
