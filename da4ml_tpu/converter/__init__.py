@@ -37,6 +37,8 @@ ENTRY_POINT_GROUP = 'da4ml_tpu.plugins'
 # name -> plugin class or 'module:attr' lazy spec
 _REGISTRY: dict[str, Any] = {
     'da4ml_tpu': 'da4ml_tpu.converter.example:ExampleTracer',
+    'keras': 'da4ml_tpu.converter.keras_plugin:KerasTracer',
+    'torch': 'da4ml_tpu.converter.torch_plugin:TorchTracer',
 }
 
 
@@ -77,13 +79,21 @@ def trace_model(
 ):
     """Trace ``model`` into symbolic (inputs, outputs) via its framework plugin.
 
-    ``framework`` defaults to the root module of the model's class, matching
-    the reference resolution rule (src/da4ml/converter/__init__.py:60).
+    ``framework`` defaults to the root module of the model's class (the
+    reference resolution rule, src/da4ml/converter/__init__.py:60), extended
+    to walk the class MRO — a user-defined ``torch.nn.Module`` subclass lives
+    in the user's module, but ``torch`` appears among its bases.
     """
     hwconf = HWConfig(*hwconf)
-    framework = framework or type(model).__module__.split('.', 1)[0]
-
     plugins = get_available_plugins()
+    if framework is None:
+        for cls_ in type(model).__mro__:
+            root = cls_.__module__.split('.', 1)[0]
+            if root in plugins:
+                framework = root
+                break
+        else:
+            framework = type(model).__module__.split('.', 1)[0]
     if framework not in plugins:
         raise ValueError(f'No plugin found for framework {framework!r}. Available: {sorted(plugins)}')
 

@@ -1,0 +1,203 @@
+"""Keras / Torch front-end plugins: DAIS predict == framework predict.
+
+Integer weights and integer inputs keep float32 framework math exact, so the
+comparison is strict equality (reference pattern: tests/test_plugin.py of
+calad0i/da4ml applied to real frameworks).
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.trace import HWConfig, comb_trace
+
+keras = pytest.importorskip('keras')
+torch = pytest.importorskip('torch')
+
+
+def _int_weights_keras(model, rng, lo=-4, hi=4):
+    for w in model.weights:
+        w.assign(rng.integers(lo, hi, w.shape).astype(np.float32))
+
+
+def _trace_predict(model, data, **kw):
+    from da4ml_tpu.converter import trace_model
+
+    inp, out = trace_model(model, HWConfig(1, -1, -1), **kw)
+    comb = comb_trace(inp, out)
+    return comb.predict(data.reshape(len(data), -1), backend='numpy')
+
+
+def test_keras_sequential_mlp(rng):
+    from keras import layers
+
+    model = keras.Sequential([layers.Input((8,)), layers.Dense(6, activation='relu'), layers.Dense(3)])
+    _int_weights_keras(model, rng)
+    data = rng.integers(-8, 8, (32, 8)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 4, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_keras_functional_residual(rng):
+    from keras import layers
+
+    i = keras.Input((6,))
+    a = layers.Dense(6, activation='relu')(i)
+    b = layers.Add()([a, i])
+    o = layers.Dense(2)(b)
+    model = keras.Model(i, o)
+    _int_weights_keras(model, rng)
+    data = rng.integers(-4, 4, (16, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_keras_conv2d_model(rng):
+    from keras import layers
+
+    model = keras.Sequential(
+        [
+            layers.Input((6, 6, 1)),
+            layers.Conv2D(2, (3, 3), activation='relu'),
+            layers.MaxPooling2D((2, 2)),
+            layers.Flatten(),
+            layers.Dense(3),
+        ]
+    )
+    _int_weights_keras(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (8, 6, 6, 1)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).reshape(8, -1).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_keras_concat_multi_branch(rng):
+    from keras import layers
+
+    i = keras.Input((5,))
+    a = layers.Dense(4, activation='relu')(i)
+    b = layers.Dense(4)(i)
+    o = layers.Concatenate()([a, b])
+    model = keras.Model(i, o)
+    _int_weights_keras(model, rng)
+    data = rng.integers(-4, 4, (16, 5)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+class _TorchMLP(torch.nn.Module):
+    input_shape = (8,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(8, 6)
+        self.act = torch.nn.ReLU()
+        self.fc2 = torch.nn.Linear(6, 3)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class _TorchResidual(torch.nn.Module):
+    input_shape = (6,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(6, 6)
+        self.out = torch.nn.Linear(6, 2)
+
+    def forward(self, x):
+        return self.out(torch.relu(self.fc(x)) + x)
+
+
+class _TorchConv(torch.nn.Module):
+    input_shape = (1, 6, 6)
+
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(1, 2, 3)
+        self.act = torch.nn.ReLU()
+        self.flat = torch.nn.Flatten(0)
+        self.fc = torch.nn.Linear(32, 3)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.act(self.conv(x))))
+
+
+def _int_weights_torch(model, rng, lo=-4, hi=4):
+    with torch.no_grad():
+        for p in model.parameters():
+            p.copy_(torch.tensor(rng.integers(lo, hi, tuple(p.shape)).astype(np.float32)))
+
+
+@pytest.mark.parametrize('cls', [_TorchMLP, _TorchResidual])
+def test_torch_mlp(rng, cls):
+    model = cls()
+    _int_weights_torch(model, rng)
+    n_in = int(np.prod(model.input_shape))
+    data = rng.integers(-4, 4, (16, n_in)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = model(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_torch_conv(rng):
+    model = _TorchConv()
+    _int_weights_torch(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (8, 1, 6, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = np.stack([model(torch.tensor(d.astype(np.float32))).numpy() for d in data]).astype(np.float64)
+    np.testing.assert_array_equal(out, ref.reshape(8, -1))
+
+
+def test_keras_avg_pool_same_padding(rng):
+    """'same'-padded average pooling must average only in-bounds cells."""
+    from keras import layers
+
+    model = keras.Sequential([layers.Input((3, 3, 1)), layers.AveragePooling2D((2, 2), padding='same')])
+    data = np.arange(9, dtype=np.float64).reshape(1, 3, 3, 1)
+    out = _trace_predict(model, data, inputs_kif=(1, 4, 0))
+    ref = np.asarray(model(data.astype(np.float32))).reshape(1, -1).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+class _TorchCat(torch.nn.Module):
+    input_shape = (4,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(4, 3)
+
+    def forward(self, x):
+        return torch.cat([self.fc(x), x], dim=1)  # batched-forward convention
+
+
+def test_torch_cat_batched_dim(rng):
+    model = _TorchCat()
+    _int_weights_torch(model, rng)
+    data = rng.integers(-4, 4, (8, 4)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = model(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_torch_padded_pool_rejected(rng):
+    class M(torch.nn.Module):
+        input_shape = (1, 6, 6)
+
+        def __init__(self):
+            super().__init__()
+            self.pool = torch.nn.MaxPool2d(2, padding=1)
+
+        def forward(self, x):
+            return self.pool(x)
+
+    from da4ml_tpu.converter import trace_model
+
+    with pytest.raises(NotImplementedError, match='padding'):
+        trace_model(M(), HWConfig(1, -1, -1), inputs_kif=(1, 3, 0))
