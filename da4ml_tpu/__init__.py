@@ -18,5 +18,20 @@ __all__ = [
     'Precision',
     'LookupTable',
     'minimal_kif',
+    'solve',
+    'trace_model',
     '__version__',
 ]
+
+
+def __getattr__(name):
+    # heavy surfaces resolve lazily so `import da4ml_tpu` stays light
+    if name == 'solve':
+        from .cmvm import solve
+
+        return solve
+    if name == 'trace_model':
+        from .converter import trace_model
+
+        return trace_model
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

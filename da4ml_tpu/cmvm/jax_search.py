@@ -25,6 +25,7 @@ exactly, at equal-or-better total cost.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import lru_cache
 from math import ceil, inf, log2
@@ -73,6 +74,14 @@ def _iceil_log2(x):
     return jnp.where(x > 0, jnp.ceil(jnp.log2(jnp.maximum(x, 1e-37))), 0.0)
 
 
+def _decode_flat(flat, P: int, B: int):
+    """Flat candidate index -> (sub, s, i, j), layout (sub, s, i, j) row-major."""
+    sub, rem = jnp.divmod(flat, B * P * P)
+    s, rem = jnp.divmod(rem, P * P)
+    i, j = jnp.divmod(rem, P)
+    return sub.astype(jnp.int32), s.astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
+
+
 def _overlap_vec(lo0, hi0, st0, lo1, hi1, st1):
     """Vectorized overlap_and_accum -> n_overlap (indexers.cc:36-56)."""
     max0 = hi0 + st0
@@ -91,6 +100,7 @@ class _KernelSpec:
     n_iters: int  # max CSE iterations this call may add
     adder_size: int
     carry_size: int
+    select: str = 'xla'  # 'xla' | 'pallas' (DA4ML_JAX_SELECT)
 
 
 @lru_cache(maxsize=64)
@@ -110,6 +120,20 @@ def _build_cse_fn(spec: _KernelSpec):
     P, O, B, n_iters = spec.P, spec.O, spec.B, spec.n_iters
     adder_size, carry_size = spec.adder_size, spec.carry_size
 
+    def shifted_stack(Ef):
+        """sh[p, o, s, b] = Ef[p, o, b + s] (zero beyond B) — the candidate
+        second operands for every shift, shared by both select paths."""
+        pad = jnp.pad(Ef, ((0, 0), (0, 0), (0, B)))
+        idx = jnp.arange(B)[:, None] + jnp.arange(B)[None, :]  # [s, b] -> b+s
+        return pad[:, :, idx]  # [P, O, S, B]
+
+    def pair_meta(qmeta, lat):
+        """Pairwise (overlap weight, latency imbalance) [P, P] for scoring."""
+        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
+        n_ov = _overlap_vec(lo[:, None], hi[:, None], st[:, None], lo[None, :], hi[None, :], st[None, :])
+        dlat = jnp.abs(lat[:, None] - lat[None, :])
+        return n_ov, dlat
+
     def pair_counts(E):
         """C_same/C_diff [S=B, P, P]: matches of row-i bit b with row-j bit b+s.
 
@@ -117,10 +141,7 @@ def _build_cse_fn(spec: _KernelSpec):
         diff = (|a||b| - ab)/2 over digits in {-1, 0, +1}.
         """
         Ef = E.astype(jnp.bfloat16)
-        # shifted stacks: sh[s, p, o, b] = X[p, o, b + s] (zero beyond B)
-        pad = jnp.pad(Ef, ((0, 0), (0, 0), (0, B)))
-        idx = jnp.arange(B)[:, None] + jnp.arange(B)[None, :]  # [s, b] -> b+s
-        sh = pad[:, :, idx]  # [P, O, S, B]
+        sh = shifted_stack(Ef)
         A = jnp.einsum('iob,josb->sij', Ef, sh, preferred_element_type=jnp.float32)
         D = jnp.einsum('iob,josb->sij', jnp.abs(Ef), jnp.abs(sh), preferred_element_type=jnp.float32)
         return (D + A) * 0.5, (D - A) * 0.5
@@ -141,10 +162,8 @@ def _build_cse_fn(spec: _KernelSpec):
         # s == 0: only i < j (i == j is self-pairing; i > j duplicates i < j)
         valid &= S0_MASK
 
-        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
         # canonical id0/id1: (i, j) if i <= j else (j, i) — metadata symmetric
-        n_ov = _overlap_vec(lo[:, None], hi[:, None], st[:, None], lo[None, :], hi[None, :], st[None, :])
-        dlat = jnp.abs(lat[:, None] - lat[None, :])
+        n_ov, dlat = pair_meta(qmeta, lat)
 
         base_mc = count
         base_wmc = count * n_ov[None, None]
@@ -168,10 +187,28 @@ def _build_cse_fn(spec: _KernelSpec):
         score = jnp.where(valid, score, -jnp.inf)
         flat = jnp.argmax(score)
         any_valid = jnp.max(score) != -jnp.inf
-        sub, rem = jnp.divmod(flat, B * P * P)
-        s, rem = jnp.divmod(rem, P * P)
-        i, j = jnp.divmod(rem, P)
-        return any_valid, sub.astype(jnp.int32), s.astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
+        return any_valid, *_decode_flat(flat, P, B)
+
+    def select_pair_pallas(E, qmeta, lat, method):
+        """Fused VMEM select (pallas): decision-identical with select_pair."""
+        from .pallas_select import make_select
+
+        sel_fn = make_select(P, O, B, interpret=jax.default_backend() != 'tpu')
+        Ef = E.astype(jnp.float32)
+        sh = shifted_stack(Ef).transpose(2, 0, 1, 3).reshape(B, P, O * B)  # [S, P, OB]
+        nov, dlat = pair_meta(qmeta, lat)
+        is_dc = (method == 1) | (method == 2)
+        is_wdc = (method == 4) | (method == 5)
+        coef = jnp.stack(
+            [
+                jnp.where(method < 3, 1.0, 0.0),
+                jnp.where(method >= 3, 1.0, 0.0),
+                jnp.where(is_dc, 1e9, jnp.where(is_wdc, 256.0, 0.0)),
+                jnp.where((method == 1) | (method == 3) | (method == 4), 1.0, 0.0),
+            ]
+        ).reshape(1, 4)
+        flat, any_valid = sel_fn(Ef.reshape(P, O * B), sh, nov, dlat, coef)
+        return any_valid, *_decode_flat(flat, P, B)
 
     b_idx = jnp.arange(B)
 
@@ -233,9 +270,12 @@ def _build_cse_fn(spec: _KernelSpec):
 
         def body(state):
             E, qmeta, lat, cur, op_rec, _ = state
-            C_same, C_diff = pair_counts(E)
-            C = jnp.stack([C_same, C_diff])  # [2, S, P, P]
-            any_valid, sub, s, i, j = select_pair(C, qmeta, lat, method)
+            if spec.select == 'pallas':
+                any_valid, sub, s, i, j = select_pair_pallas(E, qmeta, lat, method)
+            else:
+                C_same, C_diff = pair_counts(E)
+                C = jnp.stack([C_same, C_diff])  # [2, S, P, P]
+                any_valid, sub, s, i, j = select_pair(C, qmeta, lat, method)
 
             def do_update(args):
                 E, qmeta, lat, cur, op_rec = args
@@ -416,7 +456,9 @@ def solve_single_lanes(
             if sh is not None:
                 args = tuple(jax.device_put(a, sh) for a in args)
 
-            fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size))
+            fn = _build_cse_fn(
+                _KernelSpec(P, O, B, n_iters, adder_size, carry_size, os.environ.get('DA4ML_JAX_SELECT', 'xla'))
+            )
             dE, dq, dl, d_rec, dc_ = fn(*args)
             cur_f = np.asarray(jax.device_get(dc_))[:n_pend]
             op_rec = np.asarray(jax.device_get(d_rec))[:n_pend]

@@ -123,8 +123,15 @@ class KerasTracer(TracerPluginBase):
             beta = _weight(layer.beta) if layer.center else 0.0
             mean = _weight(layer.moving_mean)
             var = _weight(layer.moving_variance)
-            a = gamma / np.sqrt(var + eps)
-            return x * a + (beta - mean * a)
+            a = np.atleast_1d(gamma / np.sqrt(var + eps))
+            b = np.atleast_1d(beta - mean * a)
+            ax = layer.axis if isinstance(layer.axis, int) else layer.axis[0]
+            if ax == 0:
+                raise NotImplementedError('BatchNormalization along the batch axis is not traceable')
+            ax = ax - 1 if ax > 0 else ax % x.ndim  # batch dim dropped in tracing
+            shape = [1] * x.ndim
+            shape[ax] = a.size
+            return x * a.reshape(shape) + b.reshape(shape)
 
         if name == 'Add':
             vals = args[0] if isinstance(args[0], (list, tuple)) else args
@@ -151,6 +158,8 @@ class KerasTracer(TracerPluginBase):
         if name == 'Concatenate':
             vals = args[0] if isinstance(args[0], (list, tuple)) else args
             axis = layer.axis
+            if axis == 0:
+                raise NotImplementedError('Concatenate along the batch axis (axis=0) is not traceable')
             if axis > 0:
                 axis -= 1  # batch dim dropped in tracing
             return np.concatenate(vals, axis=axis)

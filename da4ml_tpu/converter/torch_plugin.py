@@ -149,6 +149,10 @@ class TorchTracer(TracerPluginBase):
             vals = args[0]
             return np.concatenate(vals, axis=self._sample_axis(int(dim), vals[0].ndim))
         if fn in (torch.flatten,):
+            start = int(kwargs.get('start_dim', args[1] if len(args) > 1 else 0))
+            end = int(kwargs.get('end_dim', args[2] if len(args) > 2 else -1))
+            if start not in (0, 1) or end != -1:
+                raise NotImplementedError('Only full flattening (start_dim 0/1, end_dim -1) is supported')
             return args[0].reshape(-1)
         if fn in (torch.matmul,):
             return args[0] @ args[1]
@@ -194,6 +198,10 @@ class TorchTracer(TracerPluginBase):
                 if node.target in ('reshape', 'view'):
                     env[node.name] = obj.reshape(*m_args)
                 elif node.target == 'flatten':
+                    start = int(m_args[0]) if m_args else 0
+                    end = int(m_args[1]) if len(m_args) > 1 else -1
+                    if start not in (0, 1) or end != -1:
+                        raise NotImplementedError('Only full flattening (start_dim 0/1, end_dim -1) is supported')
                     env[node.name] = obj.reshape(-1)
                 elif node.target == 'permute':
                     dims = m_args[0] if len(m_args) == 1 and isinstance(m_args[0], (list, tuple)) else m_args

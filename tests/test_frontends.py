@@ -201,3 +201,34 @@ def test_torch_padded_pool_rejected(rng):
 
     with pytest.raises(NotImplementedError, match='padding'):
         trace_model(M(), HWConfig(1, -1, -1), inputs_kif=(1, 3, 0))
+
+
+def test_keras_batchnorm_axis(rng):
+    """BatchNormalization must broadcast stats along its configured axis."""
+    from keras import layers
+
+    for axis in (1, -1):
+        model = keras.Sequential([layers.Input((3, 4)), layers.BatchNormalization(axis=axis)])
+        ch = model.layers[-1].moving_mean.shape[0]
+        model.layers[-1].moving_mean.assign(np.arange(ch, dtype=np.float32))
+        model.layers[-1].moving_variance.assign(np.full(ch, 0.25 - model.layers[-1].epsilon, np.float32))
+        model.layers[-1].gamma.assign(np.full(ch, 2.0, np.float32))
+        data = rng.integers(-4, 4, (4, 3, 4)).astype(np.float64)
+        out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+        ref = np.asarray(model(data.astype(np.float32))).reshape(4, -1).astype(np.float64)
+        # BN folds through a float rsqrt: f32 (keras) vs f64 (trace) differ in
+        # the last ulp, so this checks axis semantics, not bit-exactness
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_torch_partial_flatten_rejected(rng):
+    class M(torch.nn.Module):
+        input_shape = (2, 3, 4)
+
+        def forward(self, x):
+            return torch.flatten(x, 2)
+
+    from da4ml_tpu.converter import trace_model
+
+    with pytest.raises(NotImplementedError, match='flatten'):
+        trace_model(M(), HWConfig(1, -1, -1), inputs_kif=(1, 3, 0))

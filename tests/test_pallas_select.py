@@ -1,0 +1,49 @@
+"""Pallas fused selection: decision-identical with the XLA select path.
+
+Runs in interpret mode off-TPU (tests force the CPU platform), so this
+validates semantics; performance is measured on hardware by bench.py.
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm.jax_search import _build_cse_fn, solve_jax_many
+
+
+def _with_select(monkeypatch, impl):
+    monkeypatch.setenv('DA4ML_JAX_SELECT', impl)
+    _build_cse_fn.cache_clear()
+
+
+def random_kernel(rng, n_dim, bits):
+    mag = rng.integers(0, 2**bits, (n_dim, n_dim)).astype(np.float64)
+    sign = rng.choice([-1.0, 1.0], (n_dim, n_dim))
+    return mag * sign
+
+
+@pytest.mark.parametrize('method0', ['mc', 'wmc'])
+def test_pallas_select_decision_identical(rng, monkeypatch, method0):
+    kernels = [random_kernel(rng, 6, 3) for _ in range(3)]
+
+    _with_select(monkeypatch, 'xla')
+    ref = solve_jax_many(kernels, method0=method0)
+
+    _with_select(monkeypatch, 'pallas')
+    got = solve_jax_many(kernels, method0=method0)
+    _build_cse_fn.cache_clear()
+
+    for k, a, b in zip(kernels, ref, got):
+        np.testing.assert_array_equal(np.asarray(b.kernel, np.float64), k)
+        assert a.cost == b.cost, (a.cost, b.cost)
+        for sa, sb in zip(a.stages, b.stages):
+            assert len(sa.ops) == len(sb.ops)
+            for oa, ob in zip(sa.ops, sb.ops):
+                assert oa == ob
+
+
+def test_pallas_select_hard_dc(rng, monkeypatch):
+    kernel = random_kernel(rng, 6, 4)
+    _with_select(monkeypatch, 'pallas')
+    sol = solve_jax_many([kernel], hard_dc=1)[0]
+    _build_cse_fn.cache_clear()
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
