@@ -25,6 +25,7 @@ class solver_options_t(TypedDict):
     search_all_decompose_dc: NotRequired[bool]
     offload_fn: NotRequired[Callable | None]
     backend: NotRequired[str]
+    method0_candidates: NotRequired[list[str] | None]
 
 
 __all__ = [

@@ -146,11 +146,17 @@ def solve(
     search_all_decompose_dc: bool = True,
     backend: str = 'cpu',
     n_workers: int = 0,
+    method0_candidates: list[str] | None = None,
 ) -> Pipeline:
     """Full CMVM solve with optional sweep over all decompose depths.
 
     backend: 'cpu' (this module, host threads over dc candidates),
     'cpp' (native C++ solver if built), 'jax' (TPU batched search).
+
+    ``method0_candidates`` widens the sweep with extra selection heuristics
+    (argmin keeps the cheapest solution); on the jax backend the extra
+    candidates batch into the same device call, on cpu/cpp they solve
+    sequentially.
     """
     kernel = np.asarray(kernel, dtype=np.float64)
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
@@ -171,7 +177,30 @@ def solve(
             adder_size=adder_size,
             carry_size=carry_size,
             search_all_decompose_dc=search_all_decompose_dc,
+            method0_candidates=method0_candidates,
         )
+
+    if method0_candidates:
+        cands = list(dict.fromkeys(method0_candidates))
+        sols = [
+            solve(
+                kernel,
+                method0=mc,
+                method1=method1,
+                hard_dc=hard_dc,
+                decompose_dc=decompose_dc,
+                qintervals=qintervals,
+                latencies=latencies,
+                adder_size=adder_size,
+                carry_size=carry_size,
+                search_all_decompose_dc=search_all_decompose_dc,
+                backend=backend,
+                n_workers=n_workers,
+            )
+            for mc in cands
+        ]
+        return min(sols, key=lambda s: s.cost)
+
     if backend == 'cpp':
         from ..native import solve_native
 

@@ -605,6 +605,7 @@ def solve_jax(
     adder_size: int = -1,
     carry_size: int = -1,
     search_all_decompose_dc: bool = True,
+    method0_candidates: list[str] | None = None,
 ) -> Pipeline:
     """Drop-in `solve` with the candidate search running on TPU."""
     return solve_jax_many(
@@ -618,6 +619,7 @@ def solve_jax(
         adder_size=adder_size,
         carry_size=carry_size,
         search_all_decompose_dc=search_all_decompose_dc,
+        method0_candidates=method0_candidates,
     )[0]
 
 
@@ -633,10 +635,17 @@ def solve_jax_many(
     carry_size: int = -1,
     search_all_decompose_dc: bool = True,
     mesh=None,
+    method0_candidates: list[str] | None = None,
 ) -> list[Pipeline]:
     """Batched CMVM solve: all (matrix × dc candidate) stage-0 searches run as
     one device batch, then all stage-1 searches. The argmin over dc candidates
-    per matrix happens on host. ``mesh`` shards the lane axis over devices."""
+    per matrix happens on host. ``mesh`` shards the lane axis over devices.
+
+    ``method0_candidates`` widens the sweep with extra selection heuristics —
+    each (matrix, dc) candidate is searched once per method and the global
+    argmin keeps the cheapest. The candidate axis is what the device batches
+    over, so extra methods trade device throughput for solution quality
+    (something the serial reference sweep cannot afford)."""
     from .decompose import kernel_decompose
 
     kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
@@ -648,10 +657,10 @@ def solve_jax_many(
     # budget 10^9 when hard_dc < 0 (api.py solve -> _solve), which turns
     # 'auto' into method0 itself rather than its -dc variant.
     _hard_eff = 10**9 if (search_all_decompose_dc and hard_dc < 0) else hard_dc
-    m0, m1 = _resolve_methods(method0, method1, _hard_eff)
+    mpairs = list(dict.fromkeys(_resolve_methods(mc, method1, _hard_eff) for mc in (method0_candidates or [method0])))
 
-    # enumerate candidate (matrix, dc) lanes
-    jobs: list[tuple[int, int]] = []  # (matrix idx, dc)
+    # enumerate candidate (matrix, dc, methods) lanes
+    jobs: list[tuple[int, int, str, str]] = []  # (matrix idx, dc, method0, method1)
     for mi, kern in enumerate(kernels):
         n_in = kern.shape[0]
         log2_n = int(ceil(log2(max(n_in, 1))))
@@ -661,32 +670,37 @@ def solve_jax_many(
         else:
             dc = min(hard_dc, log2_n, decompose_dc) if decompose_dc != -2 else min(hard_dc, log2_n)
             dcs = [dc]
-        jobs.extend((mi, dc) for dc in dcs)
+        jobs.extend((mi, dc, m0r, m1r) for dc in dcs for m0r, m1r in mpairs)
 
     # stage-0 lanes (kernel decomposition batched through the native library
     # when built — OpenMP over (matrix, dc) lanes)
     if _native_emit_available():
         from ..native.bindings import decompose_batch
 
-        splits = decompose_batch([kernels[mi] for mi, _ in jobs], [dc for _, dc in jobs])
+        _decompose = lambda ps: decompose_batch([kernels[mi] for mi, dc in ps], [dc for mi, dc in ps])  # noqa: E731
     else:
-        splits = [kernel_decompose(kernels[mi], dc) for mi, dc in jobs]
+        _decompose = lambda ps: [kernel_decompose(kernels[mi], dc) for mi, dc in ps]  # noqa: E731
+    uniq_md: dict[tuple[int, int], int] = {}
+    for mi, dc, _, _ in jobs:
+        uniq_md.setdefault((mi, dc), len(uniq_md))
+    splits_u = _decompose(list(uniq_md))
+    splits = [splits_u[uniq_md[(mi, dc)]] for mi, dc, _, _ in jobs]
 
     lanes0: list[_Lane] = []
     mats1: list[NDArray] = []
-    for (mi, dc), (mat0, mat1) in zip(jobs, splits):
+    for (mi, dc, m0r, _), (mat0, mat1) in zip(jobs, splits):
         kern = kernels[mi]
         qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
         lats = latencies_list[mi] or [0.0] * kern.shape[0]
-        lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(m0, dc, _hard_eff)))
+        lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(m0r, dc, _hard_eff)))
         mats1.append(mat1)
     sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
     lanes1: list[_Lane] = []
-    for (mi, dc), sol0, mat1 in zip(jobs, sols0, mats1):
+    for (mi, dc, _, m1r), sol0, mat1 in zip(jobs, sols0, mats1):
         qints1, lats1 = sol0.out_qint, sol0.out_latency
-        lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(m1, dc, _hard_eff)))
+        lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(m1r, dc, _hard_eff)))
     sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
 
     # candidate filtering (latency budget) + argmin per matrix; only the
@@ -694,7 +708,7 @@ def solve_jax_many(
     results: list[Pipeline | None] = [None] * n_mat
     best_cost = [inf] * n_mat
     best_sols: list[tuple | None] = [None] * n_mat
-    for (mi, dc), sol0, sol1 in zip(jobs, sols0, sols1):
+    for (mi, dc, _, _), sol0, sol1 in zip(jobs, sols0, sols1):
         if hard_dc >= 0:
             kern = kernels[mi]
             qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]

@@ -36,7 +36,12 @@ class DaisExecutor:
     ``__call__`` wraps it with the host-side float conversions.
     """
 
-    def __init__(self, prog: DaisProgram, force_i64: bool | None = None):
+    #: op-count threshold above which ``mode='auto'`` switches from the fully
+    #: unrolled jaxpr (best runtime, compile time grows with program size) to
+    #: the scan interpreter (O(1) compile, one fused step body)
+    UNROLL_LIMIT = 20_000
+
+    def __init__(self, prog: DaisProgram, force_i64: bool | None = None, mode: str = 'auto'):
         prog.validate()
         self.prog = prog
         # +2 headroom: shift_add aligns operands before the narrowing shift
@@ -46,7 +51,10 @@ class DaisExecutor:
             jax.config.update('jax_enable_x64', True)
         self.dtype = jnp.int64 if self.use_i64 else jnp.int32
         self._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in prog.tables)
-        self.fn_int = jax.jit(self._build())
+        if mode == 'auto':
+            mode = 'unroll' if prog.n_ops <= self.UNROLL_LIMIT else 'scan'
+        self.mode = mode
+        self.fn_int = jax.jit(self._build() if mode == 'unroll' else self._build_scan())
 
     def _build(self):
         prog = self.prog
@@ -150,6 +158,174 @@ class DaisExecutor:
                 idx = int(prog.out_idxs[j])
                 if idx < 0:
                     outs.append(jnp.zeros((x.shape[0],), dtype=dtype))
+                    continue
+                v = buf[idx]
+                outs.append(-v if prog.out_negs[j] else v)
+            return jnp.stack(outs, axis=-1)
+
+        return fn
+
+    def _build_scan(self):
+        """lax.scan interpreter over the op table — the compile-time fallback.
+
+        One switch-dispatched step body runs ``n_ops`` times against a dense
+        execution buffer; every per-op constant becomes a gathered array.
+        Bit-exact with the unrolled path (same semantics, traced shifts).
+        """
+        prog = self.prog
+        dtype = self.dtype
+        n_ops = prog.n_ops
+        np_dt = np.int64 if self.use_i64 else np.int32
+
+        f_arr = prog.fractionals.astype(np_dt)
+        sg_arr = prog.signed.astype(np_dt)
+        w_arr = prog.width.astype(np_dt)
+        oc_arr = prog.opcode.astype(np.int64)
+        id0_arr = prog.id0.astype(np.int64)
+        id1_arr = prog.id1.astype(np.int64)
+        dlo_arr = prog.data_lo.astype(np.int64)
+        dhi_arr = prog.data_hi.astype(np.int64)
+
+        branch_of = {-1: 0, 0: 1, 1: 1, 2: 2, -2: 2, 3: 3, -3: 3, 4: 4, 5: 5, 6: 6, -6: 6, 7: 7, 8: 8, 9: 9, -9: 9, 10: 10}
+        branch_arr = np.array([branch_of[int(o)] for o in oc_arr], np.int32)
+        neg_arr = (oc_arr < 0).astype(np_dt)
+        sub_arr = (oc_arr == 1).astype(np_dt)  # subtraction is opcode +1, not a negative opcode
+
+        # gathered per-op operand metadata (garbage where a branch ignores it)
+        safe0 = np.clip(id0_arr, 0, max(n_ops - 1, 0))
+        safe1 = np.clip(id1_arr, 0, max(n_ops - 1, 0))
+        f0_arr = f_arr[safe0]
+        f1_arr = f_arr[safe1]
+        a_shift_arr = (dlo_arr + f0_arr - f1_arr).astype(np_dt)
+        g_shift_arr = (np.maximum(f0_arr, f1_arr - dlo_arr) - f_arr).astype(np_dt)
+        const_arr = ((dhi_arr << 32) | (dlo_arr & 0xFFFFFFFF)).astype(np_dt)
+        safec = np.clip(dlo_arr, 0, max(n_ops - 1, 0))
+        sgc_arr = sg_arr[safec]
+        wc_arr = w_arr[safec]
+        mux_s0_arr = (f_arr - f0_arr).astype(np_dt)
+        mux_s1_arr = (f_arr - f1_arr + dhi_arr).astype(np_dt)
+        # lookup tables flattened with per-table offsets; index clamped within
+        # its own table (the unrolled path clips per table)
+        if prog.tables:
+            flat_tab = np.concatenate([np.asarray(t, np_dt) for t in prog.tables])
+            offs = np.cumsum([0] + [len(t) for t in prog.tables])
+        else:
+            flat_tab = np.zeros(1, np_dt)
+            offs = np.array([0, 1])
+        safet = np.clip(dlo_arr, 0, len(offs) - 2)
+        tab_off_arr = offs[safet].astype(np_dt)
+        tab_end_arr = (offs[safet + 1] - 1).astype(np_dt)
+        lut_zero_arr = (-sg_arr[safe0] * (1 << np.maximum(w_arr[safe0] - 1, 0))).astype(np_dt)
+        mask0_arr = ((1 << w_arr[safe0].astype(np.int64)) - 1).astype(np_dt)
+        bb_neg0 = ((dhi_arr & 1) != 0).astype(np_dt)
+        bb_neg1 = ((dhi_arr & 2) != 0).astype(np_dt)
+        bb_subop = (dhi_arr >> 24).astype(np_dt)
+
+        P = {
+            'branch': branch_arr, 'neg': neg_arr, 'id0': id0_arr.astype(np.int32), 'id1': id1_arr.astype(np.int32),
+            'dlo': dlo_arr.astype(np.int32), 'f': f_arr, 'sg': sg_arr, 'w': w_arr, 'f0': f0_arr, 'f1': f1_arr,
+            'a_shift': a_shift_arr, 'g_shift': g_shift_arr, 'const': const_arr, 'sgc': sgc_arr, 'wc': wc_arr,
+            'mux_s0': mux_s0_arr, 'mux_s1': mux_s1_arr, 'tab_off': tab_off_arr, 'tab_end': tab_end_arr,
+            'lut_zero': lut_zero_arr, 'mask0': mask0_arr, 'bb_neg0': bb_neg0, 'bb_neg1': bb_neg1,
+            'bb_subop': bb_subop, 'issub': sub_arr,
+        }  # fmt: skip
+        P = {k: jnp.asarray(v) for k, v in P.items()}
+        flat_tab_d = jnp.asarray(flat_tab)
+        one = jnp.asarray(1, dtype)
+
+        def shl(v, s):
+            return jnp.left_shift(v, jnp.maximum(s, 0)) >> jnp.maximum(-s, 0)
+
+        def wrap(v, sg, w):
+            mod = one << w
+            int_min = jnp.where(sg != 0, -(one << (w - 1)), jnp.asarray(0, dtype))
+            return ((v - int_min) % mod) + int_min
+
+        def fn(x):
+            # x: (batch, n_in) integers
+            batch = x.shape[0]
+            xT = x.T.astype(dtype)  # [n_in, batch]
+
+            def step(buf, p):
+                x0 = buf[p['id0']]
+                x1 = buf[p['id1']]
+                neg = p['neg'] != 0
+                sg, w, f = p['sg'], p['w'], p['f']
+
+                def quantize(v, f_from):
+                    return wrap(shl(v, f - f_from), sg, w)
+
+                def b_copy(_):
+                    return wrap(xT[p['id0']], sg, w)
+
+                def b_addsub(_):
+                    v2 = jnp.where(p['issub'] != 0, -x1, x1)
+                    a = p['a_shift']
+                    r = jnp.where(a > 0, x0 + shl(v2, jnp.maximum(a, 0)), shl(x0, jnp.maximum(-a, 0)) + v2)
+                    return jnp.where(p['g_shift'] > 0, r >> jnp.maximum(p['g_shift'], 0), r)
+
+                def b_relu(_):
+                    v = jnp.where(neg, -x0, x0)
+                    return jnp.where(v < 0, jnp.asarray(0, dtype), quantize(v, p['f0']))
+
+                def b_quant(_):
+                    return quantize(jnp.where(neg, -x0, x0), p['f0'])
+
+                def b_cadd(_):
+                    return shl(x0, f - p['f0']) + p['const'].astype(dtype)
+
+                def b_const(_):
+                    return jnp.full((batch,), p['const'], dtype=dtype)
+
+                def b_mux(_):
+                    vc = buf[p['dlo']]
+                    cond = jnp.where(p['sgc'] != 0, vc < 0, vc >= (one << (p['wc'] - 1)))
+                    v1 = jnp.where(neg, -x1, x1)
+                    r0 = wrap(shl(x0, p['mux_s0']), sg, w)
+                    r1 = wrap(shl(v1, p['mux_s1']), sg, w)
+                    return jnp.where(cond, r0, r1)
+
+                def b_mul(_):
+                    return x0 * x1
+
+                def b_lookup(_):
+                    index = x0 - p['lut_zero'] - p['dhi'] + p['tab_off']
+                    index = jnp.clip(index, p['tab_off'], p['tab_end'])
+                    return jnp.take(flat_tab_d, index, mode='clip')
+
+                def b_bitu(_):
+                    v = jnp.where(neg, -x0, x0)
+                    mask = p['mask0'].astype(dtype)
+                    r_not = jnp.where(sg != 0, ~v, (~v) & mask)
+                    r_any = (v != 0).astype(dtype)
+                    r_all = ((v & mask) == mask).astype(dtype)
+                    return jnp.where(p['dlo'] == 0, r_not, jnp.where(p['dlo'] == 1, r_any, r_all))
+
+                def b_bitb(_):
+                    v1 = jnp.where(p['bb_neg0'] != 0, -x0, x0)
+                    v2 = jnp.where(p['bb_neg1'] != 0, -x1, x1)
+                    a = p['a_shift']
+                    v2 = jnp.where(a > 0, shl(v2, jnp.maximum(a, 0)), v2)
+                    v1 = jnp.where(a > 0, v1, shl(v1, jnp.maximum(-a, 0)))
+                    so = p['bb_subop']
+                    return jnp.where(so == 0, v1 & v2, jnp.where(so == 1, v1 | v2, v1 ^ v2))
+
+                branches = [b_copy, b_addsub, b_relu, b_quant, b_cadd, b_const, b_mux, b_mul, b_lookup, b_bitu, b_bitb]
+                val = jax.lax.switch(p['branch'], branches, None)
+                buf = jax.lax.dynamic_update_slice(buf, val[None, :], (p['t'], jnp.asarray(0, jnp.int32)))
+                return buf, None
+
+            Pt = dict(P)
+            Pt['dhi'] = jnp.asarray(dhi_arr.astype(np_dt))
+            Pt['t'] = jnp.arange(n_ops, dtype=jnp.int32)
+            buf0 = jnp.zeros((n_ops, batch), dtype=dtype)
+            buf, _ = jax.lax.scan(step, buf0, Pt)
+
+            outs = []
+            for j in range(prog.n_out):
+                idx = int(prog.out_idxs[j])
+                if idx < 0:
+                    outs.append(jnp.zeros((batch,), dtype=dtype))
                     continue
                 v = buf[idx]
                 outs.append(-v if prog.out_negs[j] else v)

@@ -85,3 +85,48 @@ def test_fuzz_bits_shapes(seed):
     golden = x @ kernel
     np.testing.assert_array_equal(sol.predict(x, backend='numpy'), golden)
     np.testing.assert_array_equal(sol.stages[0].predict(x, backend='jax'), x @ np.asarray(sol.stages[0].kernel, np.float64))
+
+
+def test_scan_executor_matches_unrolled(rng):
+    """The lax.scan interpreter (compile-time fallback for huge programs) is
+    bit-exact with the unrolled jaxpr executor."""
+    import numpy as np
+
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput((8,), hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(8), np.full(8, 4), np.full(8, 1))
+    w = rng.integers(-8, 8, (8, 5)).astype(np.float64)
+    y = np.sin(x[:4]).quantize(np.ones(4), np.ones(4), np.full(4, 6))
+    z = (x @ w).relu()
+    out = np.concatenate([z, y, abs(x[:2]), x[:2] & x[2:4]])
+    comb = comb_trace(inp, out)
+
+    prog = decode(comb.to_binary())
+    data = rng.uniform(-16, 16, (64, 8))
+    ref = DaisExecutor(prog, mode='unroll')(data)
+    got = DaisExecutor(prog, mode='scan')(data)
+    np.testing.assert_array_equal(got, ref)
+    np.testing.assert_array_equal(ref, comb.predict(data, backend='numpy'))
+
+
+def test_scan_executor_i64(rng):
+    """Wide programs (int64 path) run in scan mode (x64 index dtypes)."""
+    import numpy as np
+
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput((6,), hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 20), np.full(6, 4))
+    w = rng.integers(-(2**10), 2**10, (6, 3)).astype(np.float64)
+    comb = comb_trace(inp, x @ w)
+    prog = decode(comb.to_binary())
+    data = rng.uniform(-(2**19), 2**19, (32, 6))
+    ex_scan = DaisExecutor(prog, mode='scan')
+    assert ex_scan.use_i64, 'test requires the int64 path'
+    ref = DaisExecutor(prog, mode='unroll')(data)
+    np.testing.assert_array_equal(ex_scan(data), ref)

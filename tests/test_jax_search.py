@@ -64,3 +64,28 @@ def test_backend_dispatch(rng):
     kernel = random_kernel(rng, 4, 3)
     sol = solve(kernel, backend='jax')
     np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+def test_method_candidates_quality(rng):
+    """Widening the sweep with extra heuristics never worsens the argmin."""
+    kernels = [random_kernel(rng, 8, 4) for _ in range(4)]
+    base = solve_jax_many(kernels, method0='wmc')
+    wide = solve_jax_many(kernels, method0='wmc', method0_candidates=['wmc', 'mc'])
+    for k, b, w in zip(kernels, base, wide):
+        np.testing.assert_array_equal(np.asarray(w.kernel, np.float64), k)
+        assert w.cost <= b.cost, (w.cost, b.cost)
+
+
+def test_method_candidates_via_solver_options(rng):
+    """method0_candidates routes through solver_options on every backend."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    w = random_kernel(rng, 6, 3)
+    for backend in ('jax', 'cpu'):
+        opts = {'backend': backend, 'method0_candidates': ['wmc', 'mc']}
+        inp = FixedVariableArrayInput((3, 6), hwconf=HWConfig(1, -1, -1), solver_options=opts)
+        x = inp.quantize(np.ones((3, 6)), np.full((3, 6), 3), np.zeros((3, 6), np.int64))
+        comb = comb_trace(inp, x @ w)
+        data = rng.integers(-8, 8, (16, 18)).astype(np.float64)
+        out = comb.predict(data, backend='numpy')
+        np.testing.assert_array_equal(out.reshape(16, 3, -1), data.reshape(16, 3, 6) @ w)
