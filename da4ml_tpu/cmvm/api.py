@@ -215,6 +215,7 @@ def solve(
             adder_size=adder_size,
             carry_size=carry_size,
             search_all_decompose_dc=search_all_decompose_dc,
+            n_threads=n_workers,
         )
 
     if not search_all_decompose_dc:

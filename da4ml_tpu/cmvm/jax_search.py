@@ -44,6 +44,11 @@ from . import api as _host_api
 
 _METHOD_CODES = {'mc': 0, 'mc-dc': 1, 'mc-pdc': 2, 'wmc': 3, 'wmc-dc': 4, 'wmc-pdc': 5, 'dummy': 6}
 
+#: observability counters; 'over_budget_accepts' counts matrices where no
+#: candidate met the hard_dc latency budget and the forced dc=-1 / wmc-dc
+#: terminal was accepted (the host solver's terminal break, api.py _solve)
+search_stats = {'over_budget_accepts': 0}
+
 
 # --------------------------------------------------------------------------
 # device kernel
@@ -448,7 +453,13 @@ def solve_single_lanes(
             pad_lane = (0, bucket - dE.shape[0])
             pad_slot = (0, P - dE.shape[1])
             dE = jnp.pad(dE, (pad_lane, pad_slot, (0, 0), (0, 0)))
+            lanes0, slots0 = dq.shape[0], dq.shape[1]
             dq = jnp.pad(dq, (pad_lane, pad_slot, (0, 0)))
+            # padded rows must keep the benign-metadata invariant (step 1.0,
+            # not 0): their zero digit rows are never selectable, but scoring
+            # reads the step column unguarded
+            dq = dq.at[:, slots0:, 2].set(1.0)
+            dq = dq.at[lanes0:, :, 2].set(1.0)
             dl = jnp.pad(dl, (pad_lane, pad_slot))
             dc_ = jnp.pad(dc_, pad_lane, constant_values=n_in_max)
             dm = jnp.pad(dm, pad_lane)
@@ -456,9 +467,15 @@ def solve_single_lanes(
             if sh is not None:
                 args = tuple(jax.device_put(a, sh) for a in args)
 
-            fn = _build_cse_fn(
-                _KernelSpec(P, O, B, n_iters, adder_size, carry_size, os.environ.get('DA4ML_JAX_SELECT', 'xla'))
-            )
+            select = os.environ.get('DA4ML_JAX_SELECT', 'xla')
+            if select == 'pallas':
+                # the fused kernel keeps its whole working set in VMEM; large
+                # shape classes (staged searches growing P) must stay on XLA
+                from .pallas_select import fits_vmem
+
+                if not fits_vmem(P, O, B):
+                    select = 'xla'
+            fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size, select))
             dE, dq, dl, d_rec, dc_ = fn(*args)
             cur_f = np.asarray(jax.device_get(dc_))[:n_pend]
             op_rec = np.asarray(jax.device_get(d_rec))[:n_pend]
@@ -659,8 +676,11 @@ def solve_jax_many(
     _hard_eff = 10**9 if (search_all_decompose_dc and hard_dc < 0) else hard_dc
     mpairs = list(dict.fromkeys(_resolve_methods(mc, method1, _hard_eff) for mc in (method0_candidates or [method0])))
 
-    # enumerate candidate (matrix, dc, methods) lanes
-    jobs: list[tuple[int, int, str, str]] = []  # (matrix idx, dc, method0, method1)
+    # enumerate candidate (matrix, dc, method-pair) lanes. Under a latency
+    # budget the host shrinks dc and retries inside each solve (api.py _solve
+    # / api.cc:84-139); here every rung of that shrink ladder is just another
+    # device lane, so constrained solves stay on TPU end to end.
+    jobs: list[tuple[int, int, int]] = []  # (matrix idx, dc, method-pair idx)
     for mi, kern in enumerate(kernels):
         n_in = kern.shape[0]
         log2_n = int(ceil(log2(max(n_in, 1))))
@@ -669,8 +689,10 @@ def solve_jax_many(
             dcs = list(range(-1, min(_hard, log2_n) + 1))
         else:
             dc = min(hard_dc, log2_n, decompose_dc) if decompose_dc != -2 else min(hard_dc, log2_n)
-            dcs = [dc]
-        jobs.extend((mi, dc, m0r, m1r) for dc in dcs for m0r, m1r in mpairs)
+            # dc ladder: the host's shrink-and-retry, flattened into lanes
+            # (descending order = host preference: first fitting dc wins)
+            dcs = list(range(dc, -2, -1)) if hard_dc >= 0 else [dc]
+        jobs.extend((mi, dc, mp) for dc in dcs for mp in range(len(mpairs)))
 
     # stage-0 lanes (kernel decomposition batched through the native library
     # when built — OpenMP over (matrix, dc) lanes)
@@ -681,63 +703,73 @@ def solve_jax_many(
     else:
         _decompose = lambda ps: [kernel_decompose(kernels[mi], dc) for mi, dc in ps]  # noqa: E731
     uniq_md: dict[tuple[int, int], int] = {}
-    for mi, dc, _, _ in jobs:
+    for mi, dc, _ in jobs:
         uniq_md.setdefault((mi, dc), len(uniq_md))
     splits_u = _decompose(list(uniq_md))
-    splits = [splits_u[uniq_md[(mi, dc)]] for mi, dc, _, _ in jobs]
+    splits = [splits_u[uniq_md[(mi, dc)]] for mi, dc, _ in jobs]
 
     lanes0: list[_Lane] = []
     mats1: list[NDArray] = []
-    for (mi, dc, m0r, _), (mat0, mat1) in zip(jobs, splits):
+    for (mi, dc, mp), (mat0, mat1) in zip(jobs, splits):
         kern = kernels[mi]
         qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
         lats = latencies_list[mi] or [0.0] * kern.shape[0]
-        lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(m0r, dc, _hard_eff)))
+        lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(mpairs[mp][0], dc, _hard_eff)))
         mats1.append(mat1)
     sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
     lanes1: list[_Lane] = []
-    for (mi, dc, _, m1r), sol0, mat1 in zip(jobs, sols0, mats1):
+    for (mi, dc, mp), sol0, mat1 in zip(jobs, sols0, mats1):
         qints1, lats1 = sol0.out_qint, sol0.out_latency
-        lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(m1r, dc, _hard_eff)))
+        lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(mpairs[mp][1], dc, _hard_eff)))
     sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
 
-    # candidate filtering (latency budget) + argmin per matrix; only the
-    # winning candidates are materialized into full IR objects
-    results: list[Pipeline | None] = [None] * n_mat
-    best_cost = [inf] * n_mat
-    best_sols: list[tuple | None] = [None] * n_mat
-    for (mi, dc, _, _), sol0, sol1 in zip(jobs, sols0, sols1):
-        if hard_dc >= 0:
-            kern = kernels[mi]
+    # per-matrix latency budget, computed once
+    allowed = [inf] * n_mat
+    if hard_dc >= 0:
+        for mi, kern in enumerate(kernels):
             qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
             lats = latencies_list[mi] or [0.0] * kern.shape[0]
-            min_lat = _host_api.minimal_latency(kern, list(qints), list(lats), carry_size, adder_size)
-            allowed = hard_dc + min_lat
-            max_lat = max((lt for s in (sol0, sol1) for lt in s.out_latency), default=0.0)
-            if max_lat > allowed:
-                continue
-        c = float(sol0.cost) + float(sol1.cost)
-        if c < best_cost[mi]:
-            best_cost[mi] = c
-            best_sols[mi] = (sol0, sol1)
-    for mi, pair in enumerate(best_sols):
-        if pair is not None:
-            results[mi] = Pipeline(stages=(_as_comb(pair[0]), _as_comb(pair[1])))
+            allowed[mi] = hard_dc + _host_api.minimal_latency(kern, list(qints), list(lats), carry_size, adder_size)
 
-    # fallback: no candidate met the latency budget -> host retry logic
+    # candidate selection, all from device results. Sweep mode: argmin cost
+    # over in-budget candidates. Non-sweep: the host preference — first
+    # fitting dc walking down the ladder, per method pair, then argmin cost
+    # across pairs. If nothing fits, accept the forced dc=-1 / wmc-dc lane:
+    # that is exactly the host's terminal break (api.py _solve), so a
+    # hard_dc >= 0 solve never leaves the device path.
+    best_cost = [inf] * n_mat
+    best_sols: list[tuple | None] = [None] * n_mat
+    first_fit: dict[tuple[int, int], tuple] = {}  # (matrix, method pair) -> pair
+    terminal: list[tuple | None] = [None] * n_mat
+    for (mi, dc, mp), sol0, sol1 in zip(jobs, sols0, sols1):
+        pair = (sol0, sol1)
+        if dc == -1 and terminal[mi] is None:
+            terminal[mi] = pair
+        max_lat = max((lt for s in pair for lt in s.out_latency), default=0.0)
+        if max_lat > allowed[mi]:
+            continue
+        c = float(sol0.cost) + float(sol1.cost)
+        if search_all_decompose_dc:
+            if c < best_cost[mi]:
+                best_cost[mi] = c
+                best_sols[mi] = pair
+        elif (mi, mp) not in first_fit:
+            first_fit[(mi, mp)] = pair
+    if not search_all_decompose_dc:
+        for (mi, _), pair in first_fit.items():
+            c = float(pair[0].cost) + float(pair[1].cost)
+            if c < best_cost[mi]:
+                best_cost[mi] = c
+                best_sols[mi] = pair
+
+    results: list[Pipeline] = []
     for mi in range(n_mat):
-        if results[mi] is None:
-            results[mi] = _host_api._solve(
-                kernels[mi],
-                method0,
-                method1,
-                hard_dc,
-                decompose_dc,
-                qintervals_list[mi],
-                latencies_list[mi],
-                adder_size,
-                carry_size,
-            )
-    return results  # type: ignore[return-value]
+        pair = best_sols[mi] or terminal[mi]
+        if pair is None:  # hard_dc < 0 always selects; this cannot happen
+            raise RuntimeError(f'no candidate solution for matrix {mi}')
+        if best_sols[mi] is None:
+            search_stats['over_budget_accepts'] += 1
+        results.append(Pipeline(stages=(_as_comb(pair[0]), _as_comb(pair[1]))))
+    return results

@@ -44,6 +44,32 @@ except Exception:  # pragma: no cover
     _SMEM = _VMEM = None
 
 
+# Per-core VMEM is ~16 MiB on current TPUs; the kernel keeps every operand
+# resident (no blocking), so refuse shape classes whose working set cannot
+# fit with headroom for the dot-general accumulators.
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def vmem_footprint_bytes(P: int, O: int, B: int) -> int:
+    """Resident f32 working set of the fused select kernel for one lane."""
+    OB = O * B
+    sh = B * P * OB * 4  # shifted digit stack — the dominant term
+    e = P * OB * 4
+    pairs = 2 * P * P * 4  # nov + dlat
+    scratch = 4 * P * P * 4  # dot outputs + score/valid temporaries
+    return sh + e + pairs + scratch
+
+
+def fits_vmem(P: int, O: int, B: int, budget: int = VMEM_BUDGET_BYTES) -> bool:
+    """Whether the fused kernel's working set fits in VMEM for this class.
+
+    The staged search grows P past 128 where ``sh`` alone can exceed the
+    budget (e.g. P=256, O=64, B=16 -> 16 MiB for ``sh``); callers must fall
+    back to the XLA select path when this returns False.
+    """
+    return vmem_footprint_bytes(P, O, B) <= budget
+
+
 def _vspec():
     return pl.BlockSpec(memory_space=_VMEM) if _VMEM is not None else pl.BlockSpec()
 

@@ -95,9 +95,9 @@ class KerasTracer(TracerPluginBase):
         if name == 'AveragePooling2D':
             return avg_pool2d(args[0], layer.pool_size, layer.strides, layer.padding)
         if name == 'GlobalAveragePooling2D':
-            return np.mean(args[0], axis=(0, 1))
+            return np.mean(args[0], axis=(0, 1), keepdims=bool(getattr(layer, 'keepdims', False)))
         if name == 'GlobalMaxPooling2D':
-            return np.amax(args[0], axis=(0, 1))
+            return np.amax(args[0], axis=(0, 1), keepdims=bool(getattr(layer, 'keepdims', False)))
 
         if name == 'Flatten':
             return args[0].reshape(-1)

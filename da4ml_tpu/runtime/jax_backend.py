@@ -15,6 +15,7 @@ the native C++ interpreter (reference DAISInterpreter.cc semantics).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 
 import jax
@@ -51,6 +52,8 @@ class DaisExecutor:
             jax.config.update('jax_enable_x64', True)
         self.dtype = jnp.int64 if self.use_i64 else jnp.int32
         self._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in prog.tables)
+        if mode not in ('auto', 'unroll', 'scan'):
+            raise ValueError(f"mode must be 'auto', 'unroll' or 'scan', got {mode!r}")
         if mode == 'auto':
             mode = 'unroll' if prog.n_ops <= self.UNROLL_LIMIT else 'scan'
         self.mode = mode
@@ -367,16 +370,22 @@ class DaisExecutor:
         return out[: len(data)] * self._out_scale()
 
 
-_executor_cache: dict[bytes, DaisExecutor] = {}
+_executor_cache: OrderedDict[bytes, DaisExecutor] = OrderedDict()
+_EXECUTOR_CACHE_CAP = 256
 
 
 def executor_for_binary(binary: NDArray[np.int32]) -> DaisExecutor:
     key = np.asarray(binary, dtype=np.int32).tobytes()
-    if key not in _executor_cache:
-        if len(_executor_cache) > 256:
-            _executor_cache.clear()
-        _executor_cache[key] = DaisExecutor(decode(binary))
-    return _executor_cache[key]
+    ex = _executor_cache.get(key)
+    if ex is None:
+        # LRU: long conversion sweeps touch many programs; evicting one cold
+        # entry keeps the rest of the working set (and its XLA compiles) warm
+        while len(_executor_cache) >= _EXECUTOR_CACHE_CAP:
+            _executor_cache.popitem(last=False)
+        _executor_cache[key] = ex = DaisExecutor(decode(binary))
+    else:
+        _executor_cache.move_to_end(key)
+    return ex
 
 
 def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64]) -> NDArray[np.float64]:

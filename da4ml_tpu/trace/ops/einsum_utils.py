@@ -1,167 +1,178 @@
 """Two-operand einsum over symbolic arrays.
 
-The einsum string is validated and lowered to a recipe of axis
-transpositions plus a loop of ``A @ B`` slices, so constant-side operands hit
-the CMVM matmul path (reference trace/ops/einsum_utils.py; note the
-multiplication order is reversed relative to np.einsum — irrelevant for the
-commutative ops traced here).
+The subscript expression is lowered to a *batched-matmul normal form*:
+every axis of each operand is classified as batch (shared, kept), contracted
+(shared, summed), free (exclusive, kept) or collapsed (exclusive, summed),
+the operands are transposed/reshaped to ``[B, M, K]`` and ``[B, K, N]``, and
+the contraction runs as B independent ``[M, K] @ [K, N]`` matmuls — so any
+constant-side operand hits the CMVM matmul path of
+:class:`~da4ml_tpu.trace.fixed_variable_array.FixedVariableArray`.
+
+Behavioral parity with the einsum surface of calad0i/da4ml
+(src/da4ml/trace/ops/einsum_utils.py): same supported expressions incl.
+``...`` broadcasting, same rejection rules. The lowering here (matmul
+normal form instead of a flat slice loop) is an independent design.
 """
 
 from __future__ import annotations
 
+import re
+from dataclasses import dataclass
 from math import prod
-from typing import TypedDict
 
 import numpy as np
 
-_ALPHABET = 'abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ'
+_TERM_RE = re.compile(r'^[a-zA-Z]*(\.\.\.)?[a-zA-Z]*$')
+_LETTERS = 'abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ'
 
 
-class EinsumRecipe(TypedDict):
-    direct_sum_axis: tuple[tuple[int, ...], tuple[int, ...]]
-    in_transpose_idxs: tuple[tuple[int, ...], tuple[int, ...]]
-    L0: int
-    L1: int
-    I: int
-    C: int
-    out_interpert_shape: tuple[int, ...]
-    out_transpose_idxs: tuple[int, ...]
+@dataclass(frozen=True)
+class EinsumPlan:
+    """Lowering of one einsum expression at fixed operand shapes."""
+
+    collapse0: tuple[int, ...]  # axes of operand 0 summed away up front
+    collapse1: tuple[int, ...]
+    perm0: tuple[int, ...]  # post-collapse transpose to (batch, free0, contracted)
+    perm1: tuple[int, ...]  # post-collapse transpose to (batch, contracted, free1)
+    b: int  # prod of batch dims
+    m: int  # prod of free0 dims
+    k: int  # prod of contracted dims
+    n: int  # prod of free1 dims
+    stacked_shape: tuple[int, ...]  # batch + free0 + free1 dims
+    out_perm: tuple[int, ...]  # stacked order -> requested output order
 
 
-def _validate_einsum_expr(fn: str, shape0: tuple[int, ...], shape1: tuple[int, ...]):
-    """Validate + resolve '...' broadcasting; returns (normalized string, out shape)."""
-    inp, out = map(str.strip, fn.split('->'))
-    in0, in1 = map(str.strip, inp.split(','))
-    s_alpha = set(_ALPHABET)
+def _split_terms(expr: str) -> tuple[str, str, str]:
+    try:
+        lhs, rhs = expr.split('->')
+        t0, t1 = lhs.split(',')
+    except ValueError:
+        raise ValueError(f'einsum string {expr!r} must have the form "A,B->C"') from None
+    return t0.strip(), t1.strip(), rhs.strip()
 
-    if not (s_alpha >= set(in0.replace('...', '') + in1.replace('...', '') + out.replace('...', ''))):
-        raise ValueError(f"einsum string {fn} is invalid: subscripts must be [a-zA-Z] and '...'")
 
-    in0, in1, out = in0.replace('...', '0'), in1.replace('...', '0'), out.replace('...', '0')
-    ax_in0, ax_in1, ax_out = list(in0), list(in1), list(out)
-    sax_in0, sax_in1, sax_out = set(ax_in0), set(ax_in1), set(ax_out)
-    free = ''.join(sorted(s_alpha - sax_in0 - sax_in1 - sax_out))
-
-    for name, axes, sax in (('input0', ax_in0, sax_in0), ('input1', ax_in1, sax_in1), ('output', ax_out, sax_out)):
-        if len(sax) != len(axes):
-            dup = next(a for a in axes if axes.count(a) > 1)
-            dup = dup if dup != '0' else '...'
-            raise ValueError(f"einsum string {fn} is invalid: {name} includes '{dup}' multiple times")
-
-    if '0' in sax_in0 or '0' in sax_in1 or '0' in sax_out:
-        if '0' not in sax_out:
-            raise ValueError(f'einsum string {fn} is invalid: inputs broadcast but output does not')
-        if '0' not in sax_in0 and '0' not in sax_in1:
-            raise ValueError(f'einsum string {fn} is invalid: output broadcasts but inputs do not')
-    if remaining := sax_out - sax_in0 - sax_in1:
-        raise ValueError(f'einsum string {fn} is invalid: output subscripts {remaining} not found in inputs')
-
-    if '0' in sax_in0 and '0' in sax_in1:
-        nb0 = len(shape0) - len(sax_in0) + 1
-        nb1 = len(shape1) - len(sax_in1) + 1
-        assert nb0 == nb1, f"'...' expands to {nb0} and {nb1} axes in the two inputs"
-        in0 = in0.replace('0', free[:nb0])
-        in1 = in1.replace('0', free[:nb1])
-        out = out.replace('0', free[:nb0])
+def _expand(term: str, ndim: int, ell: str, what: str, expr: str) -> list[str]:
+    """Expand '...' in one operand term against its actual rank."""
+    if not _TERM_RE.match(term):
+        raise ValueError(f"einsum string {expr!r} is invalid: subscripts must be [a-zA-Z] and '...'")
+    if '...' in term:
+        named = term.replace('...', '')
+        n_ell = ndim - len(named)
+        if n_ell < 0:
+            raise ValueError(f'{what} requires at least {len(named)} dims, got {ndim}')
+        labels = list(term.replace('...', ell[len(ell) - n_ell :]))
     else:
-        if '0' in sax_in0:
-            if len(sax_in0) - 1 > len(shape0):
-                raise ValueError(f'Input0 requires at least {len(sax_in0) - 1} dims, got {len(shape0)}')
-            nb = len(shape0) - len(sax_in0) + 1
-            in0 = in0.replace('0', free[:nb])
-            out = out.replace('0', free[:nb])
-        elif len(sax_in0) != len(shape0):
-            raise ValueError(f'Input0 requires {len(sax_in0)} dims, got {len(shape0)}')
-        if '0' in sax_in1:
-            if len(sax_in1) - 1 > len(shape1):
-                raise ValueError(f'Input1 requires at least {len(sax_in1) - 1} dims, got {len(shape1)}')
-            nb = len(shape1) - len(sax_in1) + 1
-            in1 = in1.replace('0', free[:nb])
-            out = out.replace('0', free[:nb])
-        elif len(sax_in1) != len(shape1):
-            raise ValueError(f'Input1 requires {len(sax_in1)} dims, got {len(shape1)}')
-
-    ax_in0, ax_in1, ax_out = list(in0), list(in1), list(out)
-    for a in set(ax_in0) & set(ax_in1):
-        d0, d1 = shape0[ax_in0.index(a)], shape1[ax_in1.index(a)]
-        if d0 != d1:
-            raise ValueError(f"Dimension mismatch for subscript '{a}': {d0} vs {d1}")
-
-    out_shape = tuple(shape0[ax_in0.index(a)] if a in ax_in0 else shape1[ax_in1.index(a)] for a in ax_out)
-    return f'{in0},{in1}->{out}', out_shape
+        labels = list(term)
+        if len(labels) != ndim:
+            raise ValueError(f'{what} requires {len(labels)} dims, got {ndim}')
+    seen: set[str] = set()
+    for lab in labels:
+        if lab in seen:
+            orig = lab if lab in term else '...'
+            raise ValueError(f"einsum string {expr!r} is invalid: {what} includes '{orig}' multiple times")
+        seen.add(lab)
+    return labels
 
 
-def parse_einsum(fn: str, input_shape0: tuple[int, ...], input_shape1: tuple[int, ...]) -> EinsumRecipe:
-    fn, _ = _validate_einsum_expr(fn, input_shape0, input_shape1)
-    _in, _out = fn.split('->')
-    _in0, _in1 = _in.split(',')
-    in0, in1, out = list(_in0), list(_in1), list(_out)
-    s_in0, s_in1, s_out = set(in0), set(in1), set(out)
-    common = s_in0 & s_in1
-    contract = sorted(common - s_out, key=in1.index)
-    inplace = sorted(common & s_out, key=in1.index)
-    invariant0 = sorted((s_out - common) & s_in0, key=in0.index)
-    invariant1 = sorted((s_out - common) & s_in1, key=in1.index)
-    direct_sum_axis = (
-        tuple(sorted(in0.index(x) for x in s_in0 - s_out - common)),
-        tuple(sorted(in1.index(x) for x in s_in1 - s_out - common)),
+def plan_einsum(expr: str, shape0: tuple[int, ...], shape1: tuple[int, ...]) -> EinsumPlan:
+    """Validate ``expr`` against the operand shapes and build the lowering plan."""
+    t0, t1, t_out = _split_terms(expr)
+
+    # ellipsis labels come from letters the expression itself never uses
+    used = set(t0) | set(t1) | set(t_out)
+    ell = ''.join(c for c in _LETTERS if c not in used)
+
+    has_ell = ('...' in t0, '...' in t1, '...' in t_out)
+    if any(has_ell[:2]) and not has_ell[2]:
+        raise ValueError(f'einsum string {expr!r} is invalid: inputs broadcast but output does not')
+    if has_ell[2] and not any(has_ell[:2]):
+        raise ValueError(f'einsum string {expr!r} is invalid: output broadcasts but inputs do not')
+
+    lab0 = _expand(t0, len(shape0), ell, 'input0', expr)
+    lab1 = _expand(t1, len(shape1), ell, 'input1', expr)
+    if has_ell[0] and has_ell[1]:
+        n0 = len(lab0) - len(t0.replace('...', ''))
+        n1 = len(lab1) - len(t1.replace('...', ''))
+        if n0 != n1:
+            raise ValueError(f"einsum string {expr!r}: '...' expands to {n0} and {n1} axes in the two inputs")
+    n_ell_out = max(len(lab0) - len(t0.replace('...', '')), len(lab1) - len(t1.replace('...', '')), 0)
+    lab_out = list(t_out.replace('...', ell[len(ell) - n_ell_out :] if has_ell[2] else ''))
+    seen: set[str] = set()
+    for lab in lab_out:
+        if lab in seen:
+            orig = lab if lab in t_out else '...'
+            raise ValueError(f"einsum string {expr!r} is invalid: output includes '{orig}' multiple times")
+        seen.add(lab)
+
+    dims: dict[str, int] = {}
+    for labels, shape in ((lab0, shape0), (lab1, shape1)):
+        for lab, d in zip(labels, shape):
+            if dims.setdefault(lab, d) != d:
+                raise ValueError(f"Dimension mismatch for subscript '{lab}': {dims[lab]} vs {d}")
+    if unknown := set(lab_out) - set(lab0) - set(lab1):
+        raise ValueError(f'einsum string {expr!r} is invalid: output subscripts {unknown} not found in inputs')
+
+    s0, s1, s_out = set(lab0), set(lab1), set(lab_out)
+    batch = [lab for lab in lab0 if lab in s1 and lab in s_out]
+    contracted = [lab for lab in lab0 if lab in s1 and lab not in s_out]
+    free0 = [lab for lab in lab0 if lab not in s1 and lab in s_out]
+    free1 = [lab for lab in lab1 if lab not in s0 and lab in s_out]
+    collapse0 = tuple(a for a, lab in enumerate(lab0) if lab not in s1 and lab not in s_out)
+    collapse1 = tuple(a for a, lab in enumerate(lab1) if lab not in s0 and lab not in s_out)
+
+    kept0 = [lab for a, lab in enumerate(lab0) if a not in collapse0]
+    kept1 = [lab for a, lab in enumerate(lab1) if a not in collapse1]
+    perm0 = tuple(kept0.index(lab) for lab in batch + free0 + contracted)
+    perm1 = tuple(kept1.index(lab) for lab in batch + contracted + free1)
+
+    stacked = batch + free0 + free1
+    return EinsumPlan(
+        collapse0=collapse0,
+        collapse1=collapse1,
+        perm0=perm0,
+        perm1=perm1,
+        b=prod(dims[lab] for lab in batch),
+        m=prod(dims[lab] for lab in free0),
+        k=prod(dims[lab] for lab in contracted),
+        n=prod(dims[lab] for lab in free1),
+        stacked_shape=tuple(dims[lab] for lab in stacked),
+        out_perm=tuple(stacked.index(lab) for lab in lab_out),
     )
 
-    contract_idxs = tuple(map(in0.index, contract)), tuple(map(in1.index, contract))
-    inplace_idxs = tuple(map(in0.index, inplace)), tuple(map(in1.index, inplace))
-    invariant_idxs = tuple(map(in0.index, invariant0)), tuple(map(in1.index, invariant1))
 
-    inplace_shape = tuple(input_shape0[i] for i in inplace_idxs[0])
-    invariant_shape0 = tuple(input_shape0[i] for i in invariant_idxs[0])
-    invariant_shape1 = tuple(input_shape1[i] for i in invariant_idxs[1])
+def _run_plan(plan: EinsumPlan, x0, x1) -> np.ndarray:
+    """Execute the plan: B independent [M,K] @ [K,N] matmuls."""
+    from ..fixed_variable_array import FixedVariableArray
 
-    out_transpose = tuple(int(i) for i in np.argsort(tuple(map(out.index, inplace + invariant0 + invariant1))))
+    def _collapse(x, axes):
+        if not axes:
+            return x
+        y = np.sum(x, axis=axes)
+        if isinstance(x, FixedVariableArray) and not isinstance(y, FixedVariableArray):
+            # a full collapse unwraps to a scalar FixedVariable; re-wrap as 0-d
+            y = FixedVariableArray(np.array(y, dtype=object), x.solver_options, hwconf=x.hwconf)
+        return y
 
-    return EinsumRecipe(
-        direct_sum_axis=direct_sum_axis,
-        in_transpose_idxs=(
-            inplace_idxs[0] + invariant_idxs[0] + contract_idxs[0],
-            inplace_idxs[1] + invariant_idxs[1] + contract_idxs[1],
-        ),
-        out_interpert_shape=inplace_shape + invariant_shape0 + invariant_shape1,
-        out_transpose_idxs=out_transpose,
-        L0=prod(invariant_shape0),
-        L1=prod(invariant_shape1),
-        I=prod(inplace_shape),
-        C=prod(input_shape0[i] for i in contract_idxs[0]),
-    )
+    x0 = _collapse(x0, plan.collapse0)
+    x1 = _collapse(x1, plan.collapse1)
+    x0 = x0.transpose(plan.perm0).reshape((plan.b, plan.m, plan.k))
+    x1 = x1.transpose(plan.perm1).reshape((plan.b, plan.k, plan.n))
 
-
-def _exec_einsum(recipe: EinsumRecipe, input0: np.ndarray, input1: np.ndarray) -> np.ndarray:
-    sum0, sum1 = recipe['direct_sum_axis']
-    if sum0:
-        input0 = np.sum(input0, axis=sum0)
-    if sum1:
-        input1 = np.sum(input1, axis=sum1)
-    input0 = input0.transpose(recipe['in_transpose_idxs'][0]).ravel()
-    input1 = input1.transpose(recipe['in_transpose_idxs'][1]).ravel()
-    out_dtype = object if input0.dtype == object or input1.dtype == object else np.float64
-    L0, L1, I, C = recipe['L0'], recipe['L1'], recipe['I'], recipe['C']
-    output = np.zeros(L0 * L1 * I, dtype=out_dtype)
-
-    for l0 in range(L0):
-        for i in range(I):
-            A = input1[i * L1 * C : (i + 1) * L1 * C].reshape((L1, C))
-            B = input0[(i * L0 + l0) * C : (i * L0 + l0 + 1) * C]
-            output[(i * L0 + l0) * L1 : (i * L0 + l0 + 1) * L1] = A @ B
-    return output.reshape(recipe['out_interpert_shape']).transpose(recipe['out_transpose_idxs'])
+    symbolic = isinstance(x0, FixedVariableArray) or isinstance(x1, FixedVariableArray)
+    out = np.empty((plan.b, plan.m, plan.n), dtype=object if symbolic else np.float64)
+    for bi in range(plan.b):
+        block = x0[bi] @ x1[bi]
+        out[bi] = block._vars if isinstance(block, FixedVariableArray) else block
+    return out.reshape(plan.stacked_shape).transpose(plan.out_perm)
 
 
 def einsum(fn: str, input0, input1):
     """Einsum over two operands; symbolic arrays route through the CMVM matmul."""
     from ..fixed_variable_array import FixedVariableArray
 
-    fg0 = isinstance(input0, FixedVariableArray)
-    fg1 = isinstance(input1, FixedVariableArray)
-    recipe = parse_einsum(fn, input0.shape, input1.shape)
-    r = _exec_einsum(recipe, input0, input1)
-    if fg0:
-        return FixedVariableArray(r, input0.solver_options)
-    if fg1:
-        return FixedVariableArray(r, input1.solver_options)
+    plan = plan_einsum(fn, input0.shape, input1.shape)
+    r = _run_plan(plan, input0, input1)
+    for operand in (input0, input1):
+        if isinstance(operand, FixedVariableArray):
+            return FixedVariableArray(r, operand.solver_options)
     return r

@@ -1,61 +1,102 @@
 """Hardware sorting networks: compare-swap cells built from MSB muxes.
 
-Supports Batcher odd-even mergesort (default) and bitonic sort; non-pow2
-lengths are padded with out-of-range sentinels, and an optional payload
-(``aux_value``) rides along for argsort-style gathers
-(reference trace/ops/sorting.py).
+The network is built as *data* first — a list of ``(i, j, up)`` comparator
+cells — and then applied to the symbolic rows, so the wiring (Batcher
+odd-even mergesort by default, bitonic optionally) is decoupled from the
+cell implementation. Non-pow2 lengths are padded with out-of-range
+sentinels; an optional payload (``aux_value``) rides along with each key
+for argsort-style gathers.
+
+Behavioral parity with src/da4ml/trace/ops/sorting.py of calad0i/da4ml
+(same cell semantics and tie behavior); the network construction here is
+the recursive odd-even-merge / bitonic formulations, emitted as comparator
+lists rather than executed in place.
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
 from math import ceil, log2
 
 import numpy as np
-from numpy.typing import NDArray
 
 from ..fixed_variable import FixedVariable
 
 
-def cmp_swap(a, b, ascending: bool):
-    """Sort rows a, b by their first element; the rest is payload."""
-    ka, kb = a[0], b[0]
-    k = ka <= kb
-    a, b = zip(*[(k.msb_mux(va, vb, zt_sensitive=False), k.msb_mux(vb, va, zt_sensitive=False)) for va, vb in zip(a, b)])
-    if not ascending:
-        return b, a
-    return a, b
+@lru_cache(maxsize=None)
+def _batcher_network(n: int) -> tuple[tuple[int, int, bool], ...]:
+    """Comparator list for Batcher's odd-even mergesort of ``n`` (pow2) wires."""
+    cells: list[tuple[int, int, bool]] = []
+
+    def merge(lo: int, hi: int, stride: int) -> None:
+        # merge the two sorted halves of wires lo..hi taken at ``stride``
+        step = stride * 2
+        if step < hi - lo:
+            merge(lo, hi, step)
+            merge(lo + stride, hi, step)
+            for w in range(lo + stride, hi - stride, step):
+                cells.append((w, w + stride, True))
+        else:
+            cells.append((lo, lo + stride, True))
+
+    def build(lo: int, hi: int) -> None:
+        if hi - lo >= 1:
+            mid = lo + (hi - lo) // 2
+            build(lo, mid)
+            build(mid + 1, hi)
+            merge(lo, hi, 1)
+
+    build(0, n - 1)
+    return tuple(cells)
 
 
-def _bitonic_merge(a: NDArray, ascending: bool):
-    if len(a) <= 1:
-        return
-    half = len(a) // 2
-    for i in range(half):
-        a[i], a[i + half] = cmp_swap(a[i], a[i + half], ascending)
-    _bitonic_merge(a[:half], ascending)
-    _bitonic_merge(a[half:], ascending)
+@lru_cache(maxsize=None)
+def _bitonic_network(n: int) -> tuple[tuple[int, int, bool], ...]:
+    """Comparator list for a bitonic sort of ``n`` (pow2) wires."""
+    cells: list[tuple[int, int, bool]] = []
+
+    def merge(lo: int, span: int, up: bool) -> None:
+        if span == 1:
+            return
+        half = span // 2
+        for w in range(lo, lo + half):
+            cells.append((w, w + half, up))
+        merge(lo, half, up)
+        merge(lo + half, half, up)
+
+    def build(lo: int, span: int, up: bool) -> None:
+        if span == 1:
+            return
+        half = span // 2
+        build(lo, half, True)
+        build(lo + half, half, False)
+        merge(lo, span, up)
+
+    build(0, n, True)
+    return tuple(cells)
 
 
-def _bitonic_sort(a: NDArray, ascending: bool):
-    if len(a) <= 1:
-        return
-    half = len(a) // 2
-    _bitonic_sort(a[:half], True)
-    _bitonic_sort(a[half:], False)
-    _bitonic_merge(a, ascending)
+def _apply_cell(rows, i: int, j: int, up: bool) -> None:
+    """One comparator: after this, key(rows[i]) <= key(rows[j]) iff ``up``.
+
+    The swap condition is a single comparison of the keys (column 0); every
+    column of both rows is then routed through an MSB mux pair on that
+    condition, so payload columns travel with their key. Tie behavior matches
+    the reference cell: equal keys hold position in an up cell and exchange
+    in a down cell.
+    """
+    top, bot = rows[i], rows[j]
+    swap = (top[0] > bot[0]) if up else (top[0] <= bot[0])
+    n_col = len(top)
+    new_top = np.empty(n_col, dtype=object)
+    new_bot = np.empty(n_col, dtype=object)
+    for c in range(n_col):
+        new_top[c] = swap.msb_mux(bot[c], top[c], zt_sensitive=False)
+        new_bot[c] = swap.msb_mux(top[c], bot[c], zt_sensitive=False)
+    rows[i], rows[j] = new_top, new_bot
 
 
-def batcher_odd_even_merge_sort(a: NDArray, ascending: bool):
-    """Batcher odd-even mergesort network (standard formulation)."""
-    n = a.shape[0]
-    for _p in range(ceil(log2(n))):
-        p = 2**_p
-        for _k in range(_p, -1, -1):
-            k = 2**_k
-            for j in range(k % p, n - k, 2 * k):
-                for i in range(min(k, n - j - k)):
-                    if (i + j) // (2 * p) == (i + j + k) // (2 * p):
-                        a[i + j], a[i + j + k] = cmp_swap(a[i + j], a[i + j + k], ascending)
+_NETWORKS = {'batcher': _batcher_network, 'bitonic': _bitonic_network}
 
 
 def _pad_to_pow2(a):
@@ -63,17 +104,17 @@ def _pad_to_pow2(a):
     assert a.ndim == 3
     size = a.shape[-2]
     n_pad = 2 ** ceil(log2(size)) - size
-    n_pad_low, n_pad_high = n_pad // 2, n_pad - n_pad // 2
+    n_low, n_high = n_pad // 2, n_pad - n_pad // 2
     low, high, _ = a.lhs
-    low_pad = FixedVariable.from_const(float(np.min(low)) - 1, hwconf=a.hwconf)
-    high_pad = FixedVariable.from_const(float(np.max(high)) + 1, hwconf=a.hwconf)
-    low_block = np.full((a.shape[0], n_pad_low, a.shape[-1]), low_pad)
-    high_block = np.full((a.shape[0], n_pad_high, a.shape[-1]), high_pad)
-    return np.concatenate([low_block, a, high_block], axis=-2), n_pad_low, n_pad_high
+    below = FixedVariable.from_const(float(np.min(low)) - 1, hwconf=a.hwconf)
+    above = FixedVariable.from_const(float(np.max(high)) + 1, hwconf=a.hwconf)
+    low_block = np.full((a.shape[0], n_low, a.shape[-1]), below)
+    high_block = np.full((a.shape[0], n_high, a.shape[-1]), above)
+    return np.concatenate([low_block, a, high_block], axis=-2), n_low, n_high
 
 
 def sort(a, axis: int | None = None, kind: str = 'batcher', aux_value=None):
-    from ..fixed_variable_array import FixedVariableArray
+    from ..fixed_variable_array import FixedVariableArray  # noqa: F401  (type anchor)
 
     if isinstance(a, np.ndarray):
         return np.sort(a, axis=axis)
@@ -95,18 +136,20 @@ def sort(a, axis: int | None = None, kind: str = 'batcher', aux_value=None):
     r = np.moveaxis(a, axis, -2).copy()
     shape = r.shape
     r = r.reshape(-1, sort_dim, r.shape[-1])
-    r, n_pad_low, n_pad_high = _pad_to_pow2(r)
+    r, n_low, n_high = _pad_to_pow2(r)
 
-    kind = kind.lower()
-    for i in range(len(r)):
-        if kind == 'bitonic':
-            _bitonic_sort(r._vars[i], ascending=True)
-        elif kind == 'batcher':
-            batcher_odd_even_merge_sort(r._vars[i], ascending=True)
-        else:
-            raise ValueError(f'Unsupported sorting algorithm: {kind}')
+    try:
+        network = _NETWORKS[kind.lower()](r.shape[1])
+    except KeyError:
+        raise ValueError(f'Unsupported sorting algorithm: {kind}') from None
+    for lane in range(len(r)):
+        rows = list(r._vars[lane])
+        for i, j, up in network:
+            _apply_cell(rows, i, j, up)
+        for i, row in enumerate(rows):
+            r._vars[lane, i] = row
 
-    r = r[:, n_pad_low : r.shape[1] - n_pad_high, :].reshape(shape)
+    r = r[:, n_low : r.shape[1] - n_high, :].reshape(shape)
     r = np.moveaxis(r, -2, axis)
     if aux_value is not None:
         return r[..., 0], r[..., 1:]

@@ -1,0 +1,117 @@
+"""Multi-device tests on the virtual 8-device CPU mesh (conftest.py).
+
+These exercise the framework's two parallel axes (SURVEY.md §2.6) for real:
+sharded DAIS batch inference must stay bit-exact vs the numpy oracle, the
+sharded candidate search must return exactly the same solutions as the
+unsharded one, and the batch-padding helpers must place shards as promised.
+Mirrors the sample/candidate parallelism of the reference's OpenMP paths
+(dais/bindings.cc:58-96, cmvm/api.cc:208-238 of calad0i/da4ml).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from da4ml_tpu.ir.dais_binary import decode
+from da4ml_tpu.parallel import batch_sharding, default_mesh, pad_to_multiple, shard_batch
+from da4ml_tpu.runtime.jax_backend import DaisExecutor
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+N_DEV = 8
+
+
+@pytest.fixture(scope='module')
+def mesh() -> Mesh:
+    devices = np.asarray(jax.devices()[:N_DEV])
+    assert devices.size == N_DEV, 'conftest must provide 8 virtual CPU devices'
+    return Mesh(devices, ('batch',))
+
+
+@pytest.fixture(scope='module')
+def small_comb():
+    rng = np.random.default_rng(7)
+    inp = FixedVariableArrayInput(6, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 3), np.full(6, 2))
+    x = x @ rng.integers(-8, 8, (6, 5)).astype(np.float64)
+    x = x.relu(i=np.full(5, 5), f=np.full(5, 2))
+    x = x @ rng.integers(-4, 4, (5, 3)).astype(np.float64)
+    return comb_trace(inp, x)
+
+
+def test_pad_to_multiple():
+    x = np.arange(10.0).reshape(10, 1)
+    padded, n_pad = pad_to_multiple(x, N_DEV)
+    assert padded.shape == (16, 1) and n_pad == 6
+    np.testing.assert_array_equal(padded[:10], x)
+    np.testing.assert_array_equal(padded[10:], 0)
+    same, none = pad_to_multiple(np.zeros((16, 2)), N_DEV)
+    assert same.shape == (16, 2) and none == 0
+
+
+def test_shard_batch_placement(mesh):
+    x = np.arange(20.0 * 3).reshape(20, 3)
+    arr, n_pad = shard_batch(x, mesh)
+    assert n_pad == 4 and arr.shape == (24, 3)
+    assert isinstance(arr.sharding, NamedSharding)
+    assert arr.sharding.spec == PartitionSpec('batch')
+    shards = arr.addressable_shards
+    assert len(shards) == N_DEV
+    assert {s.data.shape for s in shards} == {(24 // N_DEV, 3)}
+    # every device holds exactly one shard, and concatenation restores the batch
+    assert len({s.device for s in shards}) == N_DEV
+    back = np.concatenate([np.asarray(s.data) for s in sorted(shards, key=lambda s: s.index[0].start)])
+    np.testing.assert_array_equal(back[:20], x)
+
+
+def test_default_mesh_covers_all_devices():
+    m = default_mesh()
+    assert m.devices.size == len(jax.devices())
+    assert m.axis_names == ('batch',)
+
+
+def test_predict_sharded_bit_exact(mesh, small_comb):
+    """Sharded inference == numpy oracle, including a non-divisible batch."""
+    ex = DaisExecutor(decode(small_comb.to_binary()))
+    rng = np.random.default_rng(0)
+    for n in (N_DEV * 4, N_DEV * 2 + 3, 1):  # divisible, padded, single sample
+        data = rng.uniform(-8, 8, (n, small_comb.shape[0]))
+        out = ex.predict_sharded(data, mesh)
+        ref = small_comb.predict(data, backend='numpy')
+        assert out.shape == ref.shape
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_solve_jax_many_sharded_matches_unsharded(mesh):
+    """Mesh-sharded candidate search returns the same solutions as unsharded."""
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    rng = np.random.default_rng(3)
+    kernels = [rng.integers(-8, 8, (5, 5)).astype(np.float64) for _ in range(2 * N_DEV + 1)]
+    plain = solve_jax_many(kernels)
+    sharded = solve_jax_many(kernels, mesh=Mesh(np.asarray(jax.devices()[:N_DEV]), ('lanes',)))
+    assert len(plain) == len(sharded) == len(kernels)
+    for k, p, s in zip(kernels, plain, sharded):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+        assert s.cost == p.cost
+        assert s.latency == p.latency
+
+
+def test_solve_jax_many_sharded_exactness_stress(mesh):
+    """Sharded search over mixed shapes keeps the kernel-identity oracle."""
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    rng = np.random.default_rng(11)
+    shapes = [(3, 7), (7, 3), (6, 6), (4, 9), (9, 4), (5, 5), (8, 2), (2, 8), (6, 3)]
+    kernels = [rng.integers(-16, 16, s).astype(np.float64) for s in shapes]
+    lanes_mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ('lanes',))
+    for k, s in zip(kernels, solve_jax_many(kernels, mesh=lanes_mesh)):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+
+
+def test_batch_sharding_spec(mesh):
+    sh = batch_sharding(mesh)
+    assert sh.spec == PartitionSpec('batch')
+    assert sh.mesh.axis_names == ('batch',)

@@ -126,6 +126,18 @@ CASES = {
     'matmul_int': (lambda x: x @ np.arange(-2 * N, 2 * N).reshape(N, 4), None),
     'matmul_frac': (lambda x: x @ (np.arange(-2 * N, 2 * N).reshape(N, 4) * 0.25), None),
     'einsum': (lambda x: np.einsum('i,ij->j', x, np.arange(N * 3).reshape(N, 3) * 1.0), None),
+    'einsum_rev': (lambda x: np.einsum('ij,j->i', np.arange(N * 3).reshape(3, N) * 1.0, x), None),
+    'einsum_elemwise': (lambda x: np.einsum('...i,...i->...i', x[:4], x[4:]), None),
+    'einsum_batched_mm': (
+        lambda x: np.einsum('...ij,...jk->...ik', x.reshape(2, 2, 2), x.reshape(2, 2, 2)),
+        None,
+    ),
+    'einsum_bcast_l': (lambda x: np.einsum('...i,ij->...j', x.reshape(2, 4), np.arange(12.0).reshape(4, 3)), None),
+    'einsum_bcast_r': (lambda x: np.einsum('ij,...j->...i', np.arange(12.0).reshape(3, 4), x.reshape(2, 4)), None),
+    'einsum_outer': (lambda x: np.einsum('i,j->ij', x[:4], x[4:]), None),
+    'einsum_collapse': (lambda x: np.einsum('ij,jk->k', x.reshape(2, 4), np.arange(12.0).reshape(4, 3)), None),
+    'einsum_scalar_out': (lambda x: np.einsum('i,i->', x, np.arange(N) * 1.0), None),
+    'einsum_full_collapse': (lambda x: np.einsum('i,j->j', x, np.arange(4.0)), None),
     'dot': (lambda x: np.dot(x, np.arange(N) * 1.0), None),
     'gt': (lambda x: x[:4] > x[4:], lambda x: (x[:4] > x[4:]).astype(np.float64)),
     'le': (lambda x: x[:4] <= x[4:], lambda x: (x[:4] <= x[4:]).astype(np.float64)),
