@@ -139,20 +139,51 @@ def main():
     # configs rather than timing out without printing the JSON line
     budget_s = float(os.environ.get('DA4ML_BENCH_BUDGET_S', '420'))
     deadline = time.monotonic() + budget_s
+    # on CPU fallback also shrink the workloads — the recorded number is
+    # informational there, the real measurement happens on the TPU
+    limited = platform is None
+    detail['limited_cpu_fallback'] = limited
 
     # config 1 (headline): 16x16 int4 batch
-    k1 = [_rand_kernel(rng, 16, 16, 4) for _ in range(n1)]
+    k1 = [_rand_kernel(rng, 16, 16, 4) for _ in range(min(n1, 16) if limited else n1)]
     c1 = _run_config('1_16x16_int4', k1, host_backend)
     detail['configs'] = [c1]
     # config 2: JEDI-linear MLP layer kernels, 6-bit
-    k2 = [_rand_kernel(rng, ni, no, 6) for ni, no in ((16, 64), (64, 32), (32, 32), (32, 5))]
+    shapes2 = ((16, 64), (64, 32), (32, 32), (32, 5))
+    if limited:
+        shapes2 = tuple((ni, no) for ni, no in shapes2 if max(ni, no) <= 32)
+    k2 = [_rand_kernel(rng, ni, no, 6) for ni, no in shapes2]
     # config 3: random dim x bits sweep, batched
-    k3 = [_rand_kernel(rng, d, d, b) for d, b in ((8, 2), (8, 8), (16, 4), (32, 4), (32, 8), (64, 2), (64, 6))]
+    shapes3 = ((8, 2), (8, 8), (16, 4), (32, 4), (32, 8), (64, 2), (64, 6))
+    if limited:
+        shapes3 = tuple((d, b) for d, b in shapes3 if d <= 16)
+    k3 = [_rand_kernel(rng, d, d, b) for d, b in shapes3]
     for name, ks in (('2_jedi_mlp_layers', k2), ('3_dim_bits_sweep', k3)):
         if time.monotonic() > deadline:
             detail.setdefault('skipped_configs', []).append(name)
             continue
         detail['configs'].append(_run_config(name, ks, host_backend))
+
+    # fused Pallas selection vs XLA select microbench (real TPU only)
+    if platform is not None and platform != 'cpu' and time.monotonic() < deadline:
+        try:
+            from da4ml_tpu.cmvm.jax_search import _build_cse_fn
+
+            os.environ['DA4ML_JAX_SELECT'] = 'pallas'
+            _build_cse_fn.cache_clear()
+            try:
+                _, p_steady, p_compile = _jax_solve(k1)
+            finally:
+                os.environ.pop('DA4ML_JAX_SELECT', None)
+                _build_cse_fn.cache_clear()
+            p_rate = round(len(k1) / p_steady, 3)
+            detail['pallas_select'] = {
+                'jax_rate': p_rate,
+                'vs_xla_select': round(p_rate / c1['jax_rate'], 3) if c1['jax_rate'] else None,
+                'jax_compile_s': round(p_compile, 2),
+            }
+        except Exception as e:
+            detail['pallas_select'] = {'error': f'{type(e).__name__}: {e}'[:200]}
 
     print(
         json.dumps(

@@ -1,0 +1,37 @@
+"""da4ml_tpu — a TPU-native distributed-arithmetic compiler for quantized NNs.
+
+A ground-up JAX/XLA re-design of the capabilities of calad0i/da4ml: symbolic
+fixed-point tracing to the DAIS IR, a CMVM adder-graph optimizer whose
+candidate search runs batched on TPU, bit-exact interpreters (numpy / XLA /
+native C++), and Verilog/VHDL/HLS code generation.
+"""
+
+from .ir import CombLogic, LookupTable, Op, Pipeline, Precision, QInterval, minimal_kif
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'CombLogic',
+    'Pipeline',
+    'Op',
+    'QInterval',
+    'Precision',
+    'LookupTable',
+    'minimal_kif',
+    'solve',
+    'trace_model',
+    '__version__',
+]
+
+
+def __getattr__(name):
+    # heavy surfaces resolve lazily so `import da4ml_tpu` stays light
+    if name == 'solve':
+        from .cmvm import solve
+
+        return solve
+    if name == 'trace_model':
+        from .converter import trace_model
+
+        return trace_model
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,39 @@
+"""``da4ml-tpu`` command line interface.
+
+Two subcommands (parity with the reference console script, reference
+src/da4ml/_cli/__init__.py:8-27):
+
+- ``convert`` — model file (.keras/.h5 via the keras plugin, or a saved
+  CombLogic/Pipeline .json) → RTL/HLS project with optional bit-exact
+  validation;
+- ``report`` — parse vendor synthesis reports from project directories into
+  a summary table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog='da4ml-tpu', description='TPU-native distributed-arithmetic compiler')
+    sub = parser.add_subparsers(dest='command', required=True)
+
+    from .convert import add_convert_args, convert_main
+    from .report import add_report_args, report_main
+
+    p_convert = sub.add_parser('convert', help='Convert a model into an RTL/HLS project')
+    add_convert_args(p_convert)
+    p_convert.set_defaults(func=convert_main)
+
+    p_report = sub.add_parser('report', help='Summarize synthesis reports of project directories')
+    add_report_args(p_report)
+    p_report.set_defaults(func=report_main)
+
+    args = parser.parse_args(argv)
+    return args.func(args) or 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

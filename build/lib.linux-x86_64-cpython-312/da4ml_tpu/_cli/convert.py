@@ -1,0 +1,213 @@
+"""``da4ml-tpu convert`` — model file → RTL/HLS project.
+
+Accepts a Keras model (.keras/.h5, requires the keras tracer plugin) or a
+saved CombLogic/Pipeline ``.json``. Writes the project, runs a bit-exact
+DAIS-vs-framework mismatch report, and can optionally compile and validate
+the generated RTL/HLS emulator against the interpreter (parity: reference
+src/da4ml/_cli/convert.py:8-147).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import numpy as np
+
+
+def _load_solution(path: Path):
+    """Load a saved CombLogic or Pipeline from .json."""
+    from ..ir import CombLogic, Pipeline
+
+    blob = json.loads(Path(path).read_text())
+    if isinstance(blob, dict) and 'stages' in blob:
+        return Pipeline.from_dict(blob)
+    return CombLogic.from_dict(blob)
+
+
+def _emulate(da_model, flavor: str, data: np.ndarray) -> np.ndarray:
+    """Run the generated project: compiled emulator if the toolchain exists
+    (Verilator for RTL, g++ for HLS), else the bundled netlist simulator.
+
+    Real build failures propagate — only a missing toolchain falls back."""
+    if flavor not in ('verilog', 'vhdl') or da_model.emulation_available():
+        return da_model.compile().predict(data)
+    print('[WARNING] verilator/ghdl not found; validating with the bundled netlist simulator instead of compiled RTL.')
+    if flavor == 'verilog':
+        from ..codegen.rtl.verilog.netlist_sim import simulate_comb
+    else:
+        from ..codegen.rtl.vhdl.netlist_sim import simulate_comb_vhdl as simulate_comb
+
+    sol = da_model.solution
+    stages = sol.stages if hasattr(sol, 'stages') else (sol,)
+    cur = data
+    for si, stage in enumerate(stages):
+        cur = simulate_comb(stage, name=f's{si}', data=cur)
+    return cur
+
+
+def convert(
+    model_path: Path,
+    outdir: Path,
+    n_test_sample: int = 1024,
+    clock_period: float = 5.0,
+    clock_uncertainty: float = 10.0,
+    flavor: str = 'verilog',
+    latency_cutoff: float = 5,
+    part_name: str = 'xcvu13p-flga2577-2-e',
+    verbose: int = 1,
+    validate_rtl: bool = False,
+    hwconf: tuple[int, int, int] = (1, -1, -1),
+    hard_dc: int = 2,
+    n_threads: int = 0,
+    inputs_kif: tuple[int, int, int] | None = None,
+    solver_backend: str = 'auto',
+):
+    from ..codegen import HLSModel, RTLModel, VHDLModel
+
+    model_path, outdir = Path(model_path), Path(outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+
+    model = None
+    if model_path.suffix in {'.h5', '.keras'}:
+        try:
+            import keras  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError('Converting .keras/.h5 models requires keras to be installed.') from e
+        from ..converter import trace_model
+        from ..trace import HWConfig, comb_trace
+
+        model = keras.models.load_model(model_path, compile=False)
+        if verbose > 1:
+            model.summary()
+        inp, out = trace_model(
+            model,
+            HWConfig(*hwconf),
+            {'hard_dc': hard_dc, 'backend': solver_backend},
+            verbose > 1,
+            inputs_kif=inputs_kif,
+        )
+        comb = comb_trace(inp, out)
+    elif model_path.suffix == '.json':
+        comb = _load_solution(model_path)
+    else:
+        raise ValueError(f'Unsupported model file format: {model_path.suffix}')
+
+    if flavor == 'verilog':
+        da_model = RTLModel(
+            comb, 'model', outdir, latency_cutoff=latency_cutoff, part=part_name,
+            clock_period=clock_period, clock_uncertainty=clock_uncertainty / 100,
+        )  # fmt: skip
+    elif flavor == 'vhdl':
+        da_model = VHDLModel(
+            comb, 'model', outdir, latency_cutoff=latency_cutoff, part=part_name,
+            clock_period=clock_period, clock_uncertainty=clock_uncertainty / 100,
+        )  # fmt: skip
+    elif flavor in ('vitis', 'hls'):
+        da_model = HLSModel(
+            comb, 'model', outdir, latency_cutoff=latency_cutoff, part=part_name, clock_period=clock_period
+        )
+    else:
+        raise ValueError(f'Unknown flavor: {flavor}')
+
+    da_model.write()
+    solution = da_model.solution
+    if verbose > 1:
+        print(repr(da_model))
+    if verbose:
+        print(f'[INFO] Project written to {outdir} (flavor={flavor})')
+
+    if not n_test_sample:
+        return da_model
+
+    n_in = solution.shape[0] if not hasattr(solution, 'stages') else solution.stages[0].shape[0]
+    rng = np.random.default_rng(0)
+
+    if model is not None:
+        data_in = [rng.uniform(-32, 32, (n_test_sample, *i.shape[1:])).astype(np.float32) for i in model.inputs]
+        y_model = model.predict(data_in if len(data_in) > 1 else data_in[0], batch_size=16384, verbose=0)
+        if isinstance(y_model, list):
+            y_model = np.concatenate([y.reshape(n_test_sample, -1) for y in y_model], axis=1)
+        else:
+            y_model = np.asarray(y_model).reshape(n_test_sample, -1)
+        flat_in = np.concatenate([d.reshape(n_test_sample, -1) for d in data_in], axis=1)
+        y_comb = solution.predict(flat_in, n_threads=n_threads)
+
+        mask = y_comb != y_model
+        ndiff, total = int(np.sum(mask)), int(y_comb.size)
+        if ndiff:
+            abs_diff = np.abs(y_comb - y_model)[mask]
+            rel_diff = abs_diff / (np.abs(y_model[mask]) + 1e-6)
+            stats = {
+                'max_diff': float(abs_diff.max()),
+                'max_rel_diff': float(rel_diff.max()),
+                'mean_diff': float(abs_diff.mean()),
+                'mean_rel_diff': float(rel_diff.mean()),
+            }
+            print(f'[WARNING] {ndiff}/{total} mismatches vs framework output: {stats}')
+        else:
+            stats = {'max_diff': 0.0, 'max_rel_diff': 0.0, 'mean_diff': 0.0, 'mean_rel_diff': 0.0}
+            if verbose:
+                print(f'[INFO] DAIS simulation matches framework: [0/{total}] mismatches.')
+        (outdir / 'mismatches.json').write_text(
+            json.dumps({'n_total': total, 'n_mismatch': ndiff, **stats})
+        )
+    else:
+        data_in = rng.uniform(-32, 32, (n_test_sample, n_in)).astype(np.float64)
+        flat_in = data_in
+        y_comb = solution.predict(flat_in, n_threads=n_threads)
+
+    if validate_rtl:
+        y_emu = _emulate(da_model, flavor, flat_in)
+        total = int(y_comb.size)
+        if not np.array_equal(y_comb, y_emu):
+            raise RuntimeError(f'[CRITICAL] emulation validation failed: {int(np.sum(y_comb != y_emu))}/{total} mismatches!')
+        if verbose:
+            kind = 'RTL' if flavor in ('verilog', 'vhdl') else 'FUNC'
+            print(f'[INFO] {kind} validation passed: [0/{total}] mismatches.')
+
+    return da_model
+
+
+def convert_main(args: argparse.Namespace) -> int:
+    convert(
+        args.model,
+        args.outdir,
+        n_test_sample=args.n_test_sample,
+        clock_period=args.clock_period,
+        clock_uncertainty=args.clock_uncertainty,
+        flavor=args.flavor,
+        latency_cutoff=args.latency_cutoff,
+        part_name=args.part_name,
+        verbose=args.verbose,
+        validate_rtl=args.validate_rtl,
+        hwconf=tuple(args.hw_config),
+        hard_dc=args.delay_constraint,
+        n_threads=args.n_threads,
+        inputs_kif=tuple(args.inputs_kif) if args.inputs_kif else None,
+        solver_backend=args.solver_backend,
+    )
+    return 0
+
+
+def add_convert_args(parser: argparse.ArgumentParser):
+    parser.add_argument('model', type=Path, help='Model file: .keras/.h5 (needs keras) or saved CombLogic/Pipeline .json')
+    parser.add_argument('outdir', type=Path, help='Output project directory')
+    parser.add_argument('--n-test-sample', '-n', type=int, default=1024, help='Validation sample count (0 disables)')
+    parser.add_argument('--clock-period', '-c', type=float, default=5.0, help='Clock period in ns')
+    parser.add_argument('--clock-uncertainty', '-unc', type=float, default=10.0, help='Clock uncertainty in percent')
+    parser.add_argument('--flavor', type=str, default='verilog', choices=['verilog', 'vhdl', 'vitis', 'hls'])
+    parser.add_argument('--latency-cutoff', '-lc', type=float, default=5, help='Latency cutoff for pipelining (<=0: comb)')
+    parser.add_argument('--part-name', '-p', type=str, default='xcvu13p-flga2577-2-e', help='FPGA part name')
+    parser.add_argument('--verbose', '-v', default=1, type=int, help='0 silent, 1 info, 2 debug')
+    parser.add_argument('--validate-rtl', '-vr', action='store_true', help='Compile the emulator and check bit-exactness')
+    parser.add_argument('--n-threads', '-j', type=int, default=0, help='Threads for native DAIS simulation (0 = all)')
+    parser.add_argument(
+        '--hw-config', '-hc', type=int, nargs=3, metavar=('ADDER_SIZE', 'CARRY_SIZE', 'CUTOFF'), default=[1, -1, -1]
+    )
+    parser.add_argument('--delay-constraint', '-dc', type=int, default=2, help='hard_dc per CMVM block')
+    parser.add_argument('--inputs-kif', '-ikif', type=int, nargs=3, default=None, help='Input precision (keep_neg, int, frac)')
+    parser.add_argument(
+        '--solver-backend', type=str, default='auto', choices=['auto', 'cpu', 'cpp', 'jax'], help='CMVM solver backend'
+    )

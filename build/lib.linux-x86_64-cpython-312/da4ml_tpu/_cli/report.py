@@ -170,7 +170,7 @@ def load_project(path: str | Path) -> dict[str, Any]:
         lat = lat[-1]
 
     name = d.get('name', 'model')
-    rdirs = [path, path / 'reports', path / f'build_{name}' / 'reports']
+    rdirs = [path, path / 'reports']
 
     # Vivado
     f = _first_existing(*(r / n for r in rdirs for n in ('timing_summary.rpt', f'{name}_post_route_timing.rpt')))

@@ -1,0 +1,43 @@
+"""CMVM: multiplier-free constant matrix-vector multiply optimization.
+
+Public surface mirrors the reference's ``da4ml.cmvm`` (solver_options_t,
+``solve``) with an added ``backend`` axis: 'cpu' (host reference), 'cpp'
+(native solver), 'jax' (TPU batched search — the performance path).
+"""
+
+from typing import Callable, NotRequired, TypedDict
+
+from .api import _solve, minimal_latency, solve
+from .core import cmvm, solve_single, to_solution
+from .csd import csd_decompose, int_arr_to_csd
+from .decompose import kernel_decompose, prim_mst_dc
+
+
+class solver_options_t(TypedDict):
+    """Per-solve options merged over HWConfig defaults (reference cmvm/__init__.py:14-26)."""
+
+    method0: NotRequired[str]
+    method1: NotRequired[str]
+    hard_dc: NotRequired[int]
+    decompose_dc: NotRequired[int]
+    adder_size: NotRequired[int]
+    carry_size: NotRequired[int]
+    search_all_decompose_dc: NotRequired[bool]
+    offload_fn: NotRequired[Callable | None]
+    backend: NotRequired[str]
+    method0_candidates: NotRequired[list[str] | None]
+
+
+__all__ = [
+    'solve',
+    '_solve',
+    'minimal_latency',
+    'cmvm',
+    'solve_single',
+    'to_solution',
+    'csd_decompose',
+    'int_arr_to_csd',
+    'kernel_decompose',
+    'prim_mst_dc',
+    'solver_options_t',
+]

@@ -394,20 +394,12 @@ def solve_single_lanes(
 
     active = [k for k in range(len(lanes)) if k not in results]
     if active:
-        # bucket the shape-class dims so heterogeneous batches (e.g. a sweep
-        # over layer shapes) reuse compiled programs instead of paying one XLA
-        # compile per exact (P, O, B) triple. Zero-padded slots / outputs /
-        # bit planes can never be selected (count < 2), so bucketing is
-        # decision-identical; the padding waste is bounded by the quantum.
-        def _ceil_to(x: int, q: int) -> int:
-            return -(-x // q) * q
-
-        n_in_max = _ceil_to(max(lanes[k].csd.shape[0] for k in active), 8)
-        O = _ceil_to(max(lanes[k].csd.shape[1] for k in active), 8)
-        B = _ceil_to(max(lanes[k].csd.shape[2] for k in active), 2)
+        n_in_max = max(lanes[k].csd.shape[0] for k in active)
+        O = max(lanes[k].csd.shape[1] for k in active)
+        B = max(lanes[k].csd.shape[2] for k in active)
         digits_max = max(_lane_initial_digits(lanes[k]) for k in active)
         if step is None:
-            step = _ceil_to(max(16, -(-digits_max // 8)), 8)
+            step = max(16, -(-digits_max // 8))
 
         n_act = len(active)
         st_E: dict[int, NDArray] = {}  # final digit tensors, filled as lanes finish

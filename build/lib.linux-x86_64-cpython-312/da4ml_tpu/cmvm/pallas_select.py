@@ -1,0 +1,148 @@
+"""Pallas TPU kernel for the CSE pair-selection step.
+
+The XLA path of the device search materializes, per greedy iteration, the
+full candidate tensor ``[2, B, P, P]`` (counts, scores, masks) in HBM — at
+P≈128 that is hundreds of MB of traffic per iteration across a lane batch.
+This kernel fuses pair counting (MXU dots), scoring, masking, and the
+argmax into one VMEM-resident program per lane: HBM sees only the digit
+tensor going in and two scalars coming out.
+
+Per lane (grid cell):
+  inputs   e    [P, O*B]    f32  — digit tensor, bit-major within output
+           sh   [B, P, O*B] f32  — e shifted by s along the bit axis
+           nov  [P, P]      f32  — pairwise overlap weights
+           dlat [P, P]      f32  — pairwise latency imbalance
+           coef [1, 4]      f32  — (w_mc, w_ov, penalty, absolute) from the
+                                   per-lane heuristic code
+  output   out  [1, 2]      i32  — (flat candidate index, any_valid)
+
+Flat index layout matches the XLA path (``sub``-major, then shift, then
+(i, j) row-major), and the scan order (sub outer, s inner, strict ``>``
+update, first-index tie-break within a slice) reproduces its tie-breaking
+exactly, so both implementations are decision-identical.
+
+Selection is enabled with ``DA4ML_JAX_SELECT=pallas`` (interpret mode is
+used automatically off-TPU). Reference for the selection semantics:
+src/da4ml/_binary/cmvm/indexers.cc of calad0i/da4ml.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is unavailable on some CPU-only builds; interpret mode suffices
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM = pltpu.SMEM
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _SMEM = _VMEM = None
+
+
+# Per-core VMEM is ~16 MiB on current TPUs; the kernel keeps every operand
+# resident (no blocking), so refuse shape classes whose working set cannot
+# fit with headroom for the dot-general accumulators.
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def vmem_footprint_bytes(P: int, O: int, B: int) -> int:
+    """Resident f32 working set of the fused select kernel for one lane."""
+    OB = O * B
+    sh = B * P * OB * 4  # shifted digit stack — the dominant term
+    e = P * OB * 4
+    pairs = 2 * P * P * 4  # nov + dlat
+    scratch = 4 * P * P * 4  # dot outputs + score/valid temporaries
+    return sh + e + pairs + scratch
+
+
+def fits_vmem(P: int, O: int, B: int, budget: int = VMEM_BUDGET_BYTES) -> bool:
+    """Whether the fused kernel's working set fits in VMEM for this class.
+
+    The staged search grows P past 128 where ``sh`` alone can exceed the
+    budget (e.g. P=256, O=64, B=16 -> 16 MiB for ``sh``); callers must fall
+    back to the XLA select path when this returns False.
+    """
+    return vmem_footprint_bytes(P, O, B) <= budget
+
+
+def _vspec():
+    return pl.BlockSpec(memory_space=_VMEM) if _VMEM is not None else pl.BlockSpec()
+
+
+def _sspec():
+    return pl.BlockSpec(memory_space=_SMEM) if _SMEM is not None else pl.BlockSpec()
+
+
+@lru_cache(maxsize=64)
+def make_select(P: int, O: int, B: int, interpret: bool = False):
+    """Build the fused select function for one shape class.
+
+    Returns ``select(e, sh, nov, dlat, coef) -> (flat, any_valid)`` operating
+    on a single lane; `jax.vmap` lifts it to the lane batch (pallas adds a
+    grid axis).
+    """
+    OB = O * B
+
+    def kernel(e_ref, sh_ref, nov_ref, dlat_ref, coef_ref, out_ref):
+        e = e_ref[...]  # [P, OB]
+        ea = jnp.abs(e)
+        nov = nov_ref[...]  # [P, P]
+        dl = dlat_ref[...]
+        w_mc = coef_ref[0, 0]
+        w_ov = coef_ref[0, 1]
+        pen = coef_ref[0, 2]
+        absolute = coef_ref[0, 3]
+
+        row = jax.lax.broadcasted_iota(jnp.int32, (P, P), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (P, P), 1)
+        iota2 = row * P + col
+        upper = row < col
+        big = jnp.int32(2**30)
+        neg_inf = jnp.float32(-jnp.inf)
+
+        weight = w_mc + nov * w_ov
+        pen_dl = pen * dl
+
+        best = neg_inf
+        bidx = jnp.int32(0)
+        for sub in range(2):
+            for s in range(B):
+                sh_s = sh_ref[s]  # [P, OB]
+                dn = (((1,), (1,)), ((), ()))
+                a = jax.lax.dot_general(e, sh_s, dn, preferred_element_type=jnp.float32)
+                d = jax.lax.dot_general(ea, jnp.abs(sh_s), dn, preferred_element_type=jnp.float32)
+                cnt = (d + a) * 0.5 if sub == 0 else (d - a) * 0.5
+                score = cnt * weight - pen_dl
+                valid = cnt >= 2.0
+                if s == 0:
+                    valid &= upper
+                valid &= (absolute == 0.0) | (score >= 0.0)
+                sc = jnp.where(valid, score, neg_inf)
+                m = jnp.max(sc)
+                loc = jnp.min(jnp.where(sc == m, iota2, big))
+                flat = jnp.int32((sub * B + s) * P * P) + loc
+                upd = m > best
+                best = jnp.where(upd, m, best)
+                bidx = jnp.where(upd, flat, bidx)
+
+        out_ref[0, 0] = bidx
+        out_ref[0, 1] = (best != neg_inf).astype(jnp.int32)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 2), jnp.int32),
+        in_specs=[_vspec(), _vspec(), _vspec(), _vspec(), _sspec()],
+        out_specs=_vspec(),
+        interpret=interpret,
+    )
+
+    def select(e, sh, nov, dlat, coef):
+        out = call(e, sh, nov, dlat, coef)
+        return out[0, 0], out[0, 1] != 0
+
+    return select

@@ -1,0 +1,16 @@
+"""Code generation backends: RTL (Verilog/VHDL) and HLS C++ projects.
+
+Parity target: reference src/da4ml/codegen/__init__.py (RTLModel,
+VerilogModel, VHDLModel, HLSModel).
+"""
+
+from .rtl.rtl_model import RTLModel, VerilogModel, VHDLModel
+
+__all__ = ['RTLModel', 'VerilogModel', 'VHDLModel']
+
+try:  # HLS backend lands in its own milestone
+    from .hls.hls_model import HLSModel  # noqa: F401
+
+    __all__.append('HLSModel')
+except ImportError:
+    pass

@@ -87,7 +87,6 @@ class RTLModel:
         clock_period: float = 5.0,
         clock_uncertainty: float = 0.1,
         register_layers: int = 1,
-        io_delay_minmax: tuple[float, float] = (0.2, 0.4),
     ):
         if isinstance(solution, CombLogic) and latency_cutoff > 0:
             from ...trace.pipeline import to_pipeline
@@ -101,7 +100,6 @@ class RTLModel:
         self.clock_period = clock_period
         self.clock_uncertainty = clock_uncertainty
         self.register_layers = register_layers
-        self.io_delay_minmax = io_delay_minmax
         self._lib: ctypes.CDLL | None = None
         self._lib_path: Path | None = None
 
@@ -192,39 +190,47 @@ class RTLModel:
         self._write_binder(metadata)
         return self
 
-    def _subst(self, text: str) -> str:
-        """Resolve @TOKEN@ placeholders in a flow/constraint template."""
-        d_min, d_max = self.io_delay_minmax
-        tokens = {
-            'NAME': self.name,
-            'PART': self.part,
-            'FLAVOR': self.flavor,
-            'CLOCK_PERIOD': str(self.clock_period),
-            'UNCERTAINTY_SETUP': str(self.clock_uncertainty),
-            'UNCERTAINTY_HOLD': str(self.clock_uncertainty),
-            'DELAY_MIN': str(d_min),
-            'DELAY_MAX': str(d_max),
-        }
-        for key, val in tokens.items():
-            text = text.replace(f'@{key}@', val)
-        return text
-
     def _write_constraints(self):
         cdir = self.path / 'constraints'
         cdir.mkdir(exist_ok=True)
+        period = self.clock_period
+        xdc = (
+            f'create_clock -period {period} -name clk [get_ports clk]\n'
+            f'set_clock_uncertainty {self.clock_uncertainty * period:.3f} [get_clocks clk]\n'
+        )
+        sdc = f'create_clock -period {period} -name clk [get_ports clk]\n'
         if self.is_pipeline:
-            for ext in ('xdc', 'sdc'):
-                template = (_COMMON_DIR / f'constraints.{ext}').read_text()
-                (cdir / f'{self.name}.{ext}').write_text(self._subst(template))
+            (cdir / f'{self.name}.xdc').write_text(xdc)
+            (cdir / f'{self.name}.sdc').write_text(sdc)
         else:
             (cdir / f'{self.name}.xdc').write_text('# combinational block: no clock\n')
 
     def _write_tcl(self):
         tdir = self.path / 'tcl'
         tdir.mkdir(exist_ok=True)
-        for vendor in ('vivado', 'quartus'):
-            template = (_COMMON_DIR / f'{vendor}_flow.tcl').read_text()
-            (tdir / f'build_{vendor}.tcl').write_text(self._subst(template))
+        top = f'{self.name}_wrapper'
+        vivado = f"""# Out-of-context synthesis + implementation (Vivado)
+set top {top}
+create_project -in_memory -part {self.part}
+add_files [glob src/*.v]
+read_xdc -mode out_of_context constraints/{self.name}.xdc
+synth_design -top $top -mode out_of_context
+opt_design
+place_design
+route_design
+report_timing_summary -file timing_summary.rpt
+report_utilization -hierarchical -file utilization.rpt
+report_power -file power.rpt
+"""
+        quartus = f"""# Quartus compile flow
+project_new {self.name} -overwrite
+set_global_assignment -name TOP_LEVEL_ENTITY {top}
+foreach f [glob src/*.v] {{ set_global_assignment -name VERILOG_FILE $f }}
+set_global_assignment -name SDC_FILE constraints/{self.name}.sdc
+execute_flow -compile
+"""
+        (tdir / 'build_vivado.tcl').write_text(vivado)
+        (tdir / 'build_quartus.tcl').write_text(quartus)
 
     # ------------------------------------------------------------- binder
 

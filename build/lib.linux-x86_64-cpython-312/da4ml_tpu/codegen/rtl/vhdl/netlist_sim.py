@@ -1,0 +1,120 @@
+"""Bit-exact netlist simulator front-end for the emitted VHDL subset.
+
+Parses VHDLCombEmitter output (signal declarations, concurrent assignments,
+entity instantiations) into the same internal structures as the Verilog
+netlist simulator and reuses its primitive evaluation engine, providing a
+generated-VHDL oracle on hosts without GHDL.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ..verilog.netlist_sim import VerilogNetlistSim, _Instance, _mask, _sext, _shr
+
+_RE_SIG = re.compile(r'signal\s+(\w+)\s*:\s*(std_logic_vector|signed|unsigned)\((\d+)\s+downto\s+0\);')
+_RE_ASSIGN = re.compile(r'(\w+)(?:\((\d+)\s+downto\s+(\d+)\))?\s*<=\s*(.+?);')
+_RE_INST = re.compile(r'\w+\s*:\s*entity\s+work\.(\w+)\s+generic map\s*\((.*?)\)\s*port map\s*\((.*?)\);')
+_RE_KV = re.compile(r'(\w+)\s*=>\s*("[^"]*"|[-\w]+)')
+
+# generic-name aliases between the VHDL and Verilog primitive libraries
+_PARAM_ALIASES = {'SUB_OP': 'SUB', 'SHIFT_N': 'SHIFT'}
+
+
+class VHDLNetlistSim(VerilogNetlistSim):
+    def __init__(self, text: str, mem_files: dict[str, str]):
+        # bypass the Verilog parser: build structures directly
+        self.wire_width = {}
+        self.wire_signed = {}
+        self.exprs = []
+        self.instances = []
+        self.mem = {}
+        for fname, content in mem_files.items():
+            entries: list[int | None] = []
+            for line in content.strip().splitlines():
+                line = line.strip()
+                entries.append(None if 'x' in line else int(line, 16))
+            self.mem[fname] = entries
+
+        m = re.search(r'inp : in std_logic_vector\((\d+) downto 0\)', text)
+        self.in_width = int(m.group(1)) + 1 if m else 0
+        m = re.search(r'out_port : out std_logic_vector\((\d+) downto 0\)', text)
+        self.out_width = int(m.group(1)) + 1 if m else 0
+
+        body = text[text.index('architecture') :]
+        for raw in body.splitlines():
+            line = raw.split('--')[0].strip()
+            if not line or line in ('begin', 'end architecture;'):
+                continue
+            ms = _RE_SIG.match(line)
+            if ms:
+                name, kind, hi = ms.group(1), ms.group(2), int(ms.group(3))
+                self.wire_width[name] = hi + 1
+                self.wire_signed[name] = kind == 'signed'
+                continue
+            mi = _RE_INST.match(line)
+            if mi:
+                prim, generics_s, ports_s = mi.groups()
+                params: dict[str, int | str] = {}
+                for k, v in _RE_KV.findall(generics_s):
+                    k = _PARAM_ALIASES.get(k, k)
+                    params[k] = v.strip('"') if v.startswith('"') else int(v)
+                ports = {k: v for k, v in _RE_KV.findall(ports_s)}
+                self.instances.append(_Instance(prim, params, ports))
+                continue
+            ma = _RE_ASSIGN.match(line)
+            if ma:
+                lhs, hi, lo, rhs = ma.groups()
+                if lhs == 'out_port':
+                    lhs = 'out'
+                sl = (int(hi), int(lo)) if hi is not None else None
+                self.exprs.append((lhs, sl, rhs.strip()))
+                continue
+            if line.startswith(('library', 'use', 'entity', 'port', 'inp :', 'out_port :', ');', 'end entity;', 'architecture')):
+                continue
+            raise ValueError(f'Unparsed VHDL line: {line}')
+
+    # ----------------------------------------------------------- expression
+
+    def _eval_rhs(self, rhs: str, env: dict[str, int]) -> int:
+        rhs = rhs.strip()
+        m = re.fullmatch(r'(\w+)\((\d+)\s+downto\s+(\d+)\)', rhs)
+        if m:
+            name, hi, lo = m.group(1), int(m.group(2)), int(m.group(3))
+            return (env[name] >> lo) & _mask(hi - lo + 1)
+        m = re.fullmatch(r'"([01]+)"', rhs)
+        if m:
+            return int(m.group(1), 2)
+        if rhs == "(others => '0')":
+            return 0
+        m = re.fullmatch(r'resize\(signed\((\w+)\), (\d+)\)', rhs)
+        if m:
+            return _sext(env[m.group(1)], self.wire_width[m.group(1)])
+        m = re.fullmatch(r'signed\(resize\(unsigned\((\w+)\), (\d+)\)\)', rhs)
+        if m:
+            return env[m.group(1)] & _mask(self.wire_width[m.group(1)])
+        m = re.fullmatch(r"shift_right\(shift_left\((\w+), (\d+)\), (\d+)\) \+ signed'\(\"([01]+)\"\)", rhs)
+        if m:
+            base = self._signed_value(m.group(1))
+            shifted = _shr(base << int(m.group(2)), int(m.group(3)))
+            return shifted + _sext(int(m.group(4), 2), len(m.group(4)))
+        m = re.fullmatch(r'std_logic_vector\((\w+)\((\d+)\s+downto\s+(\d+)\)\)', rhs)
+        if m:
+            name, hi, lo = m.group(1), int(m.group(2)), int(m.group(3))
+            return (env[name] >> lo) & _mask(hi - lo + 1)
+        if re.fullmatch(r'\w+', rhs):
+            return env[rhs]
+        raise ValueError(f'Unparsed VHDL rhs: {rhs}')
+
+
+def simulate_comb_vhdl(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:
+    """Emit `comb` to VHDL, simulate the netlist over `data`, return floats."""
+    from ..verilog.netlist_sim import run_netlist
+    from .comb import VHDLCombEmitter
+
+    em = VHDLCombEmitter(comb, name)
+    sim = VHDLNetlistSim(em.emit(), em.mem_files)
+    return run_netlist(em, sim, comb, data)

@@ -1,0 +1,65 @@
+"""Built-in example model + plugin — the template third parties follow.
+
+Mirrors the behavior of the reference example (reference
+src/da4ml/converter/example.py): a small numpy-defined model exercising
+quantize / relu / slicing / a sin lookup table / matmul / einsum, plus the
+plugin that traces it. The same ``operation`` runs both eagerly on numpy
+arrays (the golden path) and symbolically on FixedVariableArrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..trace import FixedVariableArray
+from ..trace.ops import einsum, quantize, relu
+from .plugin import TracerPluginBase
+
+
+def operation(inp):
+    """Example computation, traceable and numpy-executable alike."""
+    w = np.arange(-60, 60).reshape(4, 5, 6).astype(np.float64) / 2**7
+    inp = quantize(inp, 1, 7, 0)  # inputs must be quantized before use
+    out1 = relu(inp)
+
+    out2 = inp[:, 1:3].transpose()
+    out2 = quantize(np.sin(out2), 1, 0, 7, 'SAT', 'RND')
+    out2 = np.repeat(out2, 2, axis=0) * 3 + 4
+    out2 = np.amax(np.stack([out2, -out2 * 2], axis=0), axis=0)
+
+    out3 = quantize(out2 @ out1, 1, 10, 2)
+    out = einsum('ijk,ij->ik', w, out3)  # CMVM-optimized contraction
+    return out
+
+
+class ExampleModel:
+    """Tiny callable model for showcasing the plugin system."""
+
+    def __init__(self, input_shape: tuple[int, ...] | None = None):
+        self.input_shape = input_shape
+
+    def __call__(self, x):
+        return operation(x)
+
+
+class ExampleTracer(TracerPluginBase):
+    """Plugin for :class:`ExampleModel`.
+
+    Registered under the framework name ``da4ml_tpu`` (the root module of
+    ``ExampleModel``) — both in-process and as a ``da4ml_tpu.plugins`` entry
+    point in pyproject.toml.
+    """
+
+    model: ExampleModel
+
+    def get_input_shapes(self):
+        return [self.model.input_shape] if self.model.input_shape is not None else None
+
+    def apply_model(
+        self,
+        verbose: bool,
+        inputs: tuple[FixedVariableArray, ...],
+    ) -> tuple[dict[str, FixedVariableArray], list[str]]:
+        assert len(inputs) == 1, 'ExampleModel expects a single input.'
+        out = operation(inputs[0])
+        return {'output': out}, ['output']

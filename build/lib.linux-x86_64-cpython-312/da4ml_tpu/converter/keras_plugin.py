@@ -1,0 +1,206 @@
+"""Keras front-end: trace Sequential / Functional models into the DAIS graph.
+
+Each supported layer is replayed with numpy-protocol ops over
+``FixedVariableArray``s (Dense and Conv route through the CMVM optimizer);
+functional graphs are walked with the model's own ``_run_through_graph`` so
+arbitrary branching topologies (Add / Concatenate / multi-output) trace
+without re-implementing Keras graph traversal. Tracing is per-sample: the
+batch dimension is dropped throughout.
+
+The reference keeps its Keras/HGQ2 front-end out-of-tree and registers it via
+the plugin entry-point group (reference src/da4ml/converter/__init__.py:10-16,
+docs/getting_started.md); this module provides an in-tree equivalent for
+plain Keras layers. Unquantized nonlinearities (softmax, sigmoid, ...) are
+rejected — DA semantics need an explicit output precision, which plain Keras
+layers do not carry; quantize activations explicitly or use a quantized
+front-end.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..trace import FixedVariableArray
+from ..trace.ops import avg_pool2d, conv1d, conv2d, max_pool2d, relu
+from .plugin import TracerPluginBase
+
+_SUPPORTED_ACTIVATIONS = ('linear', 'relu')
+
+
+def _weight(w) -> np.ndarray:
+    return np.asarray(w, dtype=np.float64)
+
+
+def _apply_activation(x, name: str):
+    if name == 'linear':
+        return x
+    if name == 'relu':
+        return relu(x)
+    raise NotImplementedError(
+        f'Activation {name!r} is not traceable: DA semantics need an explicit output precision. '
+        f'Supported: {_SUPPORTED_ACTIVATIONS}.'
+    )
+
+
+class KerasTracer(TracerPluginBase):
+    """Tracer plugin for ``keras.Model`` / ``keras.Sequential`` (Keras 3)."""
+
+    def get_input_shapes(self):
+        try:
+            shapes = [tuple(int(d) for d in t.shape[1:]) for t in self.model.inputs]
+        except Exception:
+            return None
+        return shapes or None
+
+    # ------------------------------------------------------------ layers
+
+    def _trace_layer(self, layer, args: tuple, kwargs: dict):
+        name = type(layer).__name__
+
+        if name == 'InputLayer':
+            return args[0]
+
+        if name in ('Dropout', 'SpatialDropout1D', 'SpatialDropout2D'):
+            return args[0]
+
+        if name == 'Dense':
+            x = args[0]
+            y = x @ _weight(layer.kernel)
+            if layer.use_bias:
+                y = y + _weight(layer.bias)
+            return _apply_activation(y, layer.activation.__name__)
+
+        if name in ('Conv1D', 'Conv2D'):
+            x = args[0]
+            if getattr(layer, 'data_format', 'channels_last') != 'channels_last':
+                raise NotImplementedError('Only channels_last convolutions are supported')
+            if getattr(layer, 'groups', 1) != 1:
+                raise NotImplementedError('Grouped convolutions are not supported')
+            k = _weight(layer.kernel)
+            if name == 'Conv1D':
+                y = conv1d(x, k, stride=layer.strides[0], padding=layer.padding, dilation=layer.dilation_rate[0])
+            else:
+                y = conv2d(x, k, strides=layer.strides, padding=layer.padding, dilation=layer.dilation_rate)
+            if layer.use_bias:
+                y = y + _weight(layer.bias)
+            return _apply_activation(y, layer.activation.__name__)
+
+        if name in ('MaxPooling2D', 'AveragePooling2D', 'GlobalAveragePooling2D', 'GlobalMaxPooling2D'):
+            if getattr(layer, 'data_format', 'channels_last') != 'channels_last':
+                raise NotImplementedError('Only channels_last pooling is supported')
+        if name == 'MaxPooling2D':
+            return max_pool2d(args[0], layer.pool_size, layer.strides, layer.padding)
+        if name == 'AveragePooling2D':
+            return avg_pool2d(args[0], layer.pool_size, layer.strides, layer.padding)
+        if name == 'GlobalAveragePooling2D':
+            return np.mean(args[0], axis=(0, 1), keepdims=bool(getattr(layer, 'keepdims', False)))
+        if name == 'GlobalMaxPooling2D':
+            return np.amax(args[0], axis=(0, 1), keepdims=bool(getattr(layer, 'keepdims', False)))
+
+        if name == 'Flatten':
+            return args[0].reshape(-1)
+        if name == 'Reshape':
+            return args[0].reshape(*layer.target_shape)
+        if name == 'Permute':
+            return args[0].transpose([d - 1 for d in layer.dims])
+
+        if name == 'ReLU':
+            if getattr(layer, 'negative_slope', 0.0) or getattr(layer, 'threshold', 0.0):
+                raise NotImplementedError('Leaky/thresholded ReLU is not supported')
+            y = relu(args[0])
+            if layer.max_value is not None:
+                y = np.minimum(y, float(layer.max_value))
+            return y
+        if name == 'Activation':
+            return _apply_activation(args[0], layer.activation.__name__)
+
+        if name == 'BatchNormalization':
+            x = args[0]
+            eps = float(layer.epsilon)
+            gamma = _weight(layer.gamma) if layer.scale else 1.0
+            beta = _weight(layer.beta) if layer.center else 0.0
+            mean = _weight(layer.moving_mean)
+            var = _weight(layer.moving_variance)
+            a = np.atleast_1d(gamma / np.sqrt(var + eps))
+            b = np.atleast_1d(beta - mean * a)
+            ax = layer.axis if isinstance(layer.axis, int) else layer.axis[0]
+            if ax == 0:
+                raise NotImplementedError('BatchNormalization along the batch axis is not traceable')
+            ax = ax - 1 if ax > 0 else ax % x.ndim  # batch dim dropped in tracing
+            shape = [1] * x.ndim
+            shape[ax] = a.size
+            return x * a.reshape(shape) + b.reshape(shape)
+
+        if name == 'Add':
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            out = vals[0]
+            for v in vals[1:]:
+                out = out + v
+            return out
+        if name == 'Subtract':
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            return vals[0] - vals[1]
+        if name in ('Maximum', 'Minimum'):
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            fn = np.maximum if name == 'Maximum' else np.minimum
+            out = vals[0]
+            for v in vals[1:]:
+                out = fn(out, v)
+            return out
+        if name == 'Average':
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            out = vals[0]
+            for v in vals[1:]:
+                out = out + v
+            return out * (1.0 / len(vals))
+        if name == 'Concatenate':
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            axis = layer.axis
+            if axis == 0:
+                raise NotImplementedError('Concatenate along the batch axis (axis=0) is not traceable')
+            if axis > 0:
+                axis -= 1  # batch dim dropped in tracing
+            return np.concatenate(vals, axis=axis)
+
+        raise NotImplementedError(f'Layer type {name!r} is not supported by the Keras tracer')
+
+    # ------------------------------------------------------------ model walk
+
+    def apply_model(self, verbose: bool, inputs: tuple[FixedVariableArray, ...]):
+        import keras
+
+        model = self.model
+        traces: dict[str, Any] = {}
+
+        if isinstance(model, keras.Sequential):
+            x = inputs[0]
+            for layer in model.layers:
+                x = self._trace_layer(layer, (x,), {})
+                traces[layer.name] = x
+                if verbose:
+                    print(f'  {layer.name}: {getattr(x, "shape", None)}')
+            out_name = model.layers[-1].name if model.layers else 'out'
+            return traces, [out_name]
+
+        # Functional: reuse the model's own graph executor, substituting every
+        # operation with the symbolic tracer.
+        def operation_fn(op):
+            def apply(*args, **kwargs):
+                out = self._trace_layer(op, args, kwargs)
+                traces[op.name] = out
+                if verbose:
+                    print(f'  {op.name}: {getattr(out, "shape", None)}')
+                return out
+
+            return apply
+
+        outputs = model._run_through_graph(tuple(inputs), operation_fn=operation_fn)
+        flat_outputs = keras.tree.flatten(outputs)
+        names = []
+        for i, out in enumerate(flat_outputs):
+            name = f'output_{i}'
+            traces[name] = out
+            names.append(name)
+        return traces, names

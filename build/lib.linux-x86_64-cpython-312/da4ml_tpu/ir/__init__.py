@@ -1,0 +1,19 @@
+from .comb import CombLogic, Pipeline
+from .lut import LookupTable, TableSpec, interpret_as, lsb_loc
+from .types import Op, Precision, QInterval, minimal_kif, qint_add, quantize_float, relu_float
+
+__all__ = [
+    'CombLogic',
+    'Pipeline',
+    'LookupTable',
+    'TableSpec',
+    'Op',
+    'Precision',
+    'QInterval',
+    'minimal_kif',
+    'qint_add',
+    'quantize_float',
+    'relu_float',
+    'interpret_as',
+    'lsb_loc',
+]

@@ -1,0 +1,398 @@
+"""CombLogic and Pipeline — the executable DAIS program containers.
+
+``CombLogic`` is one block of fully-combinational SSA ops. ``Pipeline`` chains
+CombLogic stages at II=1. Both replay symbolically (over tracer variables) or
+numerically (over floats) via ``__call__``; batch bit-exact execution is
+provided by the runtime backends (numpy / JAX / C++) through ``predict``.
+
+Behavioral parity: reference src/da4ml/types.py:176-703.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import reduce
+from pathlib import Path
+from typing import NamedTuple, Sequence
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ..ops.numeric import apply_binary_bit_op, apply_quantize, apply_relu, apply_unary_bit_op
+from .lut import LookupTable
+from .types import Op, QInterval, minimal_kif
+
+
+def _i32(x: int) -> int:
+    """Interpret the low 32 bits of x as a signed int32."""
+    return ((int(x) & 0xFFFFFFFF) + (1 << 31)) % (1 << 32) - (1 << 31)
+
+
+class CombLogic(NamedTuple):
+    """A combinational SSA program: ops fill a buffer; outputs are scaled reads.
+
+    Attributes mirror the DAIS program structure (docs/dais.md:8-26):
+    ``shape`` = (n_in, n_out); ``inp_shifts`` scale inputs on entry;
+    outputs are ``buf[out_idxs[i]] * 2**out_shifts[i] * (-1 if out_negs[i])``.
+    ``carry_size``/``adder_size`` parameterize the cost/latency model.
+    """
+
+    shape: tuple[int, int]
+    inp_shifts: list[int]
+    out_idxs: list[int]
+    out_shifts: list[int]
+    out_negs: list[bool]
+    ops: list[Op]
+    carry_size: int
+    adder_size: int
+    lookup_tables: tuple[LookupTable, ...] | None = None
+
+    def __call__(self, inp, quantize: bool = False, dump: bool = False):
+        """Replay the op list over the input — numeric (floats) or symbolic."""
+        buf = np.empty(len(self.ops), dtype=object)
+        inp = np.asarray(inp)
+        if quantize:
+            k, i, f = self.inp_kifs
+            inp = [apply_quantize(x, *kif, round_mode='TRN') for x, *kif in zip(inp, k, i, f)]
+        inp = inp * (2.0 ** np.array(self.inp_shifts))
+
+        for n, op in enumerate(self.ops):
+            oc = op.opcode
+            if oc == -1:
+                buf[n] = inp[op.id0]
+            elif oc in (0, 1):
+                v0, v1 = buf[op.id0], 2.0**op.data * buf[op.id1]
+                buf[n] = v0 + v1 if oc == 0 else v0 - v1
+            elif oc in (2, -2):
+                _, _i, _f = minimal_kif(op.qint)
+                buf[n] = apply_relu(buf[op.id0], _i, _f, inv=oc == -2, round_mode='TRN')
+            elif oc in (3, -3):
+                v = buf[op.id0] if oc == 3 else -buf[op.id0]
+                _k, _i, _f = minimal_kif(op.qint)
+                buf[n] = apply_quantize(v, _k, _i, _f, round_mode='TRN', _force_factor_clear=True)
+            elif oc == 4:
+                buf[n] = buf[op.id0] + op.data * op.qint.step
+            elif oc == 5:
+                buf[n] = op.data * op.qint.step
+            elif oc in (6, -6):
+                id_c = op.data & 0xFFFFFFFF
+                k, v0, v1 = buf[id_c], buf[op.id0], buf[op.id1]
+                shift = _i32(op.data >> 32)
+                if oc == -6:
+                    v1 = -v1
+                if hasattr(k, 'msb_mux'):
+                    buf[n] = k.msb_mux(v0, v1 * 2**shift, op.qint)
+                else:
+                    qint_k = self.ops[id_c].qint
+                    if qint_k.min < 0:
+                        buf[n] = v0 if k < 0 else v1 * 2.0**shift
+                    else:
+                        _, _i, _ = minimal_kif(qint_k)
+                        buf[n] = v0 if k >= 2.0 ** (_i - 1) else v1 * 2.0**shift
+            elif oc == 7:
+                buf[n] = buf[op.id0] * buf[op.id1]
+            elif oc == 8:
+                assert self.lookup_tables is not None, 'No lookup table for lookup op'
+                buf[n] = self.lookup_tables[op.data].lookup(buf[op.id0], self.ops[op.id0].qint)
+            elif oc in (9, -9):
+                v0 = buf[op.id0] if oc == 9 else -buf[op.id0]
+                buf[n] = apply_unary_bit_op(v0, op.data, self.ops[op.id0].qint, op.qint)
+            elif oc == 10:
+                v0, v1 = buf[op.id0], buf[op.id1]
+                if (op.data >> 32) & 1:
+                    v0 = -v0
+                if (op.data >> 33) & 1:
+                    v1 = -v1
+                shift = _i32(op.data)
+                subop = (op.data >> 56) & 0xFF
+                q1 = self.ops[op.id1].qint
+                s = 2.0**shift
+                qint1 = QInterval(q1.min * s, q1.max * s, q1.step * s)
+                buf[n] = apply_binary_bit_op(v0, v1 * s, subop, self.ops[op.id0].qint, qint1, op.qint)
+            else:
+                raise ValueError(f'Unknown opcode {oc} in {op}')
+
+        if dump:
+            return buf
+        sf = 2.0 ** np.array(self.out_shifts, dtype=np.float64)
+        sign = np.where(self.out_negs, -1, 1)
+        out_idx = np.array(self.out_idxs, dtype=np.int32)
+        mask = np.where(out_idx < 0, 0, 1)
+        return buf[out_idx] * sf * sign * mask
+
+    # ---------------------------------------------------------------- metrics
+
+    @property
+    def kernel(self) -> NDArray[np.float32]:
+        """The linear kernel this program implements (one-hot replay)."""
+        kernel = np.empty(self.shape, dtype=np.float32)
+        for i, one_hot in enumerate(np.identity(self.shape[0])):
+            kernel[i] = self(one_hot)
+        return kernel
+
+    @property
+    def cost(self) -> float:
+        return float(sum(op.cost for op in self.ops))
+
+    @property
+    def latency(self) -> tuple[float, float]:
+        lats = [self.ops[i].latency if i >= 0 else 0.0 for i in self.out_idxs]
+        if not lats:
+            return 0.0, 0.0
+        return min(lats), max(lats)
+
+    @property
+    def out_latency(self) -> list[float]:
+        return [self.ops[i].latency if i >= 0 else 0.0 for i in self.out_idxs]
+
+    @property
+    def out_qint(self) -> list[QInterval]:
+        out = []
+        for i, idx in enumerate(self.out_idxs):
+            if idx < 0:
+                out.append(QInterval(0.0, 0.0, 1.0))
+                continue
+            lo, hi, step = self.ops[idx].qint
+            sf = 2.0 ** self.out_shifts[i]
+            lo, hi, step = lo * sf, hi * sf, step * sf
+            if self.out_negs[i]:
+                lo, hi = -hi, -lo
+            out.append(QInterval(lo, hi, step))
+        return out
+
+    @property
+    def out_kifs(self) -> NDArray:
+        return np.array([minimal_kif(qi) for qi in self.out_qint]).T
+
+    @property
+    def inp_latency(self) -> list[float]:
+        return [op.latency for op in self.ops if op.opcode == -1]
+
+    @property
+    def inp_qint(self) -> list[QInterval]:
+        qints = [QInterval(0.0, 0.0, 1.0) for _ in range(self.shape[0])]
+        for op in self.ops:
+            if op.opcode == -1:
+                qints[op.id0] = op.qint
+        return qints
+
+    @property
+    def inp_kifs(self) -> NDArray:
+        return np.array([minimal_kif(qi) for qi in self.inp_qint]).T
+
+    @property
+    def ref_count(self) -> NDArray:
+        """Number of downstream references to each buffer slot."""
+        rc = np.zeros(len(self.ops), dtype=np.uint64)
+        for op in self.ops:
+            if op.opcode == -1:
+                continue
+            if op.id0 != -1:
+                rc[op.id0] += 1
+            if op.id1 != -1:
+                rc[op.id1] += 1
+            if op.opcode in (6, -6):
+                rc[op.data & 0xFFFFFFFF] += 1
+        for i in self.out_idxs:
+            if i >= 0:
+                rc[i] += 1
+        return rc
+
+    def __repr__(self) -> str:
+        n_in, n_out = self.shape
+        lo, hi = self.latency
+        return f'CombLogic([{n_in} -> {n_out}], cost={self.cost}, latency={lo}-{hi})'
+
+    # ------------------------------------------------------------ persistence
+
+    def to_dict(self) -> dict:
+        return {
+            'shape': list(self.shape),
+            'inp_shifts': [int(v) for v in self.inp_shifts],
+            'out_idxs': [int(v) for v in self.out_idxs],
+            'out_shifts': [int(v) for v in self.out_shifts],
+            'out_negs': [int(v) for v in self.out_negs],
+            'ops': [[op.id0, op.id1, op.opcode, op.data, list(op.qint), op.latency, op.cost] for op in self.ops],
+            'carry_size': self.carry_size,
+            'adder_size': self.adder_size,
+            'lookup_tables': [t.to_dict() for t in self.lookup_tables] if self.lookup_tables is not None else None,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> 'CombLogic':
+        ops = [Op(o[0], o[1], o[2], o[3], QInterval(*o[4]), o[5], o[6]) for o in data['ops']]
+        tables = data.get('lookup_tables')
+        if tables is not None:
+            tables = tuple(LookupTable.from_dict(t) for t in tables)
+        return cls(
+            shape=tuple(data['shape']),
+            inp_shifts=data['inp_shifts'],
+            out_idxs=data['out_idxs'],
+            out_shifts=data['out_shifts'],
+            out_negs=data['out_negs'],
+            ops=ops,
+            carry_size=data['carry_size'],
+            adder_size=data['adder_size'],
+            lookup_tables=tables,
+        )
+
+    def save(self, path: str | Path):
+        with open(path, 'w') as f:
+            json.dump(self.to_dict(), f, separators=(',', ':'))
+
+    @classmethod
+    def load(cls, path: str | Path) -> 'CombLogic':
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # ---------------------------------------------------------- DAIS binary
+
+    def to_binary(self, version: int = 0) -> NDArray[np.int32]:
+        """Serialize to the flat int32 DAIS v1 stream (docs/dais.md:70-97)."""
+        DAIS_SPEC_VERSION = 1
+        n_in, n_out = self.shape
+        n_tables = len(self.lookup_tables) if self.lookup_tables is not None else 0
+
+        header = np.concatenate(
+            [
+                [DAIS_SPEC_VERSION, version, n_in, n_out, len(self.ops), n_tables],
+                self.inp_shifts,
+                self.out_idxs,
+                self.out_shifts,
+                np.asarray(self.out_negs, dtype=np.int32),
+            ],
+            axis=0,
+            dtype=np.int32,
+        )
+        code = np.empty((len(self.ops), 8), dtype=np.int32)
+        for i, op in enumerate(self.ops):
+            row = code[i]
+            row[0] = op.opcode
+            row[1] = op.id0
+            row[2] = op.id1
+            row[5:] = minimal_kif(op.qint)
+            data_u64 = row[3:5].view(np.uint64)
+            if op.opcode != 8:
+                data_u64[0] = op.data & 0xFFFFFFFFFFFFFFFF
+            else:
+                assert self.lookup_tables is not None
+                pad_left = self.lookup_tables[op.data].pads(self.ops[op.id0].qint)[0]
+                data_u64[0] = ((pad_left << 32) | op.data) & 0xFFFFFFFFFFFFFFFF
+        data = np.concatenate([header, code.ravel()])
+        if not self.lookup_tables:  # None or empty tuple: no table section
+            return data
+        tables = [t.table for t in self.lookup_tables]
+        sizes = [len(t) for t in tables]
+        return np.concatenate([data, np.concatenate([sizes] + tables, axis=0, dtype=np.int32)])
+
+    def save_binary(self, path: str | Path, version: int = 0):
+        self.to_binary(version=version).tofile(str(path))
+
+    # -------------------------------------------------------------- predict
+
+    def predict(self, data: NDArray | Sequence[NDArray], backend: str = 'auto', n_threads: int = 0) -> NDArray[np.float64]:
+        """Bit-exact batch inference via a runtime backend.
+
+        backend: 'auto' (native C++ if built, else numpy), 'numpy', 'cpp', 'jax'.
+        """
+        if isinstance(data, Sequence):
+            data = np.concatenate([np.asarray(a).reshape(len(a), -1) for a in data], axis=-1)
+        from ..runtime import run_comb
+
+        return run_comb(self, np.asarray(data, dtype=np.float64), backend=backend, n_threads=n_threads)
+
+
+class Pipeline(NamedTuple):
+    """An II=1 pipeline: a chain of CombLogic stages."""
+
+    stages: tuple[CombLogic, ...]
+
+    def __call__(self, inp, quantize: bool = False):
+        out = np.asarray(inp)
+        for stage in self.stages:
+            out = stage(out, quantize=quantize)
+        return out
+
+    @property
+    def solutions(self) -> tuple[CombLogic, ...]:
+        """Alias kept for API familiarity with the reference."""
+        return self.stages
+
+    @property
+    def kernel(self):
+        return reduce(lambda x, y: x @ y, [s.kernel for s in self.stages])
+
+    @property
+    def cost(self):
+        return sum(s.cost for s in self.stages)
+
+    @property
+    def latency(self):
+        return self.stages[-1].latency
+
+    @property
+    def shape(self):
+        return self.stages[0].shape[0], self.stages[-1].shape[1]
+
+    @property
+    def inp_qint(self):
+        return self.stages[0].inp_qint
+
+    @property
+    def inp_latency(self):
+        return self.stages[0].inp_latency
+
+    @property
+    def inp_shifts(self):
+        return self.stages[0].inp_shifts
+
+    @property
+    def out_qint(self):
+        return self.stages[-1].out_qint
+
+    @property
+    def out_latencies(self):
+        return self.stages[-1].out_latency
+
+    @property
+    def out_shift(self):
+        return self.stages[-1].out_shifts
+
+    @property
+    def out_neg(self):
+        return self.stages[-1].out_negs
+
+    @property
+    def reg_bits(self) -> int:
+        """Total pipeline-register bits (input regs + each stage's outputs)."""
+        bits = sum(sum(minimal_kif(q)) for q in self.inp_qint)
+        for stage in self.stages:
+            bits += sum(sum(minimal_kif(q)) for q in stage.out_qint)
+        return int(bits)
+
+    def __repr__(self) -> str:
+        dims = [s.shape[0] for s in self.stages] + [self.shape[1]]
+        lo, hi = self.latency
+        return f'Pipeline([{" -> ".join(map(str, dims))}], cost={self.cost}, latency={lo}-{hi})'
+
+    def to_dict(self) -> dict:
+        return {'stages': [s.to_dict() for s in self.stages]}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> 'Pipeline':
+        return cls(stages=tuple(CombLogic.from_dict(s) for s in data['stages']))
+
+    def save(self, path: str | Path):
+        with open(path, 'w') as f:
+            json.dump(self.to_dict(), f, separators=(',', ':'))
+
+    @classmethod
+    def load(cls, path: str | Path) -> 'Pipeline':
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def predict(self, data, backend: str = 'auto', n_threads: int = 0):
+        out = np.asarray(data, dtype=np.float64)
+        for stage in self.stages:
+            out = stage.predict(out, backend=backend, n_threads=n_threads)
+        return out

@@ -1,0 +1,41 @@
+"""Native (C++) runtime components: DAIS interpreter and CMVM solver.
+
+The shared library is built on demand from da4ml_tpu/native/src via
+``python -m da4ml_tpu.native.build``; bindings go through ctypes (no
+pybind11 dependency). Until built, ``is_available()`` is False and entry
+points raise a clear error.
+"""
+
+from __future__ import annotations
+
+
+def is_available() -> bool:
+    try:
+        from .bindings import load_lib
+
+        return load_lib() is not None
+    except Exception:
+        return False
+
+
+def has_solver() -> bool:
+    """True when the native CMVM solver (cmvm_solve symbol) is built."""
+    try:
+        from .bindings import load_lib
+
+        lib = load_lib()
+        return lib is not None and hasattr(lib, 'cmvm_solve')
+    except Exception:
+        return False
+
+
+def run_binary(binary, data, n_threads: int = 0):
+    from .bindings import run_binary as _run
+
+    return _run(binary, data, n_threads=n_threads)
+
+
+def solve_native(kernel, **kwargs):
+    from .bindings import solve_native as _solve
+
+    return _solve(kernel, **kwargs)

@@ -1,0 +1,60 @@
+"""Build the native shared library with g++ (no meson/pybind11 dependency).
+
+Usage: ``python -m da4ml_tpu.native.build [--force]``. The library is also
+auto-built on first use (bindings.load_lib) unless DA4ML_NO_NATIVE_BUILD is
+set. Output: ``_da4ml_native.so`` next to this file.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+_HERE = Path(__file__).parent
+SRC_DIR = _HERE / 'src'
+LIB_PATH = _HERE / '_da4ml_native.so'
+
+
+def _sources() -> list[Path]:
+    return sorted(SRC_DIR.glob('*.cc'))
+
+
+def needs_build() -> bool:
+    if not LIB_PATH.exists():
+        return True
+    lib_mtime = LIB_PATH.stat().st_mtime
+    deps = list(SRC_DIR.glob('*.cc')) + list(SRC_DIR.glob('*.hh'))
+    return any(p.stat().st_mtime > lib_mtime for p in deps)
+
+
+def build(force: bool = False, verbose: bool = False) -> Path:
+    if not force and not needs_build():
+        return LIB_PATH
+    cxx = os.environ.get('CXX', 'g++')
+    cmd = [
+        cxx,
+        '-std=c++20',
+        '-O3',
+        '-fPIC',
+        '-shared',
+        '-fopenmp',
+        '-fvisibility=hidden',
+        '-Wall',
+        *[str(s) for s in _sources()],
+        '-o',
+        str(LIB_PATH),
+    ]
+    if verbose:
+        print(' '.join(cmd), file=sys.stderr)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f'native build failed:\n{proc.stderr}')
+    return LIB_PATH
+
+
+if __name__ == '__main__':
+    force = '--force' in sys.argv
+    path = build(force=force, verbose=True)
+    print(f'built {path}')

@@ -1,0 +1,3 @@
+from .numeric import apply_binary_bit_op, apply_quantize, apply_relu, apply_unary_bit_op
+
+__all__ = ['apply_quantize', 'apply_relu', 'apply_unary_bit_op', 'apply_binary_bit_op']

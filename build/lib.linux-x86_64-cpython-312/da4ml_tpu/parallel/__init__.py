@@ -1,0 +1,50 @@
+"""Device-mesh utilities: batch sharding for inference, candidate sharding for search.
+
+The framework's two parallel axes (SURVEY.md §2.6):
+  - DAIS batch inference  -> shard the sample axis over the mesh
+  - CMVM candidate search -> shard the (matrix × dc × restart) axis
+
+Both ride XLA collectives over ICI; no custom transport.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def default_mesh(axis_name: str = 'batch', devices=None) -> Mesh:
+    """A 1D mesh over all local devices."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices.reshape(-1), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = 'batch') -> NamedSharding:
+    """Shard the leading (sample) axis; everything else replicated."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0) -> tuple[np.ndarray, int]:
+    """Pad axis length up to a device-count multiple; returns (padded, n_pad)."""
+    n = x.shape[axis]
+    n_pad = (-n) % multiple
+    if n_pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, n_pad)
+    return np.pad(x, widths), n_pad
+
+
+def shard_batch(x: np.ndarray, mesh: Mesh | None = None, axis_name: str = 'batch'):
+    """Place a host batch on the mesh, sharded along the sample axis.
+
+    Pads the batch to a multiple of the device count; returns (array, n_pad)
+    so callers can strip padding from results.
+    """
+    mesh = mesh if mesh is not None else default_mesh(axis_name)
+    x, n_pad = pad_to_multiple(np.asarray(x), mesh.devices.size, axis=0)
+    return jax.device_put(x, batch_sharding(mesh, axis_name)), n_pad
+
+
+__all__ = ['default_mesh', 'batch_sharding', 'shard_batch', 'pad_to_multiple']

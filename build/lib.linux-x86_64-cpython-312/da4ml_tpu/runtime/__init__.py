@@ -1,0 +1,39 @@
+"""Runtime backends executing DAIS programs bit-exactly.
+
+- ``numpy``: vectorized host interpreter (golden oracle, always available)
+- ``cpp``: native C++ interpreter, OpenMP over sample chunks (da4ml_tpu.native)
+- ``jax``: jitted XLA integer kernel for TPU batch inference
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from numpy.typing import NDArray
+
+
+def run_comb(comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: int = 0) -> NDArray[np.float64]:
+    """Execute a CombLogic over a (n_samples, n_in) batch with the given backend."""
+    binary = comb.to_binary()
+    if backend == 'auto':
+        try:
+            from ..native import is_available
+
+            backend = 'cpp' if is_available() else 'numpy'
+        except Exception:
+            backend = 'numpy'
+    if backend == 'numpy':
+        from .numpy_backend import run_binary
+
+        return run_binary(binary, data)
+    if backend == 'cpp':
+        from ..native import run_binary
+
+        return run_binary(binary, data, n_threads=n_threads)
+    if backend == 'jax':
+        from .jax_backend import run_binary
+
+        return run_binary(binary, data)
+    raise ValueError(f'Unknown backend {backend!r} (expected auto/numpy/cpp/jax)')
+
+
+__all__ = ['run_comb']

@@ -1,0 +1,392 @@
+"""Jitted XLA executor for DAIS programs (TPU batch inference).
+
+TPU-first design: the op list is static SSA, so instead of an interpreter loop
+we emit one closed jaxpr — a Python unroll over ops at trace time — which XLA
+fuses into a single integer kernel. The float boundary (input scaling/floor,
+output rescale) stays on the host so the device program is pure fixed-point
+integer arithmetic (int32 fast path, int64 when widths demand it).
+
+The throughput axis is the sample batch; shard it with
+``da4ml_tpu.parallel.shard_batch`` for multi-chip inference.
+
+Bit-exactness contract: identical results to runtime.numpy_backend /
+the native C++ interpreter (reference DAISInterpreter.cc semantics).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from numpy.typing import NDArray
+
+from ..ir.dais_binary import DaisProgram, decode
+
+
+def _shl(v, s: int):
+    return v << s if s >= 0 else v >> (-s)
+
+
+class DaisExecutor:
+    """Compiles a DAIS program into a jitted integer XLA function.
+
+    ``fn_int`` maps (batch, n_in) int → (batch, n_out) int on device;
+    ``__call__`` wraps it with the host-side float conversions.
+    """
+
+    #: op-count threshold above which ``mode='auto'`` switches from the fully
+    #: unrolled jaxpr (best runtime, compile time grows with program size) to
+    #: the scan interpreter (O(1) compile, one fused step body)
+    UNROLL_LIMIT = 20_000
+
+    def __init__(self, prog: DaisProgram, force_i64: bool | None = None, mode: str = 'auto'):
+        prog.validate()
+        self.prog = prog
+        # +2 headroom: shift_add aligns operands before the narrowing shift
+        wide = prog.max_width + 2 > 31
+        self.use_i64 = wide if force_i64 is None else force_i64
+        if self.use_i64 and not jax.config.read('jax_enable_x64'):
+            jax.config.update('jax_enable_x64', True)
+        self.dtype = jnp.int64 if self.use_i64 else jnp.int32
+        self._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in prog.tables)
+        if mode not in ('auto', 'unroll', 'scan'):
+            raise ValueError(f"mode must be 'auto', 'unroll' or 'scan', got {mode!r}")
+        if mode == 'auto':
+            mode = 'unroll' if prog.n_ops <= self.UNROLL_LIMIT else 'scan'
+        self.mode = mode
+        self.fn_int = jax.jit(self._build() if mode == 'unroll' else self._build_scan())
+
+    def _build(self):
+        prog = self.prog
+        dtype = self.dtype
+        width = prog.width
+        tables = self._tables
+
+        def one(v):
+            return jnp.asarray(v, dtype=dtype)
+
+        def wrap(v, signed: int, w: int):
+            mod = 1 << w
+            int_min = -(1 << (w - 1)) if signed else 0
+            return ((v - int_min) % mod) + int_min
+
+        def quantize(v, f_from: int, sg: int, w: int, f_to: int):
+            return wrap(_shl(v, f_to - f_from), sg, w)
+
+        def fn(x):
+            # x: (batch, n_in) integers, pre-scaled by 2**(inp_shift + f) per input op
+            buf: list = [None] * prog.n_ops
+            for i in range(prog.n_ops):
+                oc = int(prog.opcode[i])
+                i0, i1 = int(prog.id0[i]), int(prog.id1[i])
+                dlo, dhi = int(prog.data_lo[i]), int(prog.data_hi[i])
+                sg, f = int(prog.signed[i]), int(prog.fractionals[i])
+                w = int(width[i])
+
+                if oc == -1:
+                    buf[i] = wrap(x[:, i0].astype(dtype), sg, w)
+                elif oc in (0, 1):
+                    f0, f1 = int(prog.fractionals[i0]), int(prog.fractionals[i1])
+                    a_shift = dlo + f0 - f1
+                    v1 = buf[i0]
+                    v2 = -buf[i1] if oc == 1 else buf[i1]
+                    r = v1 + (v2 << a_shift) if a_shift > 0 else (v1 << -a_shift) + v2
+                    g_shift = max(f0, f1 - dlo) - f
+                    if g_shift > 0:
+                        r = r >> g_shift
+                    buf[i] = r
+                elif oc in (2, -2):
+                    v = -buf[i0] if oc == -2 else buf[i0]
+                    buf[i] = jnp.where(v < 0, 0, quantize(v, int(prog.fractionals[i0]), sg, w, f))
+                elif oc in (3, -3):
+                    v = -buf[i0] if oc == -3 else buf[i0]
+                    buf[i] = quantize(v, int(prog.fractionals[i0]), sg, w, f)
+                elif oc == 4:
+                    shift = f - int(prog.fractionals[i0])
+                    const = (dhi << 32) | (dlo & 0xFFFFFFFF)
+                    buf[i] = _shl(buf[i0], shift) + one(const)
+                elif oc == 5:
+                    buf[i] = jnp.full((x.shape[0],), (dhi << 32) | (dlo & 0xFFFFFFFF), dtype=dtype)
+                elif oc in (6, -6):
+                    ic = dlo
+                    f0, f1 = int(prog.fractionals[i0]), int(prog.fractionals[i1])
+                    shift1 = f - f1 + dhi
+                    shift0 = f - f0
+                    sgc, wc = int(prog.signed[ic]), int(width[ic])
+                    cond = buf[ic] < 0 if sgc else buf[ic] >= (1 << (wc - 1))
+                    v1 = -buf[i1] if oc == -6 else buf[i1]
+                    r0 = wrap(_shl(buf[i0], shift0), sg, w)
+                    r1 = wrap(_shl(v1, shift1), sg, w)
+                    buf[i] = jnp.where(cond, r0, r1)
+                elif oc == 7:
+                    buf[i] = buf[i0] * buf[i1]
+                elif oc == 8:
+                    sg0, w0 = int(prog.signed[i0]), int(width[i0])
+                    zero = -sg0 * (1 << (w0 - 1))
+                    index = buf[i0] - zero - dhi
+                    buf[i] = jnp.take(tables[dlo], index, mode='clip')
+                elif oc in (9, -9):
+                    v = -buf[i0] if oc == -9 else buf[i0]
+                    mask = (1 << int(width[i0])) - 1
+                    if dlo == 0:
+                        buf[i] = ~v if sg else (~v) & mask
+                    elif dlo == 1:
+                        buf[i] = (v != 0).astype(dtype)
+                    elif dlo == 2:
+                        buf[i] = ((v & mask) == mask).astype(dtype)
+                    else:
+                        raise ValueError(f'Unknown bit unary op data={dlo}')
+                elif oc == 10:
+                    f0, f1 = int(prog.fractionals[i0]), int(prog.fractionals[i1])
+                    a_shift = dlo + f0 - f1
+                    v1, v2 = buf[i0], buf[i1]
+                    if dhi & 1:
+                        v1 = -v1
+                    if dhi & 2:
+                        v2 = -v2
+                    if a_shift > 0:
+                        v2 = v2 << a_shift
+                    else:
+                        v1 = v1 << -a_shift
+                    subop = dhi >> 24
+                    buf[i] = (v1 & v2) if subop == 0 else (v1 | v2) if subop == 1 else (v1 ^ v2)
+                else:
+                    raise ValueError(f'Unknown opcode {oc} at index {i}')
+
+            outs = []
+            for j in range(prog.n_out):
+                idx = int(prog.out_idxs[j])
+                if idx < 0:
+                    outs.append(jnp.zeros((x.shape[0],), dtype=dtype))
+                    continue
+                v = buf[idx]
+                outs.append(-v if prog.out_negs[j] else v)
+            return jnp.stack(outs, axis=-1)
+
+        return fn
+
+    def _build_scan(self):
+        """lax.scan interpreter over the op table — the compile-time fallback.
+
+        One switch-dispatched step body runs ``n_ops`` times against a dense
+        execution buffer; every per-op constant becomes a gathered array.
+        Bit-exact with the unrolled path (same semantics, traced shifts).
+        """
+        prog = self.prog
+        dtype = self.dtype
+        n_ops = prog.n_ops
+        np_dt = np.int64 if self.use_i64 else np.int32
+
+        f_arr = prog.fractionals.astype(np_dt)
+        sg_arr = prog.signed.astype(np_dt)
+        w_arr = prog.width.astype(np_dt)
+        oc_arr = prog.opcode.astype(np.int64)
+        id0_arr = prog.id0.astype(np.int64)
+        id1_arr = prog.id1.astype(np.int64)
+        dlo_arr = prog.data_lo.astype(np.int64)
+        dhi_arr = prog.data_hi.astype(np.int64)
+
+        branch_of = {-1: 0, 0: 1, 1: 1, 2: 2, -2: 2, 3: 3, -3: 3, 4: 4, 5: 5, 6: 6, -6: 6, 7: 7, 8: 8, 9: 9, -9: 9, 10: 10}
+        branch_arr = np.array([branch_of[int(o)] for o in oc_arr], np.int32)
+        neg_arr = (oc_arr < 0).astype(np_dt)
+        sub_arr = (oc_arr == 1).astype(np_dt)  # subtraction is opcode +1, not a negative opcode
+
+        # gathered per-op operand metadata (garbage where a branch ignores it)
+        safe0 = np.clip(id0_arr, 0, max(n_ops - 1, 0))
+        safe1 = np.clip(id1_arr, 0, max(n_ops - 1, 0))
+        f0_arr = f_arr[safe0]
+        f1_arr = f_arr[safe1]
+        a_shift_arr = (dlo_arr + f0_arr - f1_arr).astype(np_dt)
+        g_shift_arr = (np.maximum(f0_arr, f1_arr - dlo_arr) - f_arr).astype(np_dt)
+        const_arr = ((dhi_arr << 32) | (dlo_arr & 0xFFFFFFFF)).astype(np_dt)
+        safec = np.clip(dlo_arr, 0, max(n_ops - 1, 0))
+        sgc_arr = sg_arr[safec]
+        wc_arr = w_arr[safec]
+        mux_s0_arr = (f_arr - f0_arr).astype(np_dt)
+        mux_s1_arr = (f_arr - f1_arr + dhi_arr).astype(np_dt)
+        # lookup tables flattened with per-table offsets; index clamped within
+        # its own table (the unrolled path clips per table)
+        if prog.tables:
+            flat_tab = np.concatenate([np.asarray(t, np_dt) for t in prog.tables])
+            offs = np.cumsum([0] + [len(t) for t in prog.tables])
+        else:
+            flat_tab = np.zeros(1, np_dt)
+            offs = np.array([0, 1])
+        safet = np.clip(dlo_arr, 0, len(offs) - 2)
+        tab_off_arr = offs[safet].astype(np_dt)
+        tab_end_arr = (offs[safet + 1] - 1).astype(np_dt)
+        lut_zero_arr = (-sg_arr[safe0] * (1 << np.maximum(w_arr[safe0] - 1, 0))).astype(np_dt)
+        mask0_arr = ((1 << w_arr[safe0].astype(np.int64)) - 1).astype(np_dt)
+        bb_neg0 = ((dhi_arr & 1) != 0).astype(np_dt)
+        bb_neg1 = ((dhi_arr & 2) != 0).astype(np_dt)
+        bb_subop = (dhi_arr >> 24).astype(np_dt)
+
+        P = {
+            'branch': branch_arr, 'neg': neg_arr, 'id0': id0_arr.astype(np.int32), 'id1': id1_arr.astype(np.int32),
+            'dlo': dlo_arr.astype(np.int32), 'f': f_arr, 'sg': sg_arr, 'w': w_arr, 'f0': f0_arr, 'f1': f1_arr,
+            'a_shift': a_shift_arr, 'g_shift': g_shift_arr, 'const': const_arr, 'sgc': sgc_arr, 'wc': wc_arr,
+            'mux_s0': mux_s0_arr, 'mux_s1': mux_s1_arr, 'tab_off': tab_off_arr, 'tab_end': tab_end_arr,
+            'lut_zero': lut_zero_arr, 'mask0': mask0_arr, 'bb_neg0': bb_neg0, 'bb_neg1': bb_neg1,
+            'bb_subop': bb_subop, 'issub': sub_arr,
+        }  # fmt: skip
+        P = {k: jnp.asarray(v) for k, v in P.items()}
+        flat_tab_d = jnp.asarray(flat_tab)
+        one = jnp.asarray(1, dtype)
+
+        def shl(v, s):
+            return jnp.left_shift(v, jnp.maximum(s, 0)) >> jnp.maximum(-s, 0)
+
+        def wrap(v, sg, w):
+            mod = one << w
+            int_min = jnp.where(sg != 0, -(one << (w - 1)), jnp.asarray(0, dtype))
+            return ((v - int_min) % mod) + int_min
+
+        def fn(x):
+            # x: (batch, n_in) integers
+            batch = x.shape[0]
+            xT = x.T.astype(dtype)  # [n_in, batch]
+
+            def step(buf, p):
+                x0 = buf[p['id0']]
+                x1 = buf[p['id1']]
+                neg = p['neg'] != 0
+                sg, w, f = p['sg'], p['w'], p['f']
+
+                def quantize(v, f_from):
+                    return wrap(shl(v, f - f_from), sg, w)
+
+                def b_copy(_):
+                    return wrap(xT[p['id0']], sg, w)
+
+                def b_addsub(_):
+                    v2 = jnp.where(p['issub'] != 0, -x1, x1)
+                    a = p['a_shift']
+                    r = jnp.where(a > 0, x0 + shl(v2, jnp.maximum(a, 0)), shl(x0, jnp.maximum(-a, 0)) + v2)
+                    return jnp.where(p['g_shift'] > 0, r >> jnp.maximum(p['g_shift'], 0), r)
+
+                def b_relu(_):
+                    v = jnp.where(neg, -x0, x0)
+                    return jnp.where(v < 0, jnp.asarray(0, dtype), quantize(v, p['f0']))
+
+                def b_quant(_):
+                    return quantize(jnp.where(neg, -x0, x0), p['f0'])
+
+                def b_cadd(_):
+                    return shl(x0, f - p['f0']) + p['const'].astype(dtype)
+
+                def b_const(_):
+                    return jnp.full((batch,), p['const'], dtype=dtype)
+
+                def b_mux(_):
+                    vc = buf[p['dlo']]
+                    cond = jnp.where(p['sgc'] != 0, vc < 0, vc >= (one << (p['wc'] - 1)))
+                    v1 = jnp.where(neg, -x1, x1)
+                    r0 = wrap(shl(x0, p['mux_s0']), sg, w)
+                    r1 = wrap(shl(v1, p['mux_s1']), sg, w)
+                    return jnp.where(cond, r0, r1)
+
+                def b_mul(_):
+                    return x0 * x1
+
+                def b_lookup(_):
+                    index = x0 - p['lut_zero'] - p['dhi'] + p['tab_off']
+                    index = jnp.clip(index, p['tab_off'], p['tab_end'])
+                    return jnp.take(flat_tab_d, index, mode='clip')
+
+                def b_bitu(_):
+                    v = jnp.where(neg, -x0, x0)
+                    mask = p['mask0'].astype(dtype)
+                    r_not = jnp.where(sg != 0, ~v, (~v) & mask)
+                    r_any = (v != 0).astype(dtype)
+                    r_all = ((v & mask) == mask).astype(dtype)
+                    return jnp.where(p['dlo'] == 0, r_not, jnp.where(p['dlo'] == 1, r_any, r_all))
+
+                def b_bitb(_):
+                    v1 = jnp.where(p['bb_neg0'] != 0, -x0, x0)
+                    v2 = jnp.where(p['bb_neg1'] != 0, -x1, x1)
+                    a = p['a_shift']
+                    v2 = jnp.where(a > 0, shl(v2, jnp.maximum(a, 0)), v2)
+                    v1 = jnp.where(a > 0, v1, shl(v1, jnp.maximum(-a, 0)))
+                    so = p['bb_subop']
+                    return jnp.where(so == 0, v1 & v2, jnp.where(so == 1, v1 | v2, v1 ^ v2))
+
+                branches = [b_copy, b_addsub, b_relu, b_quant, b_cadd, b_const, b_mux, b_mul, b_lookup, b_bitu, b_bitb]
+                val = jax.lax.switch(p['branch'], branches, None)
+                buf = jax.lax.dynamic_update_slice(buf, val[None, :], (p['t'], jnp.asarray(0, jnp.int32)))
+                return buf, None
+
+            Pt = dict(P)
+            Pt['dhi'] = jnp.asarray(dhi_arr.astype(np_dt))
+            Pt['t'] = jnp.arange(n_ops, dtype=jnp.int32)
+            buf0 = jnp.zeros((n_ops, batch), dtype=dtype)
+            buf, _ = jax.lax.scan(step, buf0, Pt)
+
+            outs = []
+            for j in range(prog.n_out):
+                idx = int(prog.out_idxs[j])
+                if idx < 0:
+                    outs.append(jnp.zeros((batch,), dtype=dtype))
+                    continue
+                v = buf[idx]
+                outs.append(-v if prog.out_negs[j] else v)
+            return jnp.stack(outs, axis=-1)
+
+        return fn
+
+    def _int_inputs(self, data: NDArray[np.float64]) -> NDArray:
+        prog = self.prog
+        scale = np.zeros(prog.n_in, dtype=np.float64)
+        for i in range(prog.n_ops):
+            if prog.opcode[i] == -1:
+                i0 = int(prog.id0[i])
+                scale[i0] = 2.0 ** (int(prog.inp_shifts[i0]) + int(prog.fractionals[i]))
+        x = np.floor(np.asarray(data, dtype=np.float64).reshape(len(data), -1) * scale)
+        return x.astype(np.int64 if self.use_i64 else np.int32)
+
+    def _out_scale(self) -> NDArray[np.float64]:
+        prog = self.prog
+        sf = np.zeros(prog.n_out, dtype=np.float64)
+        for j in range(prog.n_out):
+            idx = int(prog.out_idxs[j])
+            if idx < 0:
+                continue
+            sf[j] = 2.0 ** (int(prog.out_shifts[j]) - int(prog.fractionals[idx]))
+        return sf
+
+    def __call__(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
+        x = self._int_inputs(data)
+        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        return out * self._out_scale()
+
+    def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:
+        """Batch inference with the sample axis sharded over a device mesh."""
+        from ..parallel import shard_batch
+
+        x, _ = shard_batch(self._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
+        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        return out[: len(data)] * self._out_scale()
+
+
+_executor_cache: OrderedDict[bytes, DaisExecutor] = OrderedDict()
+_EXECUTOR_CACHE_CAP = 256
+
+
+def executor_for_binary(binary: NDArray[np.int32]) -> DaisExecutor:
+    key = np.asarray(binary, dtype=np.int32).tobytes()
+    ex = _executor_cache.get(key)
+    if ex is None:
+        # LRU: long conversion sweeps touch many programs; evicting one cold
+        # entry keeps the rest of the working set (and its XLA compiles) warm
+        while len(_executor_cache) >= _EXECUTOR_CACHE_CAP:
+            _executor_cache.popitem(last=False)
+        _executor_cache[key] = ex = DaisExecutor(decode(binary))
+    else:
+        _executor_cache.move_to_end(key)
+    return ex
+
+
+def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64]) -> NDArray[np.float64]:
+    return executor_for_binary(binary)(data)

@@ -1,0 +1,904 @@
+"""Symbolic scalar fixed-point variable — the tracing primitive.
+
+A ``FixedVariable`` carries an exact value interval (low, high, step) in
+``Decimal`` (no float rounding in interval algebra), a power-of-two ``factor``
+tracking free shifts/negations, the producing operation (``opr``) with parent
+links, and the hardware cost/latency of producing it. Arithmetic on variables
+eagerly builds the trace graph; ``comb_trace`` lowers it to the DAIS IR.
+
+Behavioral parity: reference src/da4ml/trace/fixed_variable.py (same interval
+semantics, factor algebra, cost model, pipeline-cutoff latency snapping, cadd
+folding, CSD constant multiplication, msb_mux peepholes).
+"""
+
+from __future__ import annotations
+
+import itertools
+from decimal import Decimal
+from math import ceil, floor, log2
+from typing import NamedTuple
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ..ir.lut import LookupTable
+from ..ir.types import QInterval
+from ..cmvm.cost import cost_add
+
+_id_counter = itertools.count(1)
+
+
+class HWConfig(NamedTuple):
+    """(adder_size, carry_size, latency_cutoff) — cost model + pipelining config."""
+
+    adder_size: int
+    carry_size: int
+    latency_cutoff: float
+
+
+class TraceContext:
+    """Global deduplicating registry of lookup tables (keyed by content hash)."""
+
+    def __init__(self):
+        self._tables: dict[str, tuple[LookupTable, int]] = {}
+        self._counter = 0
+
+    def register_table(self, table: LookupTable | np.ndarray) -> tuple[LookupTable, int]:
+        if isinstance(table, np.ndarray):
+            table = LookupTable(table)
+        key = table.spec.hash
+        if key not in self._tables:
+            self._tables[key] = (table, self._counter)
+            self._counter += 1
+        return self._tables[key]
+
+    def get_table_from_index(self, index: int) -> LookupTable:
+        for table, idx in self._tables.values():
+            if idx == index:
+                return table
+        raise KeyError(f'No table with index {index}')
+
+
+table_context = TraceContext()
+
+
+def const_f(const: float | Decimal) -> int:
+    """Minimum f such that const * 2^f is an integer (bisection, reference
+    fixed_variable.py:201-214)."""
+    const = float(const)
+    if const == 0:
+        return -32
+    lo, hi = -32, 32
+    while hi - lo > 1:
+        mid = (hi + lo) // 2
+        v = const * (2.0**mid)
+        if v == int(v):
+            hi = mid
+        else:
+            lo = mid
+    return hi
+
+
+def to_csd_powers(x: float):
+    """Yield the signed powers of two of x's CSD form, high to low."""
+    if x == 0:
+        return
+    f = const_f(abs(x))
+    xi = x * 2**f
+    s = 2.0**-f
+    n = ceil(log2(abs(xi) * 1.5 + 1e-19))
+    for b in range(n - 1, -1, -1):
+        p = 2**b
+        thres = p / 1.5
+        bit = int(xi > thres) - int(xi < -thres)
+        xi -= p * bit
+        if bit:
+            yield p * bit * s
+
+
+class FixedVariable:
+    __is_input__ = False
+
+    __slots__ = ('low', 'high', 'step', '_factor', '_from', 'opr', '_data', 'id', 'hwconf', 'latency', 'cost')
+
+    def __init__(
+        self,
+        low,
+        high,
+        step,
+        latency: float | None = None,
+        hwconf: HWConfig | tuple = HWConfig(-1, -1, -1),
+        opr: str = 'new',
+        cost: float | None = None,
+        _from: tuple['FixedVariable', ...] = (),
+        _factor=1.0,
+        _data: Decimal | None = None,
+        _id: int | None = None,
+    ):
+        if not self.__is_input__:
+            assert low <= high, f'low {low} must be <= high {high}'
+        if low != high and opr == 'const':
+            raise ValueError('Constant variable must have low == high')
+        if low == high:
+            opr = 'const'
+            _from = ()
+            step = Decimal(2) ** -const_f(low)
+
+        self.low = Decimal(low)
+        self.high = Decimal(high)
+        self.step = Decimal(step)
+        self._factor = Decimal(_factor)
+        self._from = _from
+        self.opr = opr
+        self._data = _data
+        self.id = _id if _id is not None else next(_id_counter)
+        self.hwconf = HWConfig(*hwconf)
+
+        if opr == 'cadd':
+            assert _data is not None, 'cadd must have data'
+
+        if cost is None or latency is None:
+            _cost, _latency = self.get_cost_and_latency()
+        else:
+            _cost, _latency = cost, latency
+        self.latency = _latency
+        self.cost = _cost
+
+        # constants inherit the consumer's latency so they never pin stage 0
+        self._from = tuple(v if v.opr != 'const' else v._with(latency=self.latency) for v in self._from)
+
+    # ------------------------------------------------------------- basics
+
+    def _with(self, renew_id: bool = True, **kwargs) -> 'FixedVariable':
+        if not kwargs:
+            return self
+        var = FixedVariable.__new__(type(self))
+        for slot in FixedVariable.__slots__:
+            object.__setattr__(var, slot, getattr(self, slot))
+        for k, v in kwargs.items():
+            object.__setattr__(var, k, v)
+        if renew_id:
+            var.id = next(_id_counter)
+        return var
+
+    @property
+    def qint(self) -> QInterval:
+        return QInterval(float(self.low), float(self.high), float(self.step))
+
+    @property
+    def kif(self) -> tuple[bool, int, int]:
+        if self.step == 0:
+            return False, 0, 0
+        f = -int(log2(self.step))
+        xx = max(-self.low, self.high + self.step)
+        i = ceil(log2(xx))
+        return self.low < 0, i, f
+
+    @property
+    def unscaled(self) -> 'FixedVariable':
+        return self * (1 / self._factor)
+
+    @classmethod
+    def from_const(cls, const, hwconf: HWConfig, _factor=1):
+        if not isinstance(const, Decimal):
+            const = float(const)
+        return FixedVariable(const, const, -1, hwconf=hwconf, opr='const', _factor=_factor)
+
+    @classmethod
+    def from_kif(cls, k, i: int, f: int, **kwargs):
+        step = Decimal(2) ** -f
+        hi = Decimal(2) ** i
+        return cls(-int(k) * hi, hi - step, step, **kwargs)
+
+    def __repr__(self):
+        pre = f'({self._factor}) ' if self._factor != 1 else ''
+        return f'{pre}FixedVariable({self.low}, {self.high}, {self.step})'
+
+    # ---------------------------------------------------------- cost model
+
+    def get_cost_and_latency(self) -> tuple[float, float]:
+        """Cost (LUT estimate) and availability time of this value.
+
+        Reference fixed_variable.py:327-408, including the pipeline-cutoff
+        snapping rule: if an op crosses a latency_cutoff boundary its latency
+        is bumped to the next stage boundary.
+        """
+        opr = self.opr
+        if opr == 'const':
+            return 0.0, 0.0
+
+        if opr == 'lookup':
+            (v0,) = self._from
+            b_in = sum(v0.kif)
+            b_out = sum(self.kif)
+            latency = max(b_in - 6, 1) + v0.latency
+            cost = 2 ** max(b_in - 5, 0) * ceil(b_out / 2)
+            if b_in < 5:
+                cost *= b_in / 5
+            return cost, latency
+
+        if opr in ('vadd', 'cadd', 'min', 'max', 'vmul'):
+            adder_size, carry_size, latency_cutoff = self.hwconf
+            if opr in ('min', 'max', 'vadd'):
+                v0, v1 = self._from
+                base_latency = max(v0.latency, v1.latency)
+                dlat, cost = cost_add(v0.qint, v1.qint, 0, False, adder_size, carry_size)
+            elif opr == 'cadd':
+                assert self._data is not None
+                f = const_f(self._data)
+                cost = float(ceil(log2(abs(self._data) + Decimal(2) ** -f))) + f
+                base_latency = self._from[0].latency
+                dlat = 0.0
+            else:  # vmul
+                v0, v1 = self._from
+                b0, b1 = sum(v0.kif), sum(v1.kif)
+                dlat0, cost0 = cost_add(v0.qint, v0.qint, 0, False, adder_size, carry_size)
+                dlat1, cost1 = cost_add(v1.qint, v1.qint, 0, False, adder_size, carry_size)
+                dlat = max(dlat0 * b1, dlat1 * b0)
+                cost = min(cost0 * b1, cost1 * b0)
+                base_latency = max(v0.latency, v1.latency)
+
+            latency = dlat + base_latency
+            if latency_cutoff > 0 and ceil(latency / latency_cutoff) > ceil(base_latency / latency_cutoff):
+                assert dlat <= latency_cutoff, (
+                    f'Latency of an atomic operation {dlat} exceeds the pipelining latency cutoff {latency_cutoff}'
+                )
+                latency = ceil(base_latency / latency_cutoff) * latency_cutoff + dlat
+            return cost, latency
+
+        if opr in ('relu', 'wrap'):
+            (v0,) = self._from
+            cost = 0.0
+            if v0._factor < 0:
+                cost += sum(self.kif) / 2
+            if opr == 'relu':
+                cost += sum(self.kif) / 2
+            return cost, v0.latency
+
+        if opr == 'bit_binary':
+            return sum(self.kif) * 0.2, 1.0 + max(v.latency for v in self._from)
+
+        if opr == 'bit_unary':
+            if self._data == 0:
+                return 0.0, self._from[0].latency
+            return sum(self._from[0].kif) / 6, 1.0 + max(v.latency for v in self._from)
+
+        if opr == 'new':
+            return 0.0, 0.0
+
+        raise NotImplementedError(f'Operation {opr} is unknown')
+
+    # ------------------------------------------------------------- algebra
+
+    def __neg__(self):
+        opr = self.opr if self.low != self.high else 'const'
+        return FixedVariable(
+            -self.high,
+            -self.low,
+            self.step,
+            _from=self._from,
+            _factor=-self._factor,
+            latency=self.latency,
+            cost=self.cost,
+            opr=opr,
+            _id=self.id,
+            _data=self._data,
+            hwconf=self.hwconf,
+        )
+
+    def __add__(self, other):
+        if not isinstance(other, FixedVariable):
+            return self._const_add(other)
+        if other.high == other.low:
+            return self._const_add(other.low)
+        if self.high == self.low:
+            return other._const_add(self.low)
+
+        assert self.hwconf == other.hwconf, f'hwconf mismatch: {self.hwconf} vs {other.hwconf}'
+
+        f0, f1 = self._factor, other._factor
+        if f0 < 0:
+            if f1 > 0:
+                return other + self
+            return -((-self) + (-other))
+
+        return FixedVariable(
+            self.low + other.low,
+            self.high + other.high,
+            min(self.step, other.step),
+            _from=(self, other),
+            _factor=f0,
+            opr='vadd',
+            hwconf=self.hwconf,
+        )
+
+    def _const_add(self, other):
+        if other is None:
+            return self
+        if not isinstance(other, (int, float, Decimal)):
+            other = float(other)
+        other = Decimal(other)
+        if other == 0:
+            return self
+
+        if self.opr != 'cadd':
+            cstep = Decimal(2.0 ** -const_f(other))
+            return FixedVariable(
+                self.low + other,
+                self.high + other,
+                min(self.step, cstep),
+                _from=(self,),
+                _factor=self._factor,
+                _data=other / self._factor,
+                opr='cadd',
+                hwconf=self.hwconf,
+            )
+
+        # fold chained constant adds into the parent's cadd
+        (parent,) = self._from
+        assert self._data is not None
+        sf = self._factor / parent._factor
+        combined = (self._data * parent._factor) + other / sf
+        return (parent + combined) * sf
+
+    def __radd__(self, other):
+        return self + other
+
+    def __sub__(self, other):
+        return self + (-other)
+
+    def __rsub__(self, other):
+        return (-self) + other
+
+    def __truediv__(self, other):
+        assert not isinstance(other, FixedVariable), 'Division by a variable is not supported'
+        return self * (1 / other)
+
+    def __mul__(self, other):
+        if isinstance(other, FixedVariable):
+            if self.high == self.low:
+                return other * self.low
+            if other.high > other.low:
+                return self._var_mul(other)
+            other = float(other.low)
+
+        if self.high == self.low:
+            return self.from_const(float(self.low) * float(other), hwconf=self.hwconf)
+
+        if np.all(other == 0):
+            return FixedVariable(0, 0, 1, hwconf=self.hwconf, opr='const')
+
+        if log2(abs(other)) % 1 == 0:
+            return self._pow2_mul(other)
+
+        # constant multiply: CSD power expansion + balanced pair summation,
+        # quantizing each partial to its exact interval
+        variables = [(self._pow2_mul(p), Decimal(p)) for p in to_csd_powers(float(other))]
+        while len(variables) > 1:
+            v1, p1 = variables.pop()
+            v2, p2 = variables.pop()
+            v, p = v1 + v2, p1 + p2
+            if p > 0:
+                high, low = self.high * p, self.low * p
+            else:
+                high, low = self.low * p, self.high * p
+            low_f, high_f = float(low), float(high)
+            step = float(v.step)
+            k = low_f < 0
+            i = ceil(log2(max(-low_f, high_f + step)))
+            v = v.quantize(k, i, -int(log2(step)))
+            variables.append((v, p))
+        return variables[0][0]
+
+    def __rmul__(self, other):
+        return self * other
+
+    def _var_mul(self, other: 'FixedVariable') -> 'FixedVariable':
+        if other is not self:
+            cands = (self.high * other.low, self.low * other.high, self.high * other.high, self.low * other.low)
+            low, high = min(cands), max(cands)
+        else:
+            a, b = self.low * other.low, self.high * other.high
+            if self.low < 0 and self.high > 0:
+                low, high = min(a, b, Decimal(0)), max(a, b, Decimal(0))
+            else:
+                low, high = min(a, b), max(a, b)
+        return FixedVariable(
+            low,
+            high,
+            self.step * other.step,
+            _from=(self, other),
+            hwconf=self.hwconf,
+            _factor=self._factor * other._factor,
+            opr='vmul',
+        )
+
+    def _pow2_mul(self, other) -> 'FixedVariable':
+        other = Decimal(other)
+        low = min(self.low * other, self.high * other)
+        high = max(self.low * other, self.high * other)
+        return FixedVariable(
+            low,
+            high,
+            abs(self.step * other),
+            _from=self._from,
+            _factor=self._factor * other,
+            opr=self.opr,
+            latency=self.latency,
+            cost=self.cost,
+            _id=self.id,
+            _data=self._data,
+            hwconf=self.hwconf,
+        )
+
+    def __lshift__(self, other: int):
+        assert isinstance(other, int)
+        return self * 2.0**other
+
+    def __rshift__(self, other: int):
+        assert isinstance(other, int)
+        return self * 2.0**-other
+
+    def __pow__(self, other):
+        p = int(other)
+        assert p == other and p >= 0, 'Power must be a non-negative integer'
+        if p == 0:
+            return FixedVariable(1, 1, 1, hwconf=self.hwconf, opr='const')
+        if p == 1:
+            return self
+        half = p // 2
+        ret = (self**half) * (self ** (p - half))
+        if other % 2 == 0:
+            ret.low = max(ret.low, Decimal(0))
+        return ret
+
+    # ------------------------------------------------------ nonlinearities
+
+    def relu(self, i: int | None = None, f: int | None = None, round_mode: str = 'TRN'):
+        round_mode = round_mode.upper()
+        assert round_mode in ('TRN', 'RND')
+
+        if self.opr == 'const':
+            val = self.low * (self.low > 0)
+            f = const_f(val) if not f else f
+            step = Decimal(2) ** -f
+            i = ceil(log2(val + step)) if not i else i
+            eps = step / 2 if round_mode == 'RND' else 0
+            val = (floor(val / step + eps) * step) % (Decimal(2) ** i)
+            return self.from_const(val, hwconf=self.hwconf)
+
+        step = max(Decimal(2) ** -f, self.step) if f is not None else self.step
+        if step > self.step and round_mode == 'RND':
+            return (self + step / 2).relu(i, f, 'TRN')
+        low = max(Decimal(0), self.low)
+        high = self.high
+        high, low = floor(high / step) * step, floor(low / step) * step
+
+        if i is not None:
+            cap = Decimal(2) ** i - step
+            if cap < high:  # overflows: full wrap range
+                low = Decimal(0)
+                high = cap
+        high = max(Decimal(0), high)
+
+        if self.low == low and self.high == high and self.step == step:
+            return self
+
+        return FixedVariable(
+            low,
+            high,
+            step,
+            _from=(self,),
+            _factor=abs(self._factor),
+            opr='relu',
+            hwconf=self.hwconf,
+            cost=sum(self.kif) * (1 if self._factor > 0 else 2),
+        )
+
+    def quantize(
+        self,
+        k: int | bool,
+        i: int,
+        f: int,
+        overflow_mode: str = 'WRAP',
+        round_mode: str = 'TRN',
+        _force_factor_clear: bool = False,
+    ) -> 'FixedVariable':
+        overflow_mode, round_mode = overflow_mode.upper(), round_mode.upper()
+        assert overflow_mode in ('WRAP', 'SAT', 'SAT_SYM')
+        assert round_mode in ('TRN', 'RND')
+        k, i, f = int(k), int(i), int(f)
+
+        if k + i + f <= 0:
+            return FixedVariable(0, 0, 1, hwconf=self.hwconf, opr='const')
+        _k, _i, _f = self.kif
+
+        if k >= _k and i >= _i and f >= _f and not _force_factor_clear:
+            if overflow_mode != 'SAT_SYM' or i > _i:
+                return self
+
+        if f < _f and round_mode == 'RND':
+            return (self + 2.0 ** (-f - 1)).quantize(k, i, f, overflow_mode, 'TRN')
+
+        if overflow_mode in ('SAT', 'SAT_SYM'):
+            step = Decimal(2) ** -f
+            hi = Decimal(2) ** i
+            high = hi - step
+            low = -hi * k if overflow_mode == 'SAT' else -high * k
+            ff = f + 1 if round_mode == 'RND' else f
+            v = self.quantize(_k, _i, ff, 'WRAP', 'TRN') if _k + _i + ff > 0 else self
+            return v.max_of(low).min_of(high).quantize(k, i, f, 'WRAP', round_mode)
+
+        if self.low == self.high:
+            val = self.low
+            step = Decimal(2) ** -f
+            hi = Decimal(2) ** i
+            low = -hi * k
+            val = (floor(val / step) * step - low) % (2 * hi) + low
+            return FixedVariable.from_const(val, hwconf=self.hwconf, _factor=1)
+
+        f = min(f, _f)
+        k = min(k, _k) if i >= _i else k
+
+        step = Decimal(2) ** -f
+        if self.low < 0:
+            _low = floor(self.low / step) * step
+            _i = max(_i, ceil(log2(-_low)))
+        i = min(i, _i + (k == 0 and _k == 1))
+
+        if i + k + f <= 0:
+            return FixedVariable(0, 0, 1, hwconf=self.hwconf, opr='const')
+
+        low = -int(k) * Decimal(2) ** i
+        high = Decimal(2) ** i - step
+        if self.low >= low and self.high <= high:
+            low = floor(self.low / step) * step
+            high = floor(self.high / step) * step
+
+        return FixedVariable(
+            low,
+            high,
+            step,
+            _from=(self,),
+            _factor=abs(self._factor),
+            opr='wrap',
+            latency=self.latency,
+            hwconf=self.hwconf,
+        )
+
+    # ------------------------------------------------------------ branching
+
+    def msb_mux(self, a, b, qint=None, zt_sensitive: bool = True):
+        """MSB(self) ? a : b. Signed: MSB is the sign bit."""
+        if not isinstance(a, FixedVariable):
+            a = FixedVariable.from_const(a, hwconf=self.hwconf, _factor=1)
+        if not isinstance(b, FixedVariable):
+            b = FixedVariable.from_const(b, hwconf=self.hwconf, _factor=1)
+        if self._factor < 0:
+            if zt_sensitive:
+                return self.msb().msb_mux(a, b, qint)
+            return (-self).msb_mux(b, a, qint, zt_sensitive=False)
+
+        if self.opr == 'const':
+            if self.low >= 0:
+                return b if self.high == 0 else a
+            return b if log2(abs(self.low)) % 1 == 0 else a
+        if self.opr == 'wrap':
+            # see-through: the wrap kept the sign-significant bits intact
+            k, i, _ = self.kif
+            k0, i0, _ = self._from[0].kif
+            f_self, f0 = self._factor, self._from[0]._factor
+            if k + i == k0 + i0 + log2(abs(f_self / f0)):
+                if f_self * f0 > 0 or not zt_sensitive:
+                    return self._from[0].msb_mux(a, b, qint=qint, zt_sensitive=zt_sensitive)
+
+        if a._factor < 0:
+            qint = (-qint[1], -qint[0], qint[2]) if qint else None
+            return -(self.msb_mux(-a, -b, qint=qint, zt_sensitive=zt_sensitive))
+
+        _factor = a._factor
+
+        if qint is None:
+            qint = (float(min(a.low, b.low)), float(max(a.high, b.high)), float(min(a.step, b.step)))
+        else:
+            _min, _max, _step = qint
+            step = float(min(a.step, b.step))
+            assert _step <= step, f'msb_mux cannot imply rounding: step {_step} > min operand step {step}'
+            _min = max(floor(_min / step) * step, float(min(a.low, b.low)))
+            _max = min(floor(_max / step) * step, float(max(a.high, b.high)))
+            qint = (_min, _max, step)
+
+        dlat, dcost = cost_add(a.qint, b.qint, 0, False, self.hwconf.adder_size, self.hwconf.carry_size)
+        dcost = dcost / 2
+
+        if a.opr == 'const' and a._factor != b._factor:
+            _factor = b._factor
+            a = a._with(_factor=b._factor, renew_id=True)
+        if b.opr == 'const' and a._factor != b._factor:
+            _factor = a._factor
+            b = b._with(_factor=a._factor, renew_id=True)
+
+        return FixedVariable(
+            *qint,
+            _from=(self, a, b),
+            _factor=_factor,
+            opr='msb_mux',
+            latency=max(a.latency, b.latency, self.latency) + dlat,
+            hwconf=self.hwconf,
+            cost=dcost,
+        )
+
+    def msb(self) -> 'FixedVariable':
+        k, i, _ = self.kif
+        return self.quantize(0, i + k, -i - k + 1, _force_factor_clear=True) >> (i + k - 1)
+
+    def is_negative(self) -> 'FixedVariable':
+        if self.low >= 0:
+            return self.from_const(0, hwconf=self.hwconf)
+        if self.high < 0:
+            return self.from_const(1, hwconf=self.hwconf)
+        return self.msb()
+
+    def is_positive(self) -> 'FixedVariable':
+        return (-self).is_negative()
+
+    def __abs__(self):
+        if self.low >= 0:
+            return self
+        high = max(-self.low, self.high)
+        return self.msb_mux(-self, self, (0, float(high), float(self.step)), zt_sensitive=False)
+
+    def abs(self):
+        return abs(self)
+
+    def __gt__(self, other):
+        return (self - other).is_positive()
+
+    def __lt__(self, other):
+        return (other - self).is_positive()
+
+    def __ge__(self, other):
+        return ~(self - other).is_negative()
+
+    def __le__(self, other):
+        return ~(other - self).is_negative()
+
+    def max_of(self, other):
+        if other == -float('inf'):
+            return self
+        if other == float('inf'):
+            raise ValueError('Cannot apply max_of with inf')
+        if not isinstance(other, FixedVariable):
+            other = FixedVariable.from_const(other, hwconf=self.hwconf, _factor=abs(self._factor))
+        if self.low >= other.high:
+            return self
+        if self.high <= other.low:
+            return other
+        if other.high == other.low == 0:
+            return self.relu()
+        qint = (float(max(self.low, other.low)), float(max(self.high, other.high)), float(min(self.step, other.step)))
+        return (self - other).msb_mux(other, self, qint=qint, zt_sensitive=False)
+
+    def min_of(self, other):
+        if other == float('inf'):
+            return self
+        if other == -float('inf'):
+            raise ValueError('Cannot apply min_of with -inf')
+        if not isinstance(other, FixedVariable):
+            other = FixedVariable.from_const(other, hwconf=self.hwconf, _factor=self._factor)
+        if self.high <= other.low:
+            return self
+        if self.low >= other.high:
+            return other
+        if other.high == other.low == 0:
+            return -(-self).relu()
+        qint = (float(min(self.low, other.low)), float(min(self.high, other.high)), float(min(self.step, other.step)))
+        return (self - other).msb_mux(self, other, qint=qint, zt_sensitive=False)
+
+    # ---------------------------------------------------------------- LUTs
+
+    def lookup(self, table: LookupTable | np.ndarray, original_qint=None) -> 'FixedVariable':
+        """Map this variable through a lookup table.
+
+        numpy tables start at the variable's lowest possible value; a provided
+        ``original_qint`` re-slices the table to this variable's interval.
+        """
+        size = len(table)
+        was_numpy = isinstance(table, np.ndarray)
+        if original_qint is not None:
+            o_min, o_max, o_step = original_qint
+            assert round((o_max - o_min) / o_step) + 1 == size, f'table size {size} != original qint {original_qint}'
+            _min, _max, _step = self.qint
+            assert o_step <= _step and o_max >= _max and o_min <= _min, (
+                f'Original qint {original_qint} does not cover the variable {self.qint}'
+            )
+            bias0 = round((_min - o_min) / o_step)
+            bias1 = round((o_max - _max) / o_step)
+            stride = round(_step / o_step)
+            values = table.float_table if isinstance(table, LookupTable) else np.asarray(table, dtype=np.float64)
+            table = values[bias0 : size - bias1 : stride]
+            size = len(table)
+
+        assert round((self.high - self.low) / self.step) + 1 == size, (
+            f'Variable index space ({round((self.high - self.low) / self.step) + 1}) != table size ({size})'
+        )
+
+        if was_numpy and isinstance(table, np.ndarray):
+            if len(table) == 1:
+                return self.from_const(float(table[0]), hwconf=self.hwconf)
+            if self._factor < 0:
+                table = table[::-1]
+
+        _table, table_id = table_context.register_table(table)
+        return FixedVariable(
+            _table.spec.out_qint.min,
+            _table.spec.out_qint.max,
+            _table.spec.out_qint.step,
+            _from=(self,),
+            _factor=Decimal(1),
+            opr='lookup',
+            hwconf=self.hwconf,
+            _data=Decimal(table_id),
+        )
+
+    # ------------------------------------------------------------- bit ops
+
+    def unary_bit_op(self, _type: str):
+        ops = {'not': 0, 'any': 1, 'all': 2}
+        if self.opr == 'const':
+            from ..ops.numeric import numeric_unary_bit_op
+
+            v = numeric_unary_bit_op(float(self.low), ops[_type], self.qint)
+            return self.from_const(v, hwconf=self.hwconf)
+
+        if sum(self.kif) == 1 and _type in ('any', 'all'):
+            return self.msb()
+
+        _data = Decimal(ops[_type])
+        if _type == 'not':
+            k, i, f = self.kif
+            return FixedVariable.from_kif(
+                k, i, f, hwconf=self.hwconf, opr='bit_unary', _data=_data, _from=(self,), _factor=abs(self._factor)
+            )
+        if _type == 'all':
+            if self.low > 0:
+                return self.from_const(0, hwconf=self.hwconf)
+            if self.high < -self.step:
+                return self.from_const(0, hwconf=self.hwconf)
+            if self.low == 0:
+                _max = log2(self.high + self.step)
+                if _max % 1 != 0:  # the all-ones code is unreachable
+                    return self.from_const(0, hwconf=self.hwconf)
+        return FixedVariable(0, 1, 1, hwconf=self.hwconf, opr='bit_unary', _data=_data, _from=(self,), _factor=abs(self._factor))
+
+    def binary_bit_op(self, other: 'FixedVariable', _type: str):
+        ops = {'and': 0, 'or': 1, 'xor': 2}
+        k0, i0, f0 = self.kif
+        k1, i1, f1 = other.kif
+        k, i, f = max(k0, k1), max(i0, i1), max(f0, f1)
+        qint = QInterval(-k * 2.0**i, 2.0**i - 2.0**-f, 2.0**-f)
+        if self.opr == 'const' and other.opr == 'const':
+            from ..ops.numeric import numeric_binary_bit_op
+
+            v = numeric_binary_bit_op(float(self.low), float(other.low), ops[_type], self.qint, other.qint, qint)
+            return self.from_const(v, hwconf=self.hwconf)
+        if self.opr == 'const' and self.low == 0:
+            if _type == 'and':
+                return self
+            return other
+        if other.opr == 'const' and other.low == 0:
+            return other.binary_bit_op(self, _type)
+        return FixedVariable(
+            *qint, hwconf=self.hwconf, opr='bit_binary', _data=Decimal(ops[_type]), _from=(self, other), _factor=abs(self._factor)
+        )
+
+    def _coerce(self, other):
+        if not isinstance(other, FixedVariable):
+            other = FixedVariable.from_const(other, hwconf=self.hwconf, _factor=abs(self._factor))
+        return other
+
+    def __and__(self, other):
+        return self.binary_bit_op(self._coerce(other), 'and')
+
+    def __or__(self, other):
+        return self.binary_bit_op(self._coerce(other), 'or')
+
+    def __xor__(self, other):
+        return self.binary_bit_op(self._coerce(other), 'xor')
+
+    __rand__ = __and__
+    __ror__ = __or__
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        return self.unary_bit_op('not')
+
+    def _ne(self, other):
+        other = self._coerce(other)
+        return (self - other).unary_bit_op('any')
+
+    def _eq(self, other):
+        return ~(self._ne(other))
+
+
+class FixedVariableInput(FixedVariable):
+    """Unquantized input sentinel: only quantize is legal, and it *widens* the
+    recorded input precision to the largest requested (reference
+    fixed_variable.py:1101-1198)."""
+
+    __is_input__ = True
+
+    def __init__(self, latency: float | None = None, hwconf: HWConfig | tuple = HWConfig(-1, -1, -1), opr: str = 'new'):
+        super().__init__(
+            low=Decimal(1e10),
+            high=Decimal(-1e10),
+            step=Decimal(1e10),
+            latency=latency if latency is not None else 0.0,
+            hwconf=HWConfig(*hwconf),
+            opr=opr,
+            cost=0.0,
+            _factor=Decimal(1),
+        )
+
+    def _illegal(self, *a, **k):
+        raise ValueError('Cannot operate on unquantized input variable')
+
+    def __add__(self, other):
+        if not isinstance(other, FixedVariable) and other == 0:
+            return self
+        raise ValueError('Cannot operate on unquantized input variable')
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        if not isinstance(other, FixedVariable) and other == 0:
+            return self
+        raise ValueError('Cannot operate on unquantized input variable')
+
+    def __rsub__(self, other):
+        raise ValueError('Cannot operate on unquantized input variable')
+
+    def __neg__(self):
+        raise ValueError('Cannot negate unquantized input variable')
+
+    def __mul__(self, other):
+        if not isinstance(other, FixedVariable) and other == 1:
+            return self
+        raise ValueError('Cannot multiply unquantized input variable')
+
+    __rmul__ = __mul__
+
+    def relu(self, *args, **kwargs):
+        raise ValueError('Cannot apply relu on unquantized input variable')
+
+    def max_of(self, other):
+        raise ValueError('Cannot apply max_of on unquantized input variable')
+
+    def min_of(self, other):
+        raise ValueError('Cannot apply min_of on unquantized input variable')
+
+    def quantize(self, k, i, f, overflow_mode: str = 'WRAP', round_mode: str = 'TRN', _force_factor_clear=False):
+        assert overflow_mode == 'WRAP', 'Input quantization must use WRAP'
+        if k + i + f <= 0:
+            return FixedVariable(0, 0, 1, hwconf=self.hwconf, opr='const')
+        if round_mode == 'RND':
+            return (self.quantize(k, i, f + 1) + 2.0 ** (-f - 1)).quantize(k, i, f, overflow_mode, 'TRN')
+
+        step = Decimal(2) ** -f
+        hi = Decimal(2) ** i
+        low, high = -hi * int(k), hi - step
+        # widen the recorded input precision to cover this request
+        self.high = max(self.high, high)
+        self.low = min(self.low, low)
+        self.step = min(self.step, step)
+
+        return FixedVariable(
+            low,
+            high,
+            step,
+            _from=(self,),
+            _factor=self._factor,
+            opr='wrap',
+            latency=self.latency,
+            hwconf=self.hwconf,
+        )

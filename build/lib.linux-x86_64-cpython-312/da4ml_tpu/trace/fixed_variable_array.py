@@ -1,0 +1,683 @@
+"""Symbolic arrays of FixedVariable with the numpy protocol.
+
+``FixedVariableArray`` wraps an object-dtype ndarray of FixedVariable and
+implements ``__array_ufunc__`` / ``__array_function__`` so models can be
+traced with plain numpy code. Constant-matrix multiplies route through the
+CMVM solver (``backend`` in solver_options picks cpu/jax/cpp); everything
+else lowers to elementwise variable ops, heap reductions, mux networks.
+
+Behavioral parity: reference src/da4ml/trace/fixed_variable_array.py.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from inspect import signature
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ..cmvm import solve, solver_options_t
+from ..ir.lut import LookupTable
+from ..ir.types import QInterval
+from .fixed_variable import FixedVariable, FixedVariableInput, HWConfig
+from .ops import einsum, reduce, sort
+from .ops.quantization import fixed_quantize
+
+
+def to_raw_arr(obj):
+    if isinstance(obj, tuple):
+        return tuple(to_raw_arr(x) for x in obj)
+    if isinstance(obj, list):
+        return [to_raw_arr(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: to_raw_arr(v) for k, v in obj.items()}
+    if isinstance(obj, FixedVariableArray):
+        return obj._vars
+    return obj
+
+
+def _max_of(a, b):
+    if isinstance(a, FixedVariable):
+        return a.max_of(b)
+    if isinstance(b, FixedVariable):
+        return b.max_of(a)
+    return max(a, b)
+
+
+def _min_of(a, b):
+    if isinstance(a, FixedVariable):
+        return a.min_of(b)
+    if isinstance(b, FixedVariable):
+        return b.min_of(a)
+    return min(a, b)
+
+
+def mmm(mat0: np.ndarray, mat1: np.ndarray):
+    """Naive symbolic matrix multiply (explicit multipliers + adder trees)."""
+    shape = mat0.shape[:-1] + mat1.shape[1:]
+    mat0 = mat0.reshape((-1, mat0.shape[-1]))
+    mat1 = mat1.reshape((mat1.shape[0], -1))
+    out = np.empty((mat0.shape[0], mat1.shape[1]), dtype=object)
+    for i in range(mat0.shape[0]):
+        for j in range(mat1.shape[1]):
+            out[i, j] = reduce(lambda x, y: x + y, mat0[i] * mat1[:, j])
+    return out.reshape(shape)
+
+
+def _merged_opts(v: 'FixedVariableArray', solver_options: solver_options_t) -> dict:
+    """solver_options with hwconf-derived defaults, ready for ``solve(**opts)``
+    (offload_fn is handled by the callers, never forwarded)."""
+    hwconf = v._vars.ravel()[0].hwconf
+    opts = dict(solver_options)
+    opts.setdefault('adder_size', hwconf.adder_size)
+    opts.setdefault('carry_size', hwconf.carry_size)
+    opts.pop('offload_fn', None)
+    return opts
+
+
+def cmvm(cm: np.ndarray, v: 'FixedVariableArray', solver_options: solver_options_t) -> np.ndarray:
+    """Solve vec @ cm as a shift-add network and merge it into the trace.
+
+    The solver's Pipeline is replayed symbolically over the input variables so
+    its ops join the graph. ``offload_fn`` may divert selected weights to
+    explicit multipliers.
+    """
+    offload_fn = solver_options.get('offload_fn', None)
+    mask = offload_fn(cm, v) if offload_fn is not None else None
+    if mask is not None and np.any(mask):
+        mask = np.asarray(mask, dtype=np.bool_)
+        assert mask.shape == cm.shape, f'Offload mask shape {mask.shape} != CM shape {cm.shape}'
+        offload_cm = cm * mask.astype(cm.dtype)
+        cm = cm * (~mask).astype(cm.dtype)
+        if np.all(cm == 0):
+            return mmm(v._vars, offload_cm)
+    else:
+        offload_cm = None
+
+    qintervals = [QInterval(float(_v.low), float(_v.high), float(_v.step)) for _v in v._vars]
+    latencies = [float(_v.latency) for _v in v._vars]
+    opts = _merged_opts(v, solver_options)
+    sol = solve(np.ascontiguousarray(cm, dtype=np.float64), qintervals=qintervals, latencies=latencies, **opts)
+    result: np.ndarray = sol(v._vars)
+    if offload_cm is not None:
+        result = result + mmm(v._vars, offload_cm)
+    return result
+
+
+def cmvm_rows(cm: np.ndarray, rows: 'FixedVariableArray', solver_options: solver_options_t) -> list[np.ndarray]:
+    """Solve ``rows[i] @ cm`` for every row of a 2-d variable matrix.
+
+    On the jax backend all rows go to the device as one lane batch (the rows
+    share the kernel but differ in qintervals/latencies — exactly the batch
+    axis the TPU search parallelizes over); other backends solve per row.
+    ``offload_fn`` forces the per-row path (masks depend on the row).
+    """
+    n_rows = rows.shape[0]
+    if solver_options.get('offload_fn') is not None:
+        # masks depend on the row -> per-row path
+        return [cmvm(cm, rows[i], solver_options) for i in range(n_rows)]
+
+    # The solution depends on the row only through (qintervals, latencies) —
+    # rows with identical metadata (e.g. every interior patch of a conv)
+    # share one solve, replayed symbolically per row.
+    qints_list, lats_list = [], []
+    keys: list[tuple] = []
+    for i in range(n_rows):
+        v = rows._vars[i]
+        qints = [QInterval(float(x.low), float(x.high), float(x.step)) for x in v]
+        lats = [float(x.latency) for x in v]
+        qints_list.append(qints)
+        lats_list.append(lats)
+        keys.append((tuple(qints), tuple(lats)))
+    uniq: dict[tuple, int] = {}
+    rep: list[int] = []  # unique-group index per row
+    for k in keys:
+        rep.append(uniq.setdefault(k, len(uniq)))
+    uniq_idx = [0] * len(uniq)
+    for i, g in enumerate(rep):
+        uniq_idx[g] = i  # any representative row works
+
+    if solver_options.get('backend') != 'jax' or len(uniq) <= 1:
+        usols = [_solve_one(cm, qints_list[i], lats_list[i], rows, solver_options) for i in uniq_idx]
+        return [usols[g](rows._vars[i]) for i, g in zip(range(n_rows), rep)]
+
+    from ..cmvm.jax_search import solve_jax_many
+
+    opts = _merged_opts(rows, solver_options)
+    kw = {
+        k: opts[k]
+        for k in (
+            'method0',
+            'method1',
+            'hard_dc',
+            'decompose_dc',
+            'adder_size',
+            'carry_size',
+            'search_all_decompose_dc',
+            'method0_candidates',
+        )
+        if k in opts
+    }
+    cm64 = np.ascontiguousarray(cm, dtype=np.float64)
+    usols = solve_jax_many(
+        [cm64] * len(uniq),
+        qintervals_list=[qints_list[i] for i in uniq_idx],
+        latencies_list=[lats_list[i] for i in uniq_idx],
+        **kw,
+    )
+    return [usols[g](rows._vars[i]) for i, g in zip(range(n_rows), rep)]
+
+
+def _solve_one(cm, qintervals, latencies, rows: 'FixedVariableArray', solver_options: solver_options_t):
+    opts = _merged_opts(rows, solver_options)
+    return solve(np.ascontiguousarray(cm, dtype=np.float64), qintervals=qintervals, latencies=latencies, **opts)
+
+
+_unary_ufuncs = (
+    np.sin, np.cos, np.tan, np.exp, np.log, np.invert, np.sqrt, np.tanh, np.sinh, np.cosh,
+    np.arccos, np.arcsin, np.arctan, np.arcsinh, np.arccosh, np.arctanh, np.exp2, np.expm1,
+    np.log2, np.log10, np.log1p, np.cbrt, np.reciprocal,
+)  # fmt: skip
+
+
+class FixedVariableArray:
+    """Symbolic array of FixedVariable supporting numpy ufuncs and functions."""
+
+    __array_priority__ = 100
+
+    def __init__(
+        self,
+        vars: NDArray,
+        solver_options: solver_options_t | None = None,
+        hwconf: HWConfig | tuple | None = None,
+    ):
+        _vars = np.array(vars)
+        flat = _vars.ravel()
+        if hwconf is None:
+            hwconf = next(iter(v for v in flat if isinstance(v, FixedVariable))).hwconf
+        hwconf = HWConfig(*hwconf)
+        self.hwconf = hwconf
+        for i, v in enumerate(flat):
+            if not isinstance(v, FixedVariable):
+                flat[i] = FixedVariable(float(v), float(v), 1.0, hwconf=hwconf)
+        self._vars = _vars
+        opts = dict(solver_options) if solver_options is not None else {}
+        opts.pop('qintervals', None)
+        opts.pop('latencies', None)
+        self.solver_options: solver_options_t = opts  # type: ignore[assignment]
+
+    # ------------------------------------------------------------ factories
+
+    @classmethod
+    def from_lhs(cls, low, high, step, hwconf=HWConfig(1, -1, -1), latency=0.0, solver_options=None):
+        low, high, step = np.array(low), np.array(high), np.array(step)
+        shape = low.shape
+        assert shape == high.shape == step.shape
+        lat = np.full(low.size, latency, dtype=np.float64) if np.isscalar(latency) else np.asarray(latency).ravel()
+        vars_ = [
+            FixedVariable(float(lo), float(hi), float(st), hwconf=hwconf, latency=float(lt))
+            for lo, hi, st, lt in zip(low.ravel(), high.ravel(), step.ravel(), lat)
+        ]
+        return cls(np.array(vars_).reshape(shape), solver_options)
+
+    @classmethod
+    def from_kif(cls, k, i, f, hwconf=HWConfig(1, -1, -1), latency=0.0, solver_options=None):
+        k, i, f = np.broadcast_arrays(k, i, f)
+        mask = np.asarray(k) + np.asarray(i) + np.asarray(f) <= 0
+        k = np.where(mask, 0, k)
+        i = np.where(mask, 0, i)
+        f = np.where(mask, 0, f)
+        step = 2.0 ** -f.astype(np.float64)
+        hi = 2.0 ** i.astype(np.float64)
+        return cls.from_lhs(-hi * k, hi - step, step, hwconf, latency, solver_options)
+
+    # --------------------------------------------------------- numpy hooks
+
+    def __array_function__(self, func, types, args, kwargs):
+        if func in (np.mean, np.sum, np.amax, np.amin, np.max, np.min, np.prod, np.all, np.any):
+            if func is np.mean:
+                x = reduce(lambda a, b: a + b, *args, **kwargs)
+                size = x.size if isinstance(x, FixedVariableArray) else 1
+                return x * (size / self._vars.size)
+            if func is np.sum:
+                return reduce(lambda a, b: a + b, *args, **kwargs)
+            if func in (np.max, np.amax):
+                return reduce(_max_of, *args, **kwargs)
+            if func in (np.min, np.amin):
+                return reduce(_min_of, *args, **kwargs)
+            if func is np.prod:
+                return reduce(lambda a, b: a * b, *args, **kwargs)
+            if func in (np.all, np.any):
+                assert len(args) >= 1 and args[0] is self
+                booled = self.to_bool('any')
+                op = (lambda a, b: a & b) if func is np.all else (lambda a, b: a | b)
+                return reduce(op, booled, *args[1:], **kwargs)
+
+        if func is np.clip:
+            assert len(args) == 3, 'np.clip requires exactly three arguments'
+            x, low, high = args
+            _x, low, high = np.broadcast_arrays(x, low, high)
+            x = FixedVariableArray(_x, self.solver_options, hwconf=self.hwconf)
+            x = np.amax(np.stack((x, low), axis=-1), axis=-1)
+            return np.amin(np.stack((x, high), axis=-1), axis=-1)
+
+        if func is np.einsum:
+            sig = signature(np.einsum)
+            bind = sig.bind(*args, **kwargs)
+            eq = args[0]
+            operands = bind.arguments['operands']
+            if isinstance(operands[0], str):
+                operands = operands[1:]
+            assert len(operands) == 2, 'einsum on FixedVariableArray requires exactly two operands'
+            assert bind.arguments.get('out', None) is None, 'out= is not supported'
+            return einsum(eq, *operands)
+
+        if func is np.dot:
+            assert len(args) == 2
+            a, b = args
+            if not isinstance(a, FixedVariableArray):
+                a = np.array(a)
+            if not isinstance(b, FixedVariableArray):
+                b = np.array(b)
+            if a.shape and b.shape and a.shape[-1] == b.shape[0]:
+                return a @ b
+            assert a.size == 1 or b.size == 1, f'Error in dot product: {a.shape} @ {b.shape}'
+            return a * b
+
+        if func is np.where:
+            assert len(args) == 3
+            cond, x, y = args
+            if isinstance(cond, FixedVariableArray):
+                cond = cond.to_bool('any')
+            else:
+                return FixedVariableArray(np.where(cond, to_raw_arr(x), to_raw_arr(y)), self.solver_options, hwconf=self.hwconf)
+            cond, x, y = np.broadcast_arrays(cond, x, y)
+            shape = cond.shape
+            r = [c.msb_mux(xv, yv) for c, xv, yv in zip(cond.ravel(), x.ravel(), y.ravel())]
+            return FixedVariableArray(np.array(r).reshape(shape), self.solver_options, hwconf=self.hwconf)
+
+        if func is np.sort:
+            return sort(*args, **kwargs)
+
+        if func is np.argsort:
+            a = args[0] if args else kwargs.get('a')
+            assert a.ndim == 1, 'argsort on FixedVariableArray only supports 1D arrays'
+            return _ArgsortDelayedIndex(args, kwargs)
+
+        args, kwargs = to_raw_arr(args), to_raw_arr(kwargs)
+        return FixedVariableArray(func(*args, **kwargs), self.solver_options, hwconf=self.hwconf)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        assert method == '__call__', f'Only __call__ is supported for ufuncs, got {method}'
+
+        if ufunc in (np.add, np.subtract, np.multiply, np.true_divide, np.negative):
+            inputs = [to_raw_arr(x) for x in inputs]
+            return FixedVariableArray(ufunc(*inputs, **kwargs), self.solver_options, hwconf=self.hwconf)
+
+        if ufunc in (np.maximum, np.minimum):
+            op = _max_of if ufunc is np.maximum else _min_of
+            a, b = np.broadcast_arrays(to_raw_arr(inputs[0]), to_raw_arr(inputs[1]))
+            r = np.empty(a.size, dtype=object)
+            for i, (av, bv) in enumerate(zip(a.ravel(), b.ravel())):
+                r[i] = op(av, bv)
+            return FixedVariableArray(r.reshape(a.shape), self.solver_options, hwconf=self.hwconf)
+
+        if ufunc is np.matmul:
+            assert len(inputs) == 2
+            if isinstance(inputs[0], FixedVariableArray):
+                return inputs[0].matmul(inputs[1])
+            return inputs[1].rmatmul(inputs[0])
+
+        if ufunc is np.power:
+            base, exp = inputs
+            return base**exp
+
+        if ufunc in (np.abs, np.absolute):
+            assert inputs[0] is self
+            r = np.array([v.__abs__() for v in self._vars.ravel()])
+            return FixedVariableArray(r.reshape(self.shape), self.solver_options, hwconf=self.hwconf)
+
+        if ufunc is np.square:
+            assert inputs[0] is self
+            return self**2
+
+        if ufunc in _unary_ufuncs:
+            assert len(inputs) == 1 and inputs[0] is self
+            return self.apply(ufunc)
+
+        raise NotImplementedError(f'Unsupported ufunc: {ufunc}')
+
+    # -------------------------------------------------------------- matmul
+
+    def matmul(self, other) -> 'FixedVariableArray':
+        if self.collapsed:
+            self_mat = np.array([v.low for v in self._vars.ravel()], dtype=np.float64).reshape(self._vars.shape)
+            if isinstance(other, FixedVariableArray):
+                if not other.collapsed:
+                    return self_mat @ other
+                other_mat = np.array([v.low for v in other._vars.ravel()], dtype=np.float64).reshape(other._vars.shape)
+            else:
+                other_mat = np.array(other, dtype=np.float64)
+            r = self_mat @ other_mat
+            return FixedVariableArray.from_lhs(r, r, np.ones_like(r), hwconf=self.hwconf, solver_options=self.solver_options)
+
+        if isinstance(other, FixedVariableArray):
+            other = other._vars
+        if not isinstance(other, np.ndarray):
+            other = np.array(other)
+        if any(isinstance(x, FixedVariable) for x in other.ravel()):
+            return FixedVariableArray(mmm(self._vars, other), self.solver_options, hwconf=self.hwconf)
+
+        solver_options = dict(self.solver_options or {})
+        shape0, shape1 = self.shape, other.shape
+        assert shape0[-1] == shape1[0], f'Matrix shapes do not match: {shape0} @ {shape1}'
+        contract_len = shape1[0]
+        out_shape = shape0[:-1] + shape1[1:]
+        mat0 = self.reshape((-1, contract_len))
+        mat1 = other.reshape((contract_len, -1))
+        rows = cmvm_rows(mat1, mat0, solver_options)
+        return FixedVariableArray(np.array(rows).reshape(out_shape), self.solver_options, hwconf=self.hwconf)
+
+    def __matmul__(self, other):
+        return self.matmul(other)
+
+    def rmatmul(self, other):
+        mat1 = np.moveaxis(other, -1, 0)
+        mat0 = np.moveaxis(self, 0, -1)
+        ndim0, ndim1 = mat0.ndim, mat1.ndim
+        r = mat0 @ mat1
+        _axes = tuple(range(0, ndim0 + ndim1 - 2))
+        axes = _axes[ndim0 - 1 :] + _axes[: ndim0 - 1]
+        return r.transpose(axes)
+
+    def __rmatmul__(self, other):
+        return self.rmatmul(other)
+
+    # ------------------------------------------------------------ elementwise
+
+    def _zip_with(self, other, op: Callable):
+        a = self._vars
+        b = other._vars if isinstance(other, FixedVariableArray) else other
+        a, b = np.broadcast_arrays(a, b)
+        r = np.array([op(av, bv) for av, bv in zip(a.ravel(), b.ravel())])
+        return FixedVariableArray(r.reshape(a.shape), self.solver_options, hwconf=self.hwconf)
+
+    def __add__(self, other):
+        return FixedVariableArray(self._vars + to_raw_arr(other), self.solver_options, hwconf=self.hwconf)
+
+    def __radd__(self, other):
+        return self + other
+
+    def __sub__(self, other):
+        return FixedVariableArray(self._vars - to_raw_arr(other), self.solver_options, hwconf=self.hwconf)
+
+    def __rsub__(self, other):
+        return FixedVariableArray(to_raw_arr(other) - self._vars, self.solver_options, hwconf=self.hwconf)
+
+    def __mul__(self, other):
+        return FixedVariableArray(self._vars * to_raw_arr(other), self.solver_options, hwconf=self.hwconf)
+
+    def __rmul__(self, other):
+        return self * other
+
+    def __truediv__(self, other):
+        return FixedVariableArray(self._vars * (1 / other), self.solver_options, hwconf=self.hwconf)
+
+    def __neg__(self):
+        return FixedVariableArray(-self._vars, self.solver_options, hwconf=self.hwconf)
+
+    def __pow__(self, power):
+        p = int(power)
+        if p == power and p >= 0:
+            return FixedVariableArray(self._vars**p, self.solver_options, hwconf=self.hwconf)
+        return self.apply(lambda x: x**power)
+
+    def __gt__(self, other):
+        return self._zip_with(other, lambda a, b: a > b)
+
+    def __lt__(self, other):
+        return self._zip_with(other, lambda a, b: a < b)
+
+    def __ge__(self, other):
+        return self._zip_with(other, lambda a, b: a >= b)
+
+    def __le__(self, other):
+        return self._zip_with(other, lambda a, b: a <= b)
+
+    def __and__(self, other):
+        return self._zip_with(other, lambda a, b: a & b)
+
+    def __or__(self, other):
+        return self._zip_with(other, lambda a, b: a | b)
+
+    def __xor__(self, other):
+        return self._zip_with(other, lambda a, b: a ^ b)
+
+    def __invert__(self):
+        r = np.array([~v for v in self._vars.ravel()])
+        return FixedVariableArray(r.reshape(self.shape), self.solver_options, hwconf=self.hwconf)
+
+    def __abs__(self):
+        r = np.array([abs(v) for v in self._vars.ravel()])
+        return FixedVariableArray(r.reshape(self.shape), self.solver_options, hwconf=self.hwconf)
+
+    def __ne__(self, other):  # type: ignore[override]
+        if not isinstance(other, (FixedVariableArray, np.ndarray, int, float, np.integer, np.floating)):
+            raise ValueError(f'Illegal comparison between FixedVariableArray and {type(other)}')
+        return self._zip_with(other, lambda a, b: a._ne(b))
+
+    def __eq__(self, other):  # type: ignore[override]
+        return ~(self.__ne__(other))
+
+    def to_bool(self, reduction: str = 'any'):
+        assert reduction in ('any', 'all'), f'reduction must be any/all, got {reduction}'
+        r = np.array([v.unary_bit_op(reduction) for v in self._vars.ravel()]).reshape(self._vars.shape)
+        return FixedVariableArray(r, self.solver_options, hwconf=self.hwconf)
+
+    # --------------------------------------------------------- quant / relu
+
+    def relu(self, i=None, f=None, round_mode: str = 'TRN'):
+        shape = self._vars.shape
+        i = np.broadcast_to(i, shape) if i is not None else np.full(shape, None)
+        f = np.broadcast_to(f, shape) if f is not None else np.full(shape, None)
+        out = [v.relu(i=iv, f=fv, round_mode=round_mode) for v, iv, fv in zip(self._vars.ravel(), i.ravel(), f.ravel())]
+        return FixedVariableArray(np.array(out).reshape(shape), self.solver_options, hwconf=self.hwconf)
+
+    def quantize(self, k=None, i=None, f=None, overflow_mode: str = 'WRAP', round_mode: str = 'TRN'):
+        shape = self._vars.shape
+        if any(x is None for x in (k, i, f)):
+            kif = self.kif
+        k = np.broadcast_to(k, shape) if k is not None else kif[0]
+        i = np.broadcast_to(i, shape) if i is not None else kif[1]
+        f = np.broadcast_to(f, shape) if f is not None else kif[2]
+        out = [
+            v.quantize(k=kv, i=iv, f=fv, overflow_mode=overflow_mode, round_mode=round_mode)
+            for v, kv, iv, fv in zip(self._vars.ravel(), k.ravel(), i.ravel(), f.ravel())
+        ]
+        return FixedVariableArray(np.array(out).reshape(shape), self.solver_options, hwconf=self.hwconf)
+
+    # --------------------------------------------------------------- shape
+
+    def __getitem__(self, item):
+        if isinstance(item, _ArgsortDelayedIndex):
+            ret = sort(*item.args, **item.kwargs, aux_value=self)[1]
+            for s in item._slicing:
+                ret = ret[s]
+            return ret
+        vars_ = self._vars[item]
+        if isinstance(vars_, np.ndarray):
+            return FixedVariableArray(vars_, self.solver_options, hwconf=self.hwconf)
+        return vars_
+
+    def __len__(self):
+        return len(self._vars)
+
+    def flatten(self):
+        return FixedVariableArray(self._vars.flatten(), self.solver_options, hwconf=self.hwconf)
+
+    def reshape(self, *shape):
+        return FixedVariableArray(self._vars.reshape(*shape), self.solver_options, hwconf=self.hwconf)
+
+    def transpose(self, axes=None):
+        return FixedVariableArray(self._vars.transpose(axes), self.solver_options, hwconf=self.hwconf)
+
+    def ravel(self):
+        return FixedVariableArray(self._vars.ravel(), self.solver_options, hwconf=self.hwconf)
+
+    def copy(self):
+        return FixedVariableArray(self._vars.copy(), self.solver_options, hwconf=self.hwconf)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def shape(self):
+        return self._vars.shape
+
+    @property
+    def dtype(self):
+        return self._vars.dtype
+
+    @property
+    def size(self):
+        return self._vars.size
+
+    @property
+    def ndim(self):
+        return self._vars.ndim
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def kif(self):
+        """Stacked [k, i, f] arrays (leading axis 3)."""
+        shape = self._vars.shape
+        kif = np.array([v.kif for v in self._vars.ravel()]).reshape(*shape, 3)
+        return np.moveaxis(kif, -1, 0)
+
+    @property
+    def lhs(self):
+        """Stacked [low, high, step] arrays (leading axis 3)."""
+        shape = self._vars.shape
+        lhs = np.array([(v.low, v.high, v.step) for v in self._vars.ravel()], dtype=np.float32).reshape(*shape, 3)
+        return np.moveaxis(lhs, -1, 0)
+
+    @property
+    def latency(self):
+        return np.array([v.latency for v in self._vars.ravel()]).reshape(self._vars.shape)
+
+    @property
+    def collapsed(self) -> bool:
+        """True when every element is a constant (low == high)."""
+        return all(v.low == v.high for v in self._vars.ravel())
+
+    def apply(self, fn: Callable) -> 'LazyUnaryArray':
+        """Apply a unary float function, deferred until quantization fixes
+        the output precision (lowered to lookup tables)."""
+        return LazyUnaryArray(self._vars, self.solver_options, operator=fn)
+
+    def as_new(self):
+        """Same intervals/config, fresh unconnected variables (new trace roots)."""
+        shape = self._vars.shape
+        vars_ = np.array([v._with(_from=(), opr='new', renew_id=True) for v in self._vars.ravel()]).reshape(shape)
+        return FixedVariableArray(vars_, self.solver_options, hwconf=self.hwconf)
+
+    def __repr__(self):
+        max_lat = max(v.latency for v in self._vars.ravel())
+        return f'FixedVariableArray(shape={self._vars.shape}, hwconf={tuple(self.hwconf)}, latency={max_lat})'
+
+
+class FixedVariableArrayInput(FixedVariableArray):
+    """Input array whose element precisions are recorded as the widest ever
+    requested via quantize (reference fixed_variable_array.py:630-644)."""
+
+    def __init__(self, shape, hwconf=HWConfig(1, -1, -1), solver_options=None, latency=0.0):
+        _vars = np.empty(shape, dtype=object)
+        flat = _vars.ravel()
+        for i in range(_vars.size):
+            flat[i] = FixedVariableInput(latency, hwconf)
+        super().__init__(_vars, solver_options, hwconf=hwconf)
+
+
+def make_table(fn: Callable, qint: QInterval) -> LookupTable:
+    low, high, step = qint
+    n = round(abs(high - low) / step) + 1
+    return LookupTable(np.asarray(fn(np.linspace(low, high, n)), dtype=np.float64))
+
+
+class LazyUnaryArray(FixedVariableArray):
+    """Array with a pending unary function of unspecified output precision.
+
+    Composes further unary ops lazily; materializes into lookup-table
+    variables upon ``quantize`` (reference RetardedFixedVariableArray).
+    """
+
+    def __init__(self, vars: NDArray, solver_options, operator: Callable):
+        self._operator = operator
+        super().__init__(vars, solver_options)
+
+    def __array_function__(self, func, types, args, kwargs):
+        raise RuntimeError('LazyUnaryArray only supports quantization or further unary operations.')
+
+    def apply(self, fn: Callable) -> 'LazyUnaryArray':
+        op = self._operator
+        return LazyUnaryArray(self._vars, self.solver_options, operator=lambda x: fn(op(x)))
+
+    def quantize(self, k=None, i=None, f=None, overflow_mode: str = 'WRAP', round_mode: str = 'TRN'):
+        if any(x is None for x in (k, i, f)):
+            assert all(x is None for x in (k, i, f)), 'Either all or none of k, i, f must be specified'
+            _k = _i = _f = [None] * self.size
+        else:
+            _k = np.broadcast_to(k, self.shape).ravel()
+            _i = np.broadcast_to(i, self.shape).ravel()
+            _f = np.broadcast_to(f, self.shape).ravel()
+
+        local_tables: dict = {}
+        variables = []
+        for v, kk, ii, ff in zip(self._vars.ravel(), _k, _i, _f):
+            qint = v.qint if v._factor >= 0 else QInterval(v.qint.max, v.qint.min, v.qint.step)
+            if kk is None or ii is None or ff is None:
+                op = self._operator
+                key = qint
+            else:
+                base = self._operator
+
+                def op(x, _b=base, _k=kk, _i=ii, _f=ff):
+                    return fixed_quantize(_b(x), _k, _i, _f, overflow_mode, round_mode)
+
+                key = (qint, (int(kk), int(ii), int(ff)))
+            if key in local_tables:
+                table = local_tables[key]
+            else:
+                table = make_table(op, qint)
+                local_tables[key] = table
+            variables.append(v.lookup(table))
+
+        variables = np.array(variables).reshape(self._vars.shape)
+        return FixedVariableArray(variables, self.solver_options, hwconf=self.hwconf)
+
+    @property
+    def kif(self):
+        raise RuntimeError('LazyUnaryArray has no defined kif until quantized.')
+
+    def __repr__(self):
+        return 'Lazy' + super().__repr__()
+
+
+# Alias for users coming from the reference API
+RetardedFixedVariableArray = LazyUnaryArray
+
+
+class _ArgsortDelayedIndex:
+    """Placeholder returned by np.argsort; indexing another array with it
+    lowers to a payload-carrying sort (reference fixed_variable_array.py:723-731)."""
+
+    def __init__(self, args, kwargs, slicing: tuple = ()):
+        self.args = args
+        self.kwargs = kwargs
+        self._slicing = slicing
+
+    def __getitem__(self, idx):
+        return _ArgsortDelayedIndex(self.args, self.kwargs, self._slicing + (idx,))

@@ -1,0 +1,18 @@
+from .conv_utils import avg_pool2d, conv1d, conv2d, max_pool2d
+from .einsum_utils import einsum
+from .quantization import fixed_quantize, quantize, relu
+from .reduce_utils import reduce
+from .sorting import sort
+
+__all__ = [
+    'einsum',
+    'quantize',
+    'relu',
+    'reduce',
+    'sort',
+    'fixed_quantize',
+    'conv1d',
+    'conv2d',
+    'max_pool2d',
+    'avg_pool2d',
+]

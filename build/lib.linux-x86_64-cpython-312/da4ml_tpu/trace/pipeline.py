@@ -1,0 +1,160 @@
+"""Pipeline stage splitting and retiming (compiler passes).
+
+``to_pipeline`` splits a CombLogic at latency_cutoff boundaries, inserting
+inter-stage register copies for values crossing stages. ``retime_pipeline``
+binary-searches the smallest cutoff that preserves the stage count by
+re-executing the IR symbolically with a new HWConfig — the latency-snap rule
+in FixedVariable.get_cost_and_latency redistributes ops between stages.
+
+Behavioral parity: reference src/da4ml/trace/pipeline.py.
+"""
+
+from __future__ import annotations
+
+from math import floor
+
+from ..ir.comb import CombLogic, Pipeline
+from ..ir.types import Op
+from .fixed_variable import FixedVariable, HWConfig
+from .tracer import comb_trace
+
+
+def retime_pipeline(pipe: Pipeline, verbose: bool = False) -> Pipeline:
+    n_stages = len(pipe.stages)
+    cutoff_high = max(max(sol.out_latency) / (i + 1) for i, sol in enumerate(pipe.stages))
+    cutoff_low = max(pipe.out_latencies) / n_stages
+    adder_size, carry_size = pipe.stages[0].adder_size, pipe.stages[0].carry_size
+    best = pipe
+    while cutoff_high - cutoff_low > 1:
+        cutoff = (cutoff_high + cutoff_low) // 2
+        hwconf = HWConfig(adder_size, carry_size, cutoff)
+        inp = [FixedVariable(*qint, hwconf=hwconf) for qint in pipe.inp_qint]
+        try:
+            out = list(pipe(inp))
+        except AssertionError:
+            cutoff_low = cutoff
+            continue
+        cand = to_pipeline(comb_trace(inp, out), cutoff, retiming=False)
+        if len(cand.stages) > n_stages:
+            cutoff_low = cutoff
+        else:
+            cutoff_high = cutoff
+            best = cand
+    if verbose:
+        print(f'actual cutoff: {cutoff_high}')
+    return best
+
+
+def _get_new_idx(
+    idx: int,
+    locator: list[dict[int, int]],
+    opd: dict[int, list[Op]],
+    out_idxd: dict[int, list[int]],
+    ops: list[Op],
+    stage: int,
+    latency_cutoff: float,
+) -> int:
+    """Index of value `idx` within `stage`, materializing cross-stage register
+    copies (input-copy ops) for every boundary crossed."""
+    if idx < 0:
+        return idx
+    stages_present = locator[idx].keys()
+    if stage not in stages_present:
+        p0_stage = max(stages_present)
+        p0_idx = locator[idx][p0_stage]
+        for j in range(p0_stage, stage):
+            op0 = ops[idx]
+            latency = float(latency_cutoff * (j + 1))
+            out_idxd.setdefault(j, []).append(locator[idx][j])
+            copy_op = Op(len(out_idxd[j]) - 1, -1, -1, 0, op0.qint, latency, 0.0)
+            opd.setdefault(j + 1, []).append(copy_op)
+            p0_idx = len(opd[j + 1]) - 1
+            locator[idx][j + 1] = p0_idx
+    else:
+        p0_idx = locator[idx][stage]
+    return p0_idx
+
+
+def to_pipeline(comb: CombLogic, latency_cutoff: float, retiming: bool = True, verbose: bool = False) -> Pipeline:
+    """Split a CombLogic into an II=1 pipeline at the given latency cutoff."""
+    assert len(comb.ops) > 0, 'No operations in the record'
+
+    def get_stage(op: Op) -> int:
+        return floor(op.latency / (latency_cutoff + 1e-9)) if latency_cutoff > 0 else 0
+
+    opd: dict[int, list[Op]] = {}
+    out_idxd: dict[int, list[int]] = {}
+    locator: list[dict[int, int]] = []
+
+    ops = list(comb.ops)
+    lat = max(ops[i].latency for i in comb.out_idxs)
+    for i in comb.out_idxs:
+        # sentinel "emit to external output" markers
+        ops.append(Op(i, -1001, -1001, 0, ops[i].qint, lat, 0.0))
+
+    for op in ops:
+        stage = get_stage(op)
+        if op.opcode == -1:
+            opd.setdefault(stage, []).append(op)
+            locator.append({stage: len(opd[stage]) - 1})
+            continue
+
+        p0 = _get_new_idx(op.id0, locator, opd, out_idxd, ops, stage, latency_cutoff)
+        p1 = _get_new_idx(op.id1, locator, opd, out_idxd, ops, stage, latency_cutoff)
+        if op.opcode in (6, -6):
+            k = _get_new_idx(op.data & 0xFFFFFFFF, locator, opd, out_idxd, ops, stage, latency_cutoff)
+            data = ((op.data >> 32) & 0xFFFFFFFF) << 32 | k
+        else:
+            data = op.data
+
+        if p1 == -1001:
+            out_idxd.setdefault(stage, []).append(p0)
+        else:
+            opd.setdefault(stage, []).append(Op(p0, p1, op.opcode, data, op.qint, op.latency, op.cost))
+            locator.append({stage: len(opd[stage]) - 1})
+
+    stages = []
+    max_stage = max(opd.keys())
+    n_in = comb.shape[0]
+    for stage in range(len(opd.keys())):
+        _ops = opd[stage]
+        _out_idx = out_idxd[stage]
+        if stage == max_stage:
+            out_shifts, out_negs = comb.out_shifts, comb.out_negs
+        else:
+            out_shifts, out_negs = [0] * len(_out_idx), [False] * len(_out_idx)
+
+        if comb.lookup_tables is not None:
+            _ops, lookup_tables = remap_table_idxs(comb, _ops)
+        else:
+            lookup_tables = None
+        stages.append(
+            CombLogic(
+                shape=(n_in, len(_out_idx)),
+                inp_shifts=[0] * n_in,
+                out_idxs=_out_idx,
+                out_shifts=out_shifts,
+                out_negs=out_negs,
+                ops=_ops,
+                carry_size=comb.carry_size,
+                adder_size=comb.adder_size,
+                lookup_tables=lookup_tables,
+            )
+        )
+        n_in = len(_out_idx)
+
+    pipe = Pipeline(tuple(stages))
+    if retiming:
+        pipe = retime_pipeline(pipe, verbose=verbose)
+    return pipe
+
+
+def remap_table_idxs(comb: CombLogic, _ops: list[Op]):
+    """Compact per-stage lookup table indices to the tables actually used."""
+    assert comb.lookup_tables is not None
+    table_idxs = sorted({op.data for op in _ops if op.opcode == 8})
+    remap = {j: i for i, j in enumerate(table_idxs)}
+    out_ops = [
+        Op(op.id0, op.id1, op.opcode, remap[op.data], op.qint, op.latency, op.cost) if op.opcode == 8 else op for op in _ops
+    ]
+    return out_ops, tuple(comb.lookup_tables[i] for i in table_idxs)

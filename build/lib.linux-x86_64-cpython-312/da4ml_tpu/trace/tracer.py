@@ -1,0 +1,236 @@
+"""Graph -> DAIS IR lowering: gather, topologically order, encode, DSE.
+
+Each traced variable lowers to one Op; factors (free power-of-two scales and
+negations) are folded into op data/opcode signs. Dead statement elimination
+runs backward liveness and compacts indices.
+
+Behavioral parity: reference src/da4ml/trace/tracer.py.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from decimal import Decimal
+from math import log2
+
+import numpy as np
+
+from ..ir.comb import CombLogic
+from ..ir.types import Op, QInterval
+from .fixed_variable import FixedVariable, const_f, table_context
+
+
+def _recursive_gather(v: FixedVariable, gathered: dict[int, FixedVariable]):
+    if v.id in gathered:
+        return
+    for p in v._from:
+        _recursive_gather(p, gathered)
+    gathered[v.id] = v
+
+
+def gather_variables(inputs: Sequence[FixedVariable], outputs: Sequence[FixedVariable]):
+    """Collect the transitive graph, stably sorted by (latency, insertion),
+    with unreferenced non-input variables pruned."""
+    input_ids = {v.id for v in inputs}
+    gathered = {v.id: v for v in inputs}
+    for o in outputs:
+        _recursive_gather(o, gathered)
+    variables = list(gathered.values())
+
+    n = len(variables)
+    order = sorted(range(n), key=lambda i: variables[i].latency * n + i)
+    variables = [variables[i] for i in order]
+
+    refcount = {v.id: 0 for v in variables}
+    for v in variables:
+        if v.id in input_ids:
+            continue
+        for p in v._from:
+            refcount[p.id] += 1
+    for v in outputs:
+        refcount[v.id] += 1
+
+    variables = [v for v in variables if refcount[v.id] > 0 or v.id in input_ids]
+    index = {v.id: i for i, v in enumerate(variables)}
+    return variables, index
+
+
+def _comb_trace(inputs: Sequence[FixedVariable], outputs: Sequence[FixedVariable]):
+    variables, index = gather_variables(inputs, outputs)
+    ops: list[Op] = []
+    inp_ids = {v.id: i for i, v in enumerate(inputs)}
+    lookup_tables: list = []
+
+    table_map: dict[int, int] = {}
+    for v in variables:
+        if v.opr != 'lookup':
+            continue
+        assert v._data is not None
+        idx = int(v._data)
+        if idx not in table_map:
+            table_map[idx] = len(lookup_tables)
+            lookup_tables.append(table_context.get_table_from_index(idx))
+
+    for i, v in enumerate(variables):
+        if v.id in inp_ids and v.opr != 'const':
+            ops.append(Op(inp_ids[v.id], -1, -1, 0, v.unscaled.qint, v.latency, 0.0))
+            continue
+        if v.opr == 'new':
+            raise NotImplementedError('Operation "new" is only expected in the input list')
+
+        opr = v.opr
+        if opr == 'vadd':
+            v0, v1 = v._from
+            f0, f1 = v0._factor, v1._factor
+            id0, id1 = index[v0.id], index[v1.id]
+            sub = int(f1 < 0)
+            data = int(log2(abs(f1 / f0)))
+            assert id0 < i and id1 < i, f'{id0} {id1} {i} {v.id}'
+            op = Op(id0, id1, sub, data, v.unscaled.qint, v.latency, v.cost)
+        elif opr == 'cadd':
+            (v0,) = v._from
+            id0 = index[v0.id]
+            assert v._data is not None
+            qint = v.unscaled.qint
+            data = int(v._data / Decimal(qint.step))
+            assert id0 < i
+            op = Op(id0, -1, 4, data, qint, v.latency, v.cost)
+        elif opr == 'wrap':
+            (v0,) = v._from
+            id0 = index[v0.id]
+            assert id0 < i
+            opcode = -3 if v0._factor < 0 else 3
+            op = Op(id0, -1, opcode, 0, v.unscaled.qint, v.latency, v.cost)
+        elif opr == 'relu':
+            (v0,) = v._from
+            id0 = index[v0.id]
+            assert id0 < i
+            opcode = -2 if v0._factor < 0 else 2
+            op = Op(id0, -1, opcode, 0, v.unscaled.qint, v.latency, v.cost)
+        elif opr == 'const':
+            qint = v.unscaled.qint
+            assert qint.min == qint.max, f'const {v.id} {qint.min} {qint.max}'
+            f = const_f(qint.min)
+            step = 2.0**-f
+            qint = QInterval(qint.min, qint.min, step)
+            op = Op(-1, -1, 5, int(qint.min / step), qint, v.latency, v.cost)
+        elif opr == 'msb_mux':
+            qint = v.unscaled.qint
+            key, in0, in1 = v._from
+            opcode = 6 if in1._factor > 0 else -6
+            idk, id0, id1 = index[key.id], index[in0.id], index[in1.id]
+            shift = int(log2(abs(in1._factor / in0._factor)))
+            data = idk + (shift << 32)
+            assert idk < i and id0 < i and id1 < i
+            assert key._factor > 0, f'Cannot mux on v{key.id} with negative factor {key._factor}'
+            op = Op(id0, id1, opcode, data, qint, v.latency, v.cost)
+        elif opr == 'vmul':
+            v0, v1 = v._from
+            id0, id1 = index[v0.id], index[v1.id]
+            assert id0 < i and id1 < i
+            op = Op(id0, id1, 7, 0, v.unscaled.qint, v.latency, v.cost)
+        elif opr == 'lookup':
+            (v0,) = v._from
+            id0 = index[v0.id]
+            assert v._data is not None and id0 < i
+            op = Op(id0, -1, 8, table_map[int(v._data)], v.unscaled.qint, v.latency, v.cost)
+        elif opr == 'bit_unary':
+            (v0,) = v._from
+            id0 = index[v0.id]
+            assert v._data is not None and id0 < i
+            opcode = 9 if v._factor > 0 else -9
+            op = Op(id0, -1, opcode, int(v._data), v.unscaled.qint, v.latency, v.cost)
+        elif opr == 'bit_binary':
+            v0, v1 = v._from
+            id0, id1 = index[v0.id], index[v1.id]
+            assert v._data is not None and id0 < i and id1 < i
+            f0, f1 = v0._factor, v1._factor
+            # data: {subopcode[63:56], pad, v1_neg[33], v0_neg[32], shift[31:0]}
+            data = int(log2(abs(f1 / f0))) & 0xFFFFFFFF
+            data += (int(v._data) << 56) + (int(f0 < 0) << 32) + (int(f1 < 0) << 33)
+            op = Op(id0, id1, 10, data, v.unscaled.qint, v.latency, v.cost)
+        else:
+            raise NotImplementedError(f'Operation "{opr}" is not supported in tracing')
+        ops.append(op)
+
+    out_index = [index[v.id] for v in outputs]
+    return ops, out_index, tuple(lookup_tables) if lookup_tables else None
+
+
+def _index_remap(op: Op, idx_map: dict[int, int]) -> Op:
+    if op.opcode == -1:
+        return op
+    id0 = idx_map[op.id0] if op.id0 >= 0 else op.id0
+    id1 = idx_map[op.id1] if op.id1 >= 0 else op.id1
+    if abs(op.opcode) == 6:
+        id_c = idx_map[op.data & 0xFFFFFFFF]
+        data = id_c + (((op.data >> 32) & 0xFFFFFFFF) << 32)
+    else:
+        data = op.data
+    return Op(id0, id1, op.opcode, data, op.qint, op.latency, op.cost)
+
+
+def dead_statement_elimination(comb: CombLogic, keep_dead_inputs: bool = False) -> CombLogic:
+    """Backward liveness + index compaction (reference tracer.py:178-211)."""
+    dead = np.ones(len(comb.ops), dtype=bool)
+    for idx in comb.out_idxs:
+        if idx != -1:
+            dead[idx] = False
+
+    for i in range(len(comb.ops) - 1, -1, -1):
+        op = comb.ops[i]
+        if dead[i] and not (keep_dead_inputs and op.opcode == -1):
+            continue
+        if op.id0 >= 0:
+            dead[op.id0] = False
+        if op.id1 >= 0:
+            dead[op.id1] = False
+        if abs(op.opcode) == 6:
+            dead[op.data & 0xFFFFFFFF] = False
+
+    new_idxs = np.cumsum(~dead) - 1
+    idx_map = {i: int(new_idxs[i]) for i in range(len(comb.ops))}
+    new_ops = [_index_remap(op, idx_map) for i, op in enumerate(comb.ops) if not dead[i]]
+    new_out_idxs = [idx_map[idx] if idx >= 0 else -1 for idx in comb.out_idxs]
+    return CombLogic(
+        comb.shape,
+        comb.inp_shifts,
+        new_out_idxs,
+        comb.out_shifts,
+        comb.out_negs,
+        new_ops,
+        comb.carry_size,
+        comb.adder_size,
+        comb.lookup_tables,
+    )
+
+
+def comb_trace(inputs, outputs, keep_dead_inputs: bool = False) -> CombLogic:
+    """Lower a traced computation (inputs -> outputs) to a CombLogic."""
+    if isinstance(inputs, FixedVariable):
+        inputs = [inputs]
+    if isinstance(outputs, FixedVariable):
+        outputs = [outputs]
+    inputs, outputs = list(np.ravel(inputs)), list(np.ravel(outputs))
+
+    assert all(inp._factor > 0 for inp in inputs), 'Input variables must have positive scaling factor'
+
+    if any(not isinstance(v, FixedVariable) for v in outputs):
+        hwconf = inputs[0].hwconf
+        outputs = [v if isinstance(v, FixedVariable) else FixedVariable.from_const(v, hwconf, 1) for v in outputs]
+
+    ops, out_index, lookup_tables = _comb_trace(inputs, outputs)
+    shape = len(inputs), len(outputs)
+    out_sf = [v._factor for v in outputs]
+    comb = CombLogic(
+        shape,
+        [0] * shape[0],
+        out_index,
+        [int(log2(abs(sf))) for sf in out_sf],
+        [sf < 0 for sf in out_sf],
+        ops,
+        outputs[0].hwconf.carry_size,
+        outputs[0].hwconf.adder_size,
+        lookup_tables,
+    )
+    return dead_statement_elimination(comb, keep_dead_inputs)

@@ -1,0 +1,7 @@
+"""Typing re-exports (parity: reference src/da4ml/typing/__init__.py:1-3)."""
+
+from .cmvm import solver_options_t
+from .ir import CombLogic, Op, Pipeline, Precision, QInterval
+from .trace import HWConfig
+
+__all__ = ['solver_options_t', 'HWConfig', 'CombLogic', 'Pipeline', 'Op', 'QInterval', 'Precision']

@@ -1,0 +1,13 @@
+# Timing constraints (Quartus / generic SDC). Tokens resolved at
+# project-write time; uncertainty and IO delays are ratios of the period.
+set period @CLOCK_PERIOD@
+
+create_clock -period $period -name clk [get_ports {clk}]
+
+set_clock_uncertainty -setup -to [get_clocks clk] [expr {$period * @UNCERTAINTY_SETUP@}]
+set_clock_uncertainty -hold  -to [get_clocks clk] [expr {$period * @UNCERTAINTY_HOLD@}]
+
+set_input_delay  -clock clk -max [expr {$period * @DELAY_MAX@}] [get_ports {inp[*]}]
+set_input_delay  -clock clk -min [expr {$period * @DELAY_MIN@}] [get_ports {inp[*]}]
+set_output_delay -clock clk -max [expr {$period * @DELAY_MAX@}] [get_ports {out[*]}]
+set_output_delay -clock clk -min [expr {$period * @DELAY_MIN@}] [get_ports {out[*]}]

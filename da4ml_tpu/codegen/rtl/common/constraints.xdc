@@ -1,0 +1,13 @@
+# Timing constraints (Vivado OOC). Tokens resolved at project-write time;
+# uncertainty and IO delays are ratios of the clock period.
+set period @CLOCK_PERIOD@
+
+create_clock -period $period -name clk [get_ports clk]
+
+set_clock_uncertainty -setup [expr {$period * @UNCERTAINTY_SETUP@}] [get_clocks clk]
+set_clock_uncertainty -hold  [expr {$period * @UNCERTAINTY_HOLD@}]  [get_clocks clk]
+
+set_input_delay  -clock clk -max [expr {$period * @DELAY_MAX@}] [get_ports {inp[*]}]
+set_input_delay  -clock clk -min [expr {$period * @DELAY_MIN@}] [get_ports {inp[*]}]
+set_output_delay -clock clk -max [expr {$period * @DELAY_MAX@}] [get_ports {out[*]}]
+set_output_delay -clock clk -min [expr {$period * @DELAY_MIN@}] [get_ports {out[*]}]

@@ -1,0 +1,48 @@
+"""Build hook: compile the native C++ library at install/wheel-build time.
+
+The library is a plain C-ABI shared object loaded with ctypes (no Python
+extension API), so the standard build_ext is overridden to invoke the same
+g++ command as da4ml_tpu/native/build.py and drop ``_da4ml_native.so`` into
+the package. The extension is optional: when no C++ toolchain is available
+the install still succeeds and the runtime falls back to the committed
+binary or the first-use auto-build (bindings.load_lib).
+
+Parity: the reference builds its native modules at install time via
+meson-python (meson.build:25-52 of calad0i/da4ml).
+"""
+
+from __future__ import annotations
+
+import os
+from glob import glob
+from pathlib import Path
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext as _build_ext
+
+_CXX_FLAGS = ['-std=c++20', '-O3', '-fPIC', '-shared', '-fopenmp', '-fvisibility=hidden', '-Wall']
+
+
+class NativeLibBuild(_build_ext):
+    def get_ext_filename(self, fullname: str) -> str:
+        # plain .so, no CPython ABI tag: the library is loaded via ctypes
+        return os.path.join(*fullname.split('.')) + '.so'
+
+    def build_extension(self, ext: Extension) -> None:
+        out = Path(self.get_ext_fullpath(ext.name))
+        out.parent.mkdir(parents=True, exist_ok=True)
+        cxx = os.environ.get('CXX', 'g++')
+        self.spawn([cxx, *_CXX_FLAGS, *ext.sources, '-o', str(out)])
+
+
+setup(
+    ext_modules=[
+        Extension(
+            'da4ml_tpu.native._da4ml_native',
+            sources=sorted(glob('da4ml_tpu/native/src/*.cc')),
+            depends=sorted(glob('da4ml_tpu/native/src/*.hh')),
+            optional=True,
+        )
+    ],
+    cmdclass={'build_ext': NativeLibBuild},
+)

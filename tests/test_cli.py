@@ -195,3 +195,50 @@ def test_report_stdout(tmp_path, capsys):
     assert rc == 0
     cap = capsys.readouterr().out
     assert 'LUT' in cap and 'cost' in cap
+
+
+def test_vendor_flow_emission(tmp_path):
+    """Projects ship fully-substituted OOC vendor flows + constraint files."""
+    from da4ml_tpu.codegen import RTLModel
+
+    comb = _make_comb()
+    model = RTLModel(comb, 'flowprj', tmp_path / 'prj', latency_cutoff=3.0, clock_period=4.0, clock_uncertainty=0.15)
+    model.write()
+    viv = (model.path / 'tcl' / 'build_vivado.tcl').read_text()
+    qts = (model.path / 'tcl' / 'build_quartus.tcl').read_text()
+    xdc = (model.path / 'constraints' / 'flowprj.xdc').read_text()
+    sdc = (model.path / 'constraints' / 'flowprj.sdc').read_text()
+    for text in (viv, qts, xdc, sdc):
+        assert '@' not in text, 'unresolved substitution token'
+    # vivado flow: OOC synth, staged impl, report names the report CLI parses
+    assert '-mode out_of_context' in viv
+    for stage in ('synth_design', 'opt_design', 'place_design', 'phys_opt_design', 'route_design'):
+        assert stage in viv
+    for rpt in ('post_route_timing.rpt', 'post_route_util.rpt', 'post_route_power.rpt'):
+        assert rpt in viv
+    # quartus flow: virtual pins (OOC) + timing-driven compile
+    assert 'VIRTUAL_PIN' in qts and 'execute_flow -compile' in qts
+    # constraints: period and ratio-scaled uncertainty / IO delays
+    assert 'set period 4.0' in xdc and 'set period 4.0' in sdc
+    assert '$period * 0.15' in xdc and '$period * 0.15' in sdc
+    assert 'set_input_delay' in xdc and 'set_output_delay' in sdc
+
+
+def test_report_finds_build_dir_reports(tmp_path):
+    """report CLI end-to-end: reports in build_<name>/reports (where the
+    emitted vivado flow writes them) are merged with project metadata."""
+    from da4ml_tpu._cli.report import load_project
+    from da4ml_tpu.codegen import RTLModel
+
+    comb = _make_comb()
+    model = RTLModel(comb, 'rptprj', tmp_path / 'prj', latency_cutoff=3.0)
+    model.write()
+    rdir = model.path / 'build_rptprj' / 'reports'
+    rdir.mkdir(parents=True)
+    (rdir / 'rptprj_post_route_timing.rpt').write_text(VIVADO_TIMING)
+    (rdir / 'rptprj_post_route_util.rpt').write_text(VIVADO_UTIL)
+    (rdir / 'rptprj_post_route_power.rpt').write_text(VIVADO_POWER)
+    res = load_project(model.path)
+    assert res['WNS(ns)'] == 0.237
+    assert res['LUT'] == 1244
+    assert res['name'] == 'rptprj'

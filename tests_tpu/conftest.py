@@ -1,0 +1,42 @@
+"""Real-hardware test fixtures (run with: pytest tests_tpu/).
+
+Unlike tests/conftest.py this does NOT force the CPU platform — the whole
+point is to exercise the real TPU. Every test is skipped unless a TPU-class
+backend actually initialized, so this directory is safe to collect anywhere.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _tpu_backend() -> bool:
+    """Bounded-subprocess probe: TPU plugin init can hang, not just fail."""
+    try:
+        r = subprocess.run(
+            [sys.executable, '-c', "import jax; print('BK=' + jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=90,
+        )
+    except Exception:
+        return False
+    lines = r.stdout.strip().splitlines()
+    return r.returncode == 0 and bool(lines) and lines[-1].startswith('BK=') and lines[-1][3:] not in ('cpu', 'gpu')
+
+
+def pytest_collection_modifyitems(config, items):
+    if _tpu_backend():
+        return
+    skip = pytest.mark.skip(reason='no TPU backend available')
+    for item in items:
+        item.add_marker(skip)
+
+
+@pytest.fixture
+def rng() -> np.random.Generator:
+    return np.random.default_rng(42)

@@ -1,0 +1,98 @@
+"""On-hardware checks: things CI's virtual CPU mesh cannot prove.
+
+1. int32/int64 DAIS execution is bit-exact on the real chip (two's-complement
+   wrap + arithmetic shifts compile correctly through XLA's TPU backend).
+2. The fused Pallas selection kernel is decision-identical with the XLA
+   select path on hardware (VERDICT r1: interpret-mode-only coverage).
+3. unroll vs scan executor modes agree on TPU.
+
+Run: ``pytest tests_tpu/`` with the TPU plugin active (skips off-TPU).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _solve_costs(kernels, select: str):
+    """Solve a batch under a given selection backend; return comparable state."""
+    from da4ml_tpu.cmvm.jax_search import _build_cse_fn, solve_jax_many
+
+    old = os.environ.get('DA4ML_JAX_SELECT')
+    os.environ['DA4ML_JAX_SELECT'] = select
+    try:
+        _build_cse_fn.cache_clear()
+        sols = solve_jax_many(kernels)
+    finally:
+        if old is None:
+            os.environ.pop('DA4ML_JAX_SELECT', None)
+        else:
+            os.environ['DA4ML_JAX_SELECT'] = old
+    return sols
+
+
+def test_executor_bit_exact_on_tpu(rng):
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput(8, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(8), np.full(8, 3), np.full(8, 2))
+    w = rng.integers(-8, 8, (8, 6)).astype(np.float64)
+    x = (x @ w).relu(i=np.full(6, 6), f=np.full(6, 2))
+    comb = comb_trace(inp, x)
+    data = rng.uniform(-8, 8, (256, 8))
+    golden = comb.predict(data, backend='numpy')
+    for force_i64 in (None, True):
+        ex = DaisExecutor(decode(comb.to_binary()), force_i64=force_i64)
+        np.testing.assert_array_equal(ex(data), golden)
+
+
+def test_unroll_scan_parity_on_tpu(rng):
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput(6, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 3), np.full(6, 2))
+    x = x @ rng.integers(-8, 8, (6, 6)).astype(np.float64)
+    comb = comb_trace(inp, x)
+    prog = decode(comb.to_binary())
+    data = rng.uniform(-8, 8, (64, 6))
+    out_u = DaisExecutor(prog, mode='unroll')(data)
+    out_s = DaisExecutor(prog, mode='scan')(data)
+    np.testing.assert_array_equal(out_u, out_s)
+
+
+def test_pallas_select_decision_identity_on_tpu(rng):
+    """Same kernels, same solutions (ops and cost) under pallas vs xla select."""
+    pytest.importorskip('jax.experimental.pallas')
+    kernels = [
+        (rng.integers(0, 2**b, (n, n)) * rng.choice([-1.0, 1.0], (n, n))).astype(np.float64)
+        for n, b in ((6, 4), (8, 4), (8, 2), (12, 4))
+    ]
+    sols_x = _solve_costs(kernels, 'xla')
+    sols_p = _solve_costs(kernels, 'pallas')
+    for k, sx, sp in zip(kernels, sols_x, sols_p):
+        np.testing.assert_array_equal(np.asarray(sp.kernel, np.float64), k)
+        assert sp.cost == sx.cost, (sp.cost, sx.cost)
+        assert sp.latency == sx.latency
+        for st_x, st_p in zip(sx.stages, sp.stages):
+            assert len(st_x.ops) == len(st_p.ops)
+            for ox, op in zip(st_x.ops, st_p.ops):
+                assert (ox.id0, ox.id1, ox.opcode, ox.data) == (op.id0, op.id1, op.opcode, op.data)
+
+
+def test_pallas_vmem_guard_falls_back(rng):
+    """Oversized shape classes must demote to XLA rather than fail compile."""
+    from da4ml_tpu.cmvm.pallas_select import fits_vmem
+
+    assert fits_vmem(64, 16, 8)
+    assert not fits_vmem(512, 64, 16)
+    # a large-ish solve with pallas requested must still succeed end to end
+    k = (rng.integers(0, 16, (24, 24)) * rng.choice([-1.0, 1.0], (24, 24))).astype(np.float64)
+    sols = _solve_costs([k], 'pallas')
+    np.testing.assert_array_equal(np.asarray(sols[0].kernel, np.float64), k)
