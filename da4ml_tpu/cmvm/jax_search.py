@@ -6,13 +6,21 @@ scalar. Here the whole search is re-expressed as fixed-shape tensor programs:
 
 - A CSD expression set is a dense int8 tensor ``E[slot, out, bit]`` with
   digits in {-1, 0, +1}; slot = input or CSE intermediate.
-- One CSE iteration counts *all* candidate pairs ``a ± (b << s)`` at once via
-  shifted correlations (einsums on the MXU), scores them (mc / wmc / dc
-  variants, vectorized over the slot metadata), picks the argmax, and
-  substitutes densely. ``lax.while_loop`` drives the greedy iterations.
-- Lanes = (matrix, dc candidate, method) triples, batched with ``vmap`` and
-  shardable over a device mesh — each TPU core scores thousands of candidate
-  substitutions in parallel.
+- Candidate pair counts ``C[sub, s, i, j]`` (matches of ``a ± (b << s)``)
+  are computed once per stage via shifted correlations (einsums on the MXU)
+  and then carried in the loop state: each greedy iteration scores the
+  count tensor (mc / wmc / dc variants, vectorized over the slot metadata),
+  picks the argmax, substitutes densely, and *incrementally recounts only
+  the pairs touching the three modified rows* ``{i, j, cur}`` — the same
+  dirty-row strategy as the reference's ``update_stats``
+  (state_opr.cc:285-345), expressed as tiny ``[3,O,S,B] x [P,O,B]``
+  einsums + scatters instead of sorted-map surgery. Per-iteration work
+  drops from O(S·P²·O·B) to O(S·P·O·B) + one bandwidth pass for the
+  argmax, which is what makes wide-output matrices tractable on device.
+- ``lax.while_loop`` drives the greedy iterations. Lanes = (matrix, dc
+  candidate, method) triples, batched with ``vmap`` and shardable over a
+  device mesh — each TPU core scores thousands of candidate substitutions
+  in parallel.
 
 Host does the cheap, shape-dynamic ends: CSD/kernel decomposition, adder-tree
 emission (to_solution), and candidate argmin.
@@ -139,29 +147,65 @@ def _build_cse_fn(spec: _KernelSpec):
         dlat = jnp.abs(lat[:, None] - lat[None, :])
         return n_ov, dlat
 
+    # counts are bounded by O*B matches per pair; int16 storage halves the
+    # bandwidth of the per-iteration scoring pass
+    cdtype = jnp.int16 if O * B < 32000 else jnp.int32
+
     def pair_counts(E):
         """C_same/C_diff [S=B, P, P]: matches of row-i bit b with row-j bit b+s.
 
         Two MXU einsums via the identity same = (|a||b| + ab)/2,
-        diff = (|a||b| - ab)/2 over digits in {-1, 0, +1}.
+        diff = (|a||b| - ab)/2 over digits in {-1, 0, +1}. Computed once at
+        stage entry; the loop maintains the counts incrementally.
         """
         Ef = E.astype(jnp.bfloat16)
         sh = shifted_stack(Ef)
         A = jnp.einsum('iob,josb->sij', Ef, sh, preferred_element_type=jnp.float32)
         D = jnp.einsum('iob,josb->sij', jnp.abs(Ef), jnp.abs(sh), preferred_element_type=jnp.float32)
-        return (D + A) * 0.5, (D - A) * 0.5
+        return ((D + A) * 0.5).astype(cdtype), ((D - A) * 0.5).astype(cdtype)
+
+    s_rng = jnp.arange(B)
+
+    def update_counts(Cs, Cd, E, R):
+        """Recount pairs touching rows ``R = [i, j, cur]`` from the updated E.
+
+        All other pairs are unchanged (their rows were not modified), so two
+        rank-3 einsums + row/column scatters refresh the exact counts.
+        """
+        Ef = E.astype(jnp.bfloat16)
+        Er = Ef[R]  # [3, O, B]
+        # up[r,o,s,b] = Er[r,o,b+s]; down[r,o,s,b] = Er[r,o,b-s]
+        i_up = s_rng[:, None] + b_idx[None, :]  # [S, B]
+        i_dn = b_idx[None, :] - s_rng[:, None]
+        up = jnp.where(i_up[None, None] < B, Er[:, :, jnp.minimum(i_up, B - 1)], 0)
+        down = jnp.where(i_dn[None, None] >= 0, Er[:, :, jnp.maximum(i_dn, 0)], 0)
+        # C[s, r, p] = sum_{o,b} Er[r,o,b-s] * E[p,o,b]   (row r as first elem)
+        A1 = jnp.einsum('rosb,pob->srp', down, Ef, preferred_element_type=jnp.float32)
+        D1 = jnp.einsum('rosb,pob->srp', jnp.abs(down), jnp.abs(Ef), preferred_element_type=jnp.float32)
+        # C[s, p, r] = sum_{o,b} E[p,o,b] * Er[r,o,b+s]   (row r as second elem)
+        A2 = jnp.einsum('pob,rosb->spr', Ef, up, preferred_element_type=jnp.float32)
+        D2 = jnp.einsum('pob,rosb->spr', jnp.abs(Ef), jnp.abs(up), preferred_element_type=jnp.float32)
+        s1, d1 = ((D1 + A1) * 0.5).astype(cdtype), ((D1 - A1) * 0.5).astype(cdtype)
+        s2, d2 = ((D2 + A2) * 0.5).astype(cdtype), ((D2 - A2) * 0.5).astype(cdtype)
+        # rows first, then columns: the column write also refreshes the
+        # [R, R] block from the fully updated E (duplicate indices in R write
+        # identical values, so scatter order is immaterial)
+        Cs = Cs.at[:, R, :].set(s1).at[:, :, R].set(s2)
+        Cd = Cd.at[:, R, :].set(d1).at[:, :, R].set(d2)
+        return Cs, Cd
 
     s_np = np.arange(B, dtype=np.int64)[None, :, None, None]
     i_np = np.arange(P, dtype=np.int64)[None, None, :, None]
     j_np = np.arange(P, dtype=np.int64)[None, None, None, :]
     S0_MASK = jnp.asarray((s_np > 0) | (i_np < j_np))
 
-    def select_pair(C, qmeta, lat, method):
+    def select_pair(Cs, Cd, qmeta, lat, method):
         """Masked scoring + single-pass argmax over the [2, S, P, P] tensor.
 
         Ties resolve by first flattened index — deterministic, though not the
         host's scan order (the contract is exactness at comparable cost).
         """
+        C = jnp.stack([Cs, Cd]).astype(jnp.float32)  # [2, S, P, P]
         count = C
         valid = C >= 2.0
         # s == 0: only i < j (i == j is self-pairing; i > j duplicates i < j)
@@ -194,13 +238,15 @@ def _build_cse_fn(spec: _KernelSpec):
         any_valid = jnp.max(score) != -jnp.inf
         return any_valid, *_decode_flat(flat, P, B)
 
-    def select_pair_pallas(E, qmeta, lat, method):
-        """Fused VMEM select (pallas): decision-identical with select_pair."""
+    def select_pair_pallas(Cs, Cd, qmeta, lat, method):
+        """Fused VMEM select (pallas): decision-identical with select_pair.
+
+        One grid pass over the count tensor computes score + mask + local
+        argmax per tile without materializing the f32 score tensor in HBM.
+        """
         from .pallas_select import make_select
 
-        sel_fn = make_select(P, O, B, interpret=jax.default_backend() != 'tpu')
-        Ef = E.astype(jnp.float32)
-        sh = shifted_stack(Ef).transpose(2, 0, 1, 3).reshape(B, P, O * B)  # [S, P, OB]
+        sel_fn = make_select(P, B, str(Cs.dtype), interpret=jax.default_backend() != 'tpu')
         nov, dlat = pair_meta(qmeta, lat)
         is_dc = (method == 1) | (method == 2)
         is_wdc = (method == 4) | (method == 5)
@@ -212,7 +258,7 @@ def _build_cse_fn(spec: _KernelSpec):
                 jnp.where((method == 1) | (method == 3) | (method == 4), 1.0, 0.0),
             ]
         ).reshape(1, 4)
-        flat, any_valid = sel_fn(Ef.reshape(P, O * B), sh, nov, dlat, coef)
+        flat, any_valid = sel_fn(Cs, Cd, nov, dlat, coef)
         return any_valid, *_decode_flat(flat, P, B)
 
     b_idx = jnp.arange(B)
@@ -270,22 +316,21 @@ def _build_cse_fn(spec: _KernelSpec):
         op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
 
         def cond(state):
-            E, qmeta, lat, cur, _, go = state
+            E, Cs, Cd, qmeta, lat, cur, _, go = state
             return go & (cur < P)
 
         def body(state):
-            E, qmeta, lat, cur, op_rec, _ = state
+            E, Cs, Cd, qmeta, lat, cur, op_rec, _ = state
             if spec.select == 'pallas':
-                any_valid, sub, s, i, j = select_pair_pallas(E, qmeta, lat, method)
+                any_valid, sub, s, i, j = select_pair_pallas(Cs, Cd, qmeta, lat, method)
             else:
-                C_same, C_diff = pair_counts(E)
-                C = jnp.stack([C_same, C_diff])  # [2, S, P, P]
-                any_valid, sub, s, i, j = select_pair(C, qmeta, lat, method)
+                any_valid, sub, s, i, j = select_pair(Cs, Cd, qmeta, lat, method)
 
             def do_update(args):
-                E, qmeta, lat, cur, op_rec = args
+                E, Cs, Cd, qmeta, lat, cur, op_rec = args
                 E2, new_row, _ = substitute(E, sub, s, i, j)
                 E2 = E2.at[cur].set(new_row)
+                Cs2, Cd2 = update_counts(Cs, Cd, E2, jnp.stack([i, j, cur]))
 
                 id0 = jnp.minimum(i, j)
                 id1 = jnp.maximum(i, j)
@@ -303,17 +348,18 @@ def _build_cse_fn(spec: _KernelSpec):
                 qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
                 lat = lat.at[cur].set(nlat)
                 op_rec = op_rec.at[cur - cur0].set(jnp.stack([id0, id1, sub, shift]))
-                return E2, qmeta, lat, cur + 1, op_rec
+                return E2, Cs2, Cd2, qmeta, lat, cur + 1, op_rec
 
             def no_update(args):
                 return args
 
-            args = (E, qmeta, lat, cur, op_rec)
-            E, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
-            return E, qmeta, lat, cur, op_rec, any_valid
+            args = (E, Cs, Cd, qmeta, lat, cur, op_rec)
+            E, Cs, Cd, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
+            return E, Cs, Cd, qmeta, lat, cur, op_rec, any_valid
 
-        state = (E0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
-        E, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
+        Cs0, Cd0 = pair_counts(E0)
+        state = (E0, Cs0, Cd0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
+        E, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
         return E, qmeta, lat, op_rec, cur
 
     return jax.jit(jax.vmap(lane_fn))
@@ -447,6 +493,7 @@ def solve_single_lanes(
 
             sh = batch_sharding(mesh, mesh.axis_names[0])
 
+        debug = bool(int(os.environ.get('DA4ML_JAX_DEBUG', '0') or '0'))
         pend = list(range(n_act))
         dE = jnp.asarray(Eb)
         dq = jnp.asarray(qb)
@@ -475,17 +522,22 @@ def solve_single_lanes(
             if sh is not None:
                 args = tuple(jax.device_put(a, sh) for a in args)
 
+            # the fused pallas select tiles its row axis, so every shape
+            # class is admissible — no VMEM-based fallback needed
             select = os.environ.get('DA4ML_JAX_SELECT', 'xla')
-            if select == 'pallas':
-                # the fused kernel keeps its whole working set in VMEM; large
-                # shape classes (staged searches growing P) must stay on XLA
-                from .pallas_select import fits_vmem
-
-                if not fits_vmem(P, O, B):
-                    select = 'xla'
             fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size, select))
+            if debug:
+                import time as _time
+
+                _t0 = _time.perf_counter()
             dE, dq, dl, d_rec, dc_ = fn(*args)
             cur_f = np.asarray(jax.device_get(dc_))[:n_pend]
+            if debug:
+                print(
+                    f'[jax_search] round P={P} O={O} B={B} bucket={bucket} n_iters={n_iters} '
+                    f'select={select}: {_time.perf_counter() - _t0:.2f}s',
+                    flush=True,
+                )
             op_rec = np.asarray(jax.device_get(d_rec))[:n_pend]
 
             fin_pos, cont_pos, next_pend = [], [], []

@@ -1,29 +1,33 @@
 """Pallas TPU kernel for the CSE pair-selection step.
 
-The XLA path of the device search materializes, per greedy iteration, the
-full candidate tensor ``[2, B, P, P]`` (counts, scores, masks) in HBM — at
-P≈128 that is hundreds of MB of traffic per iteration across a lane batch.
-This kernel fuses pair counting (MXU dots), scoring, masking, and the
-argmax into one VMEM-resident program per lane: HBM sees only the digit
-tensor going in and two scalars coming out.
+The XLA select path scores the pair-count tensor ``[2, S, P, P]`` and takes
+an argmax every greedy iteration. XLA fuses the elementwise scoring into the
+reduction, but it still runs two passes (max, then argmax) over the counts
+and materializes broadcast temporaries at tile boundaries. This kernel does
+the whole selection in one grid pass: each cell loads a row-tile of the
+count tensor into VMEM, computes score + validity masks in registers, and
+reduces to a per-tile (max value, first flat index) pair; a tiny XLA argmax
+over the per-tile results finishes the selection.
 
-Per lane (grid cell):
-  inputs   e    [P, O*B]    f32  — digit tensor, bit-major within output
-           sh   [B, P, O*B] f32  — e shifted by s along the bit axis
-           nov  [P, P]      f32  — pairwise overlap weights
-           dlat [P, P]      f32  — pairwise latency imbalance
-           coef [1, 4]      f32  — (w_mc, w_ov, penalty, absolute) from the
-                                   per-lane heuristic code
-  output   out  [1, 2]      i32  — (flat candidate index, any_valid)
+Per grid cell (s, row-block):
+  inputs   cs/cd [1, Pb, P] int16/int32 — count tile (same / diff pairs)
+           nov   [Pb, P] f32            — pairwise overlap weights
+           dlat  [Pb, P] f32            — pairwise latency imbalance
+           coef  [1, 4]  f32 (SMEM)     — (w_mc, w_ov, penalty, absolute)
+  outputs  vals  [1, 1, 2] f32 (SMEM)   — per-sub tile maxima
+           idxs  [1, 1, 2] i32 (SMEM)   — per-sub first-max flat indices
 
-Flat index layout matches the XLA path (``sub``-major, then shift, then
-(i, j) row-major), and the scan order (sub outer, s inner, strict ``>``
-update, first-index tie-break within a slice) reproduces its tie-breaking
-exactly, so both implementations are decision-identical.
+Scalar results are written to SMEM blocks — scalar stores to VMEM are
+rejected by Mosaic on real TPUs (the round-1 kernel only ever ran in
+interpret mode and hit exactly that on hardware).
 
-Selection is enabled with ``DA4ML_JAX_SELECT=pallas`` (interpret mode is
-used automatically off-TPU). Reference for the selection semantics:
+Decision identity: the flat index layout matches ``_decode_flat``
+(sub-major, then shift, then (i, j) row-major); ties resolve to the first
+flat index via a min-over-equal-maxima reduction in-kernel and
+first-occurrence argmax across tiles. Selection semantics reference:
 src/da4ml/_binary/cmvm/indexers.cc of calad0i/da4ml.
+
+Enabled with ``DA4ML_JAX_SELECT=pallas`` (interpret mode off-TPU).
 """
 
 from __future__ import annotations
@@ -38,111 +42,101 @@ try:  # pltpu is unavailable on some CPU-only builds; interpret mode suffices
     from jax.experimental.pallas import tpu as pltpu
 
     _SMEM = pltpu.SMEM
-    _VMEM = pltpu.VMEM
 except Exception:  # pragma: no cover
     pltpu = None
-    _SMEM = _VMEM = None
+    _SMEM = None
+
+_NEG = -3.0e38  # plain scalars: jnp constants would be captured by the kernel
+_BIG = 2**31 - 1
+
+# VMEM working set per cell ~ 6 f32 row-tiles [Pb, P]; keep them comfortably
+# under the ~16 MiB/core budget with headroom for temporaries.
+_TILE_BUDGET_ELEMS = 192 * 1024  # Pb * P <= this  (~4.5 MiB of f32 tiles)
 
 
-# Per-core VMEM is ~16 MiB on current TPUs; the kernel keeps every operand
-# resident (no blocking), so refuse shape classes whose working set cannot
-# fit with headroom for the dot-general accumulators.
-VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+def _row_tile(P: int) -> int:
+    """Largest row-tile Pb (multiple of 8) with Pb * P within budget."""
+    pb = max(8, (_TILE_BUDGET_ELEMS // max(P, 1)) // 8 * 8)
+    return min(P, pb)
 
 
-def vmem_footprint_bytes(P: int, O: int, B: int) -> int:
-    """Resident f32 working set of the fused select kernel for one lane."""
-    OB = O * B
-    sh = B * P * OB * 4  # shifted digit stack — the dominant term
-    e = P * OB * 4
-    pairs = 2 * P * P * 4  # nov + dlat
-    scratch = 4 * P * P * 4  # dot outputs + score/valid temporaries
-    return sh + e + pairs + scratch
+@lru_cache(maxsize=32)
+def make_select(P: int, B: int, cdtype: str, *, interpret: bool = False):
+    """Selection function (Cs, Cd, nov, dlat, coef) -> (flat, any_valid).
 
-
-def fits_vmem(P: int, O: int, B: int, budget: int = VMEM_BUDGET_BYTES) -> bool:
-    """Whether the fused kernel's working set fits in VMEM for this class.
-
-    The staged search grows P past 128 where ``sh`` alone can exceed the
-    budget (e.g. P=256, O=64, B=16 -> 16 MiB for ``sh``); callers must fall
-    back to the XLA select path when this returns False.
+    Cs/Cd are the ``[S, P, P]`` same/diff pair counts (S == B shifts), nov and
+    dlat the ``[P, P]`` pair metadata, coef the ``[1, 4]`` per-lane heuristic
+    coefficients. Returns the flat candidate index (layout of
+    ``jax_search._decode_flat``) and whether any candidate was valid.
     """
-    return vmem_footprint_bytes(P, O, B) <= budget
+    Pb = _row_tile(P)
+    RB = pl.cdiv(P, Pb)
+    S = B
 
-
-def _vspec():
-    return pl.BlockSpec(memory_space=_VMEM) if _VMEM is not None else pl.BlockSpec()
-
-
-def _sspec():
-    return pl.BlockSpec(memory_space=_SMEM) if _SMEM is not None else pl.BlockSpec()
-
-
-@lru_cache(maxsize=64)
-def make_select(P: int, O: int, B: int, interpret: bool = False):
-    """Build the fused select function for one shape class.
-
-    Returns ``select(e, sh, nov, dlat, coef) -> (flat, any_valid)`` operating
-    on a single lane; `jax.vmap` lifts it to the lane batch (pallas adds a
-    grid axis).
-    """
-    OB = O * B
-
-    def kernel(e_ref, sh_ref, nov_ref, dlat_ref, coef_ref, out_ref):
-        e = e_ref[...]  # [P, OB]
-        ea = jnp.abs(e)
-        nov = nov_ref[...]  # [P, P]
-        dl = dlat_ref[...]
+    def kernel(cs_ref, cd_ref, nov_ref, dlat_ref, coef_ref, vals_ref, idxs_ref):
+        s = pl.program_id(0)
+        rb = pl.program_id(1)
+        nov = nov_ref[...]
+        dlat = dlat_ref[...]
         w_mc = coef_ref[0, 0]
         w_ov = coef_ref[0, 1]
         pen = coef_ref[0, 2]
         absolute = coef_ref[0, 3]
 
-        row = jax.lax.broadcasted_iota(jnp.int32, (P, P), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (P, P), 1)
-        iota2 = row * P + col
-        upper = row < col
-        big = jnp.int32(2**30)
-        neg_inf = jnp.float32(-jnp.inf)
+        i_loc = jax.lax.broadcasted_iota(jnp.int32, (Pb, P), 0)
+        j_g = jax.lax.broadcasted_iota(jnp.int32, (Pb, P), 1)
+        i_g = rb * Pb + i_loc
+        # s == 0 admits only i < j; padded rows (i_g >= P) are never valid
+        base_ok = ((s > 0) | (i_g < j_g)) & (i_g < P)
+        flat_loc = i_g * P + j_g
 
-        weight = w_mc + nov * w_ov
-        pen_dl = pen * dl
+        for sub, ref in ((0, cs_ref), (1, cd_ref)):
+            c = ref[0].astype(jnp.float32)
+            score = w_mc * c + w_ov * c * nov - pen * dlat
+            valid = (c >= 2.0) & base_ok & ((absolute == 0.0) | (score >= 0.0))
+            score = jnp.where(valid, score, _NEG)
+            best = jnp.max(score)
+            # first flat index among the maxima (ties: lowest (i, j))
+            idx = jnp.min(jnp.where(score == best, flat_loc, _BIG))
+            vals_ref[0, 0, sub] = best
+            idxs_ref[0, 0, sub] = s * (P * P) + idx
 
-        best = neg_inf
-        bidx = jnp.int32(0)
-        for sub in range(2):
-            for s in range(B):
-                sh_s = sh_ref[s]  # [P, OB]
-                dn = (((1,), (1,)), ((), ()))
-                a = jax.lax.dot_general(e, sh_s, dn, preferred_element_type=jnp.float32)
-                d = jax.lax.dot_general(ea, jnp.abs(sh_s), dn, preferred_element_type=jnp.float32)
-                cnt = (d + a) * 0.5 if sub == 0 else (d - a) * 0.5
-                score = cnt * weight - pen_dl
-                valid = cnt >= 2.0
-                if s == 0:
-                    valid &= upper
-                valid &= (absolute == 0.0) | (score >= 0.0)
-                sc = jnp.where(valid, score, neg_inf)
-                m = jnp.max(sc)
-                loc = jnp.min(jnp.where(sc == m, iota2, big))
-                flat = jnp.int32((sub * B + s) * P * P) + loc
-                upd = m > best
-                best = jnp.where(upd, m, best)
-                bidx = jnp.where(upd, flat, bidx)
-
-        out_ref[0, 0] = bidx
-        out_ref[0, 1] = (best != neg_inf).astype(jnp.int32)
+    grid = (S, RB)
+    count_spec = pl.BlockSpec((1, Pb, P), lambda s, rb: (s, rb, 0))
+    pair_spec = pl.BlockSpec((Pb, P), lambda s, rb: (rb, 0))
+    if not interpret and _SMEM is not None:
+        coef_spec = pl.BlockSpec(memory_space=_SMEM)
+        out_specs = [
+            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0), memory_space=_SMEM),
+            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0), memory_space=_SMEM),
+        ]
+    else:
+        coef_spec = pl.BlockSpec((1, 4), lambda s, rb: (0, 0))
+        out_specs = [
+            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0)),
+            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0)),
+        ]
 
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((1, 2), jnp.int32),
-        in_specs=[_vspec(), _vspec(), _vspec(), _vspec(), _sspec()],
-        out_specs=_vspec(),
+        grid=grid,
+        in_specs=[count_spec, count_spec, pair_spec, pair_spec, coef_spec],
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, RB, 2), jnp.float32),
+            jax.ShapeDtypeStruct((S, RB, 2), jnp.int32),
+        ],
         interpret=interpret,
     )
 
-    def select(e, sh, nov, dlat, coef):
-        out = call(e, sh, nov, dlat, coef)
-        return out[0, 0], out[0, 1] != 0
+    def select(Cs, Cd, nov, dlat, coef):
+        vals, idxs = call(Cs, Cd, nov, dlat, coef)
+        # flatten in (sub, s, rb) order == flat candidate order
+        v = vals.transpose(2, 0, 1).reshape(-1)
+        g = jnp.argmax(v)
+        any_valid = v[g] > _NEG
+        sub = (g // (S * RB)).astype(jnp.int32)
+        flat = sub * (B * P * P) + idxs.transpose(2, 0, 1).reshape(-1)[g]
+        return flat, any_valid
 
     return select
