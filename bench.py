@@ -5,8 +5,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 Headline (BASELINE.md config 1): batch-solve random 16x16 int4 kernels on the
 JAX backend vs the native C++/OpenMP solver pinned to 16 threads (the
 BASELINE.json baseline). detail[] adds config 2 (JEDI-linear MLP layer
-kernels) and config 3 (dim x bits random sweep), plus the compile-vs-search
-time split of the JAX path.
+kernels), config 3 (dim x bits random sweep), config 4 (QConv2D 3x3 kernels
+as im2col constant blocks [kh*kw*Cin, Cout]), and config 5 (a full MLP+Conv
+model traced end to end, jax vs cpp solver backend), plus the
+compile-vs-search time split of the JAX path.
 
 Robustness: the axon TPU plugin can *hang* (not just fail) at backend init,
 so the TPU is probed in a bounded subprocess with retries; on failure the
@@ -110,6 +112,44 @@ def _run_config(name, kernels, host_backend):
     return entry
 
 
+def _trace_model(backend: str, limited: bool):
+    """Trace the config-5 model (BASELINE.md: MLP+Conv, all layers CMVM)."""
+    import da4ml_tpu.trace.ops.conv_utils as cu
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    rng = np.random.default_rng(5)
+    side, cin, cmid, dense = (4, 2, 4, 8) if limited else (8, 3, 8, 32)
+    inp = FixedVariableArrayInput((side, side, cin), hwconf=HWConfig(1, -1, -1), solver_options={'backend': backend})
+    x = inp.quantize(np.ones((side, side, cin)), np.full((side, side, cin), 3), np.full((side, side, cin), 2))
+    w1 = rng.integers(-32, 32, (3, 3, cin, cmid)).astype(np.float64)
+    x = cu.conv2d(x, w1, padding='same')
+    x = x.relu(i=np.full(x.shape, 6), f=np.full(x.shape, 2))
+    x = cu.max_pool2d(x, 2)
+    x = x.reshape(-1)
+    w2 = rng.integers(-32, 32, (x.shape[0], dense)).astype(np.float64)
+    x = (x @ w2).relu(i=np.full(dense, 7), f=np.full(dense, 2))
+    w3 = rng.integers(-32, 32, (dense, 5)).astype(np.float64)
+    return comb_trace(inp, x @ w3)
+
+
+def _run_model_config(limited: bool, host_backend: str = 'cpp'):
+    """Config 5: end-to-end model build time (trace + every CMVM solve)."""
+    t0 = time.perf_counter()
+    comb_host = _trace_model(host_backend, limited)
+    host_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    comb_jax = _trace_model('jax', limited)
+    jax_t = time.perf_counter() - t0
+    return {
+        'config': '5_full_model_trace',
+        'host_s': round(host_t, 3),
+        'jax_s': round(jax_t, 3),
+        'speedup': round(host_t / jax_t, 3),
+        'cost_jax': float(comb_jax.cost),
+        'cost_host': float(comb_host.cost),
+    }
+
+
 def main():
     n1 = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     detail: dict = {'host_threads': HOST_THREADS, 'nproc': os.cpu_count()}
@@ -124,6 +164,13 @@ def main():
     if platform is None:
         jax.config.update('jax_platforms', 'cpu')
     detail['platform'] = platform or 'cpu-fallback'
+    # persistent compilation cache: staged-search shape classes compile once
+    # per machine, not once per bench run
+    try:
+        jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    except Exception:
+        pass
 
     try:
         from da4ml_tpu.native import has_solver
@@ -158,11 +205,25 @@ def main():
     if limited:
         shapes3 = tuple((d, b) for d, b in shapes3 if d <= 16)
     k3 = [_rand_kernel(rng, d, d, b) for d, b in shapes3]
-    for name, ks in (('2_jedi_mlp_layers', k2), ('3_dim_bits_sweep', k3)):
+    # config 4: QConv2D 3x3 kernels unrolled to im2col blocks [9*Cin, Cout]
+    shapes4 = ((1, 8), (4, 8), (8, 16), (16, 16))
+    if limited:
+        shapes4 = tuple((ci, co) for ci, co in shapes4 if 9 * ci <= 36)
+    k4 = [_rand_kernel(rng, 9 * ci, co, 6) for ci, co in shapes4]
+    for name, ks in (('2_jedi_mlp_layers', k2), ('3_dim_bits_sweep', k3), ('4_qconv3x3_im2col', k4)):
         if time.monotonic() > deadline:
             detail.setdefault('skipped_configs', []).append(name)
             continue
         detail['configs'].append(_run_config(name, ks, host_backend))
+
+    # config 5: full MLP+Conv model traced end to end (trace + all solves)
+    if time.monotonic() < deadline:
+        try:
+            detail['configs'].append(_run_model_config(limited, host_backend))
+        except Exception as e:
+            detail['model_config_error'] = f'{type(e).__name__}: {e}'[:200]
+    else:
+        detail.setdefault('skipped_configs', []).append('5_full_model_trace')
 
     # fused Pallas selection vs XLA select microbench (real TPU only)
     if platform is not None and platform != 'cpu' and time.monotonic() < deadline:

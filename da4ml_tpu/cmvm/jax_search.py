@@ -194,10 +194,14 @@ def _build_cse_fn(spec: _KernelSpec):
         Cd = Cd.at[:, R, :].set(d1).at[:, :, R].set(d2)
         return Cs, Cd
 
-    s_np = np.arange(B, dtype=np.int64)[None, :, None, None]
-    i_np = np.arange(P, dtype=np.int64)[None, None, :, None]
-    j_np = np.arange(P, dtype=np.int64)[None, None, None, :]
-    S0_MASK = jnp.asarray((s_np > 0) | (i_np < j_np))
+    def _s0_mask():
+        # s == 0 admits only i < j (i == j is self-pairing; i > j duplicates
+        # i < j). Built from iota, not a baked [S, P, P] literal — at large P
+        # a dense constant bloats the executable and HBM.
+        s_ax = jax.lax.broadcasted_iota(jnp.int32, (1, B, P, P), 1)
+        i_ax = jax.lax.broadcasted_iota(jnp.int32, (1, B, P, P), 2)
+        j_ax = jax.lax.broadcasted_iota(jnp.int32, (1, B, P, P), 3)
+        return (s_ax > 0) | (i_ax < j_ax)
 
     def select_pair(Cs, Cd, qmeta, lat, method):
         """Masked scoring + single-pass argmax over the [2, S, P, P] tensor.
@@ -208,8 +212,7 @@ def _build_cse_fn(spec: _KernelSpec):
         C = jnp.stack([Cs, Cd]).astype(jnp.float32)  # [2, S, P, P]
         count = C
         valid = C >= 2.0
-        # s == 0: only i < j (i == j is self-pairing; i > j duplicates i < j)
-        valid &= S0_MASK
+        valid &= _s0_mask()
 
         # canonical id0/id1: (i, j) if i <= j else (j, i) — metadata symmetric
         n_ov, dlat = pair_meta(qmeta, lat)
