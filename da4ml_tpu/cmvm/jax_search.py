@@ -105,6 +105,15 @@ def _overlap_vec(lo0, hi0, st0, lo1, hi1, st1):
     return k + i_low + f
 
 
+def _count_itemsize(O: int, B: int) -> int:
+    """Bytes per pair-count element: int16 unless O*B could overflow it.
+
+    Single source of truth for the storage dtype in ``_build_cse_fn`` and the
+    HBM budget estimate in ``solve_single_lanes``.
+    """
+    return 2 if O * B < 32000 else 4
+
+
 @dataclass(frozen=True)
 class _KernelSpec:
     P: int  # total slots (inputs + max CSE intermediates)
@@ -149,7 +158,7 @@ def _build_cse_fn(spec: _KernelSpec):
 
     # counts are bounded by O*B matches per pair; int16 storage halves the
     # bandwidth of the per-iteration scoring pass
-    cdtype = jnp.int16 if O * B < 32000 else jnp.int32
+    cdtype = jnp.int16 if _count_itemsize(O, B) == 2 else jnp.int32
 
     def pair_counts(E):
         """C_same/C_diff [S=B, P, P]: matches of row-i bit b with row-j bit b+s.
@@ -503,68 +512,100 @@ def solve_single_lanes(
         dl = jnp.asarray(lb)
         dc_ = jnp.full((n_act,), n_in_max, dtype=jnp.int32)
         dm = jnp.asarray(mcodes)
+        hbm_budget = int(os.environ.get('DA4ML_JAX_HBM_BUDGET', str(4 << 30)))
         while pend:
             P = int(st_cur[pend].max()) + step
             n_iters = P - n_in_max
             n_pend = len(pend)
-            bucket = _bucket_lanes(n_pend, mesh)
-            pad_lane = (0, bucket - dE.shape[0])
-            pad_slot = (0, P - dE.shape[1])
-            dE = jnp.pad(dE, (pad_lane, pad_slot, (0, 0), (0, 0)))
-            lanes0, slots0 = dq.shape[0], dq.shape[1]
-            dq = jnp.pad(dq, (pad_lane, pad_slot, (0, 0)))
-            # padded rows must keep the benign-metadata invariant (step 1.0,
-            # not 0): their zero digit rows are never selectable, but scoring
-            # reads the step column unguarded
-            dq = dq.at[:, slots0:, 2].set(1.0)
-            dq = dq.at[lanes0:, :, 2].set(1.0)
-            dl = jnp.pad(dl, (pad_lane, pad_slot))
-            dc_ = jnp.pad(dc_, pad_lane, constant_values=n_in_max)
-            dm = jnp.pad(dm, pad_lane)
-            args = (dE, dq, dl, dc_, dm)
-            if sh is not None:
-                args = tuple(jax.device_put(a, sh) for a in args)
-
-            # the fused pallas select tiles its row axis, so every shape
-            # class is admissible — no VMEM-based fallback needed
             select = os.environ.get('DA4ML_JAX_SELECT', 'xla')
             fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size, select))
-            if debug:
-                import time as _time
 
-                _t0 = _time.perf_counter()
-            dE, dq, dl, d_rec, dc_ = fn(*args)
-            cur_f = np.asarray(jax.device_get(dc_))[:n_pend]
-            if debug:
-                print(
-                    f'[jax_search] round P={P} O={O} B={B} bucket={bucket} n_iters={n_iters} '
-                    f'select={select}: {_time.perf_counter() - _t0:.2f}s',
-                    flush=True,
-                )
-            op_rec = np.asarray(jax.device_get(d_rec))[:n_pend]
+            # HBM guard: the carried pair-count tensors dominate the loop
+            # state (2 x [S, P, P] per lane, plus f32 scoring transients).
+            # Bound the lanes per device call so a wide batch of large
+            # matrices cannot OOM-crash the worker; excess lanes run in
+            # sequential chunks of the same compiled program.
+            itemsize = _count_itemsize(O, B)
+            per_lane = 2 * B * P * P * (itemsize + 4) + P * O * B + 16 * P
+            max_lanes = max(1, hbm_budget // per_lane)
+            # the budget must hold for the *padded* lane bucket, not just
+            # n_pend — _bucket_lanes rounds up to a power of two (and a mesh
+            # multiple), which can nearly double the allocation
+            if _bucket_lanes(n_pend, mesh) > max_lanes:
+                max_lanes = max(1, 1 << (max_lanes.bit_length() - 1))
 
-            fin_pos, cont_pos, next_pend = [], [], []
-            for x, a in enumerate(pend):
-                c0, c1 = int(st_cur[a]), int(cur_f[x])
-                if c1 > c0:
-                    recs[a].append(op_rec[x, : c1 - c0].copy())
-                st_cur[a] = c1
-                if c1 >= P:  # budget exhausted -> resume with a larger P
-                    next_pend.append(a)
-                    cont_pos.append(x)
+            next_pend: list[int] = []
+            outE_parts, outq_parts, outl_parts, outc_parts, outm_parts = [], [], [], [], []
+            for lo in range(0, n_pend, max_lanes):
+                hi = min(lo + max_lanes, n_pend)
+                n_chunk = hi - lo
+                if lo == 0 and n_chunk == n_pend:
+                    cE, cq, cl, cc, cm = dE, dq, dl, dc_, dm
                 else:
-                    fin_pos.append(x)
-            if fin_pos:
-                E_fin = np.asarray(jax.device_get(jnp.take(dE, jnp.asarray(fin_pos), axis=0)))
-                for y, x in enumerate(fin_pos):
-                    st_E[pend[x]] = E_fin[y]
+                    cE, cq, cl, cc, cm = dE[lo:hi], dq[lo:hi], dl[lo:hi], dc_[lo:hi], dm[lo:hi]
+                bucket = _bucket_lanes(n_chunk, mesh)
+                pad_lane = (0, bucket - cE.shape[0])
+                pad_slot = (0, P - cE.shape[1])
+                cE = jnp.pad(cE, (pad_lane, pad_slot, (0, 0), (0, 0)))
+                lanes0, slots0 = cq.shape[0], cq.shape[1]
+                cq = jnp.pad(cq, (pad_lane, pad_slot, (0, 0)))
+                # padded rows must keep the benign-metadata invariant (step
+                # 1.0, not 0): their zero digit rows are never selectable,
+                # but scoring reads the step column unguarded
+                cq = cq.at[:, slots0:, 2].set(1.0)
+                cq = cq.at[lanes0:, :, 2].set(1.0)
+                cl = jnp.pad(cl, (pad_lane, pad_slot))
+                cc = jnp.pad(cc, pad_lane, constant_values=n_in_max)
+                cm = jnp.pad(cm, pad_lane)
+                args = (cE, cq, cl, cc, cm)
+                if sh is not None:
+                    args = tuple(jax.device_put(a, sh) for a in args)
+
+                if debug:
+                    import time as _time
+
+                    _t0 = _time.perf_counter()
+                cE, cq, cl, c_rec, cc = fn(*args)
+                cur_f = np.asarray(jax.device_get(cc))[:n_chunk]
+                if debug:
+                    print(
+                        f'[jax_search] round P={P} O={O} B={B} bucket={bucket} n_iters={n_iters} '
+                        f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_time.perf_counter() - _t0:.2f}s',
+                        flush=True,
+                    )
+                op_rec = np.asarray(jax.device_get(c_rec))[:n_chunk]
+
+                cont_pos: list[int] = []
+                fin_here: list[tuple[int, int]] = []  # (lane index, position in chunk)
+                for x in range(n_chunk):
+                    a = pend[lo + x]
+                    c0, c1 = int(st_cur[a]), int(cur_f[x])
+                    if c1 > c0:
+                        recs[a].append(op_rec[x, : c1 - c0].copy())
+                    st_cur[a] = c1
+                    if c1 >= P:  # budget exhausted -> resume with a larger P
+                        next_pend.append(a)
+                        cont_pos.append(x)
+                    else:
+                        fin_here.append((a, x))
+                if fin_here:
+                    E_fin = np.asarray(jax.device_get(jnp.take(cE, jnp.asarray([x for _, x in fin_here]), axis=0)))
+                    for y, (a, _) in enumerate(fin_here):
+                        st_E[a] = E_fin[y]
+                if cont_pos:
+                    keep = jnp.asarray(cont_pos)
+                    outE_parts.append(jnp.take(cE, keep, 0))
+                    outq_parts.append(jnp.take(cq, keep, 0))
+                    outl_parts.append(jnp.take(cl, keep, 0))
+                    outc_parts.append(jnp.take(cc[:n_chunk], keep, 0))
+                    outm_parts.append(jnp.take(cm[:n_chunk], keep, 0))
+
             if next_pend:
-                keep = jnp.asarray(cont_pos)
-                dE = jnp.take(dE, keep, axis=0)
-                dq = jnp.take(dq, keep, axis=0)
-                dl = jnp.take(dl, keep, axis=0)
-                dc_ = jnp.take(dc_[:n_pend], keep, axis=0)
-                dm = jnp.take(dm[:n_pend], keep, axis=0)
+                dE = jnp.concatenate(outE_parts) if len(outE_parts) > 1 else outE_parts[0]
+                dq = jnp.concatenate(outq_parts) if len(outq_parts) > 1 else outq_parts[0]
+                dl = jnp.concatenate(outl_parts) if len(outl_parts) > 1 else outl_parts[0]
+                dc_ = jnp.concatenate(outc_parts) if len(outc_parts) > 1 else outc_parts[0]
+                dm = jnp.concatenate(outm_parts) if len(outm_parts) > 1 else outm_parts[0]
             pend = next_pend
 
         emit_jobs: list[tuple[int, NDArray, NDArray]] = []  # (lane idx, E_lane, rec)
