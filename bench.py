@@ -150,6 +150,42 @@ def _run_model_config(limited: bool, host_backend: str = 'cpp'):
     }
 
 
+def _run_inference_micro(limited: bool):
+    """DAIS inference samples/s: jitted device kernel vs native interpreter."""
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    rng = np.random.default_rng(11)
+    n_in, hidden = (8, 16) if limited else (16, 64)
+    inp = FixedVariableArrayInput(n_in, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(n_in), np.full(n_in, 3), np.full(n_in, 2))
+    w1 = rng.integers(-8, 8, (n_in, hidden)).astype(np.float64)
+    x = (x @ w1).relu(i=np.full(hidden, 6), f=np.full(hidden, 2))
+    w2 = rng.integers(-8, 8, (hidden, 8)).astype(np.float64)
+    comb = comb_trace(inp, x @ w2)
+
+    n_samples = 4096 if limited else 262144
+    data = rng.uniform(-8, 8, (n_samples, n_in))
+
+    ex = DaisExecutor(decode(comb.to_binary()))
+    out_dev = ex(data)  # first call pays the compile
+    t0 = time.perf_counter()
+    out_dev = ex(data)
+    dev_t = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out_host = comb.predict(data, n_threads=HOST_THREADS)
+    host_t = time.perf_counter() - t0
+    return {
+        'n_samples': n_samples,
+        'device_rate': round(n_samples / dev_t, 1),
+        'host_rate': round(n_samples / host_t, 1),
+        'speedup': round(host_t / dev_t, 3),
+        'bit_exact': bool(np.array_equal(out_dev, out_host)),
+    }
+
+
 def main():
     n1 = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     detail: dict = {'host_threads': HOST_THREADS, 'nproc': os.cpu_count()}
@@ -224,6 +260,31 @@ def main():
             detail['model_config_error'] = f'{type(e).__name__}: {e}'[:200]
     else:
         detail.setdefault('skipped_configs', []).append('5_full_model_trace')
+
+    # solution-quality axis: widening the device sweep with a second
+    # selection heuristic costs only extra lanes — report the cost win
+    if time.monotonic() < deadline:
+        try:
+            from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+            t0 = time.perf_counter()
+            wide = solve_jax_many(k1, method0_candidates=['wmc', 'mc'])
+            detail['quality_sweep'] = {
+                'mean_cost_wide': round(float(np.mean([s.cost for s in wide])), 3),
+                'mean_cost_single': c1['mean_cost_jax'],
+                'wall_s': round(time.perf_counter() - t0, 2),
+            }
+        except Exception as e:
+            detail['quality_sweep'] = {'error': f'{type(e).__name__}: {e}'[:200]}
+
+    # DAIS batch-inference throughput: jitted XLA integer kernel vs the
+    # native OpenMP interpreter (the reference's sample-parallel axis,
+    # src/da4ml/_binary/dais/bindings.cc:58-96 of calad0i/da4ml)
+    if time.monotonic() < deadline:
+        try:
+            detail['dais_inference'] = _run_inference_micro(limited)
+        except Exception as e:
+            detail['dais_inference'] = {'error': f'{type(e).__name__}: {e}'[:200]}
 
     # fused Pallas selection vs XLA select microbench (real TPU only)
     if platform is not None and platform != 'cpu' and time.monotonic() < deadline:

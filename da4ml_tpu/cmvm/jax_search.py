@@ -512,7 +512,10 @@ def solve_single_lanes(
         dl = jnp.asarray(lb)
         dc_ = jnp.full((n_act,), n_in_max, dtype=jnp.int32)
         dm = jnp.asarray(mcodes)
-        hbm_budget = int(os.environ.get('DA4ML_JAX_HBM_BUDGET', str(4 << 30)))
+        try:
+            hbm_budget = int(float(os.environ.get('DA4ML_JAX_HBM_BUDGET', '') or (4 << 30)))
+        except ValueError:
+            hbm_budget = 4 << 30
         while pend:
             P = int(st_cur[pend].max()) + step
             n_iters = P - n_in_max
@@ -526,13 +529,18 @@ def solve_single_lanes(
             # matrices cannot OOM-crash the worker; excess lanes run in
             # sequential chunks of the same compiled program.
             itemsize = _count_itemsize(O, B)
-            per_lane = 2 * B * P * P * (itemsize + 4) + P * O * B + 16 * P
-            max_lanes = max(1, hbm_budget // per_lane)
-            # the budget must hold for the *padded* lane bucket, not just
-            # n_pend — _bucket_lanes rounds up to a power of two (and a mesh
-            # multiple), which can nearly double the allocation
-            if _bucket_lanes(n_pend, mesh) > max_lanes:
-                max_lanes = max(1, 1 << (max_lanes.bit_length() - 1))
+            # carried counts (+f32 scoring transients) dominate; stage entry
+            # also materializes the shifted digit stack and its abs copy
+            # (pair_counts), bf16 [P, O, S, B] each
+            per_lane = 2 * B * P * P * (itemsize + 4) + 4 * P * O * B * B + P * O * B + 16 * P
+            # under a sharded mesh the lane axis splits across devices, so the
+            # per-device footprint is bucket/nd lanes
+            nd = mesh.devices.size if (mesh is not None and sh is not None) else 1
+            # the budget must hold for the *padded* lane bucket (power of two
+            # and a mesh multiple, _bucket_lanes), not just the chunk length
+            max_lanes = max(1, (nd * hbm_budget) // per_lane)
+            while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
+                max_lanes = max(1, max_lanes // 2)
 
             next_pend: list[int] = []
             outE_parts, outq_parts, outl_parts, outc_parts, outm_parts = [], [], [], [], []

@@ -1,0 +1,106 @@
+"""Careful on-hardware validation ladder for the axon TPU tunnel.
+
+The tunnel's remote worker can crash (and stay wedged) if a program OOMs or
+faults on-device, so each rung runs in its own bounded subprocess and the
+ladder stops at the first failure — never leaving an unbounded process
+holding the chip. Run after any substantial change to the device search:
+
+    python tests_tpu/validate_ladder.py [--fast]
+
+Rungs: basic device op -> tiny solve -> config-1 batch -> wide-output
+matrix (the staged-search stressor) -> bench.py -> tests_tpu suite.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+FAST = '--fast' in sys.argv
+
+RUNGS: list[tuple[str, int, str]] = [
+    (
+        'basic',
+        120,
+        "import jax, jax.numpy as jnp; print('dev', jax.devices()); print('sum', (jnp.arange(16)**2).sum())",
+    ),
+    (
+        'tiny_solve',
+        300,
+        """
+import numpy as np
+from da4ml_tpu.cmvm.jax_search import solve_jax_many
+rng = np.random.default_rng(0)
+ks = [rng.integers(-8, 8, (6, 6)).astype(np.float64) for _ in range(2)]
+sols = solve_jax_many(ks)
+for k, s in zip(ks, sols):
+    assert np.array_equal(np.asarray(s.kernel, np.float64), k)
+print('tiny solve exact')
+""",
+    ),
+    (
+        'config1_batch',
+        420,
+        """
+import numpy as np, time
+from da4ml_tpu.cmvm.jax_search import solve_jax_many
+rng = np.random.default_rng(20260729)
+ks = [(rng.integers(0, 16, (16, 16)) * rng.choice([-1.0, 1.0], (16, 16))).astype(np.float64) for _ in range(32)]
+solve_jax_many(ks)
+t0 = time.perf_counter(); sols = solve_jax_many(ks); dt = time.perf_counter() - t0
+for k, s in zip(ks, sols):
+    assert np.array_equal(np.asarray(s.kernel, np.float64), k)
+print(f'config1 rate {32/dt:.1f} matrices/s')
+""",
+    ),
+    (
+        'wide_output',
+        560,
+        """
+import numpy as np, time, os
+os.environ['DA4ML_JAX_DEBUG'] = '1'
+from da4ml_tpu.cmvm.jax_search import solve_jax_many
+rng = np.random.default_rng(20260729)
+k = (rng.integers(0, 64, (16, 64)) * rng.choice([-1.0, 1.0], (16, 64))).astype(np.float64)
+t0 = time.perf_counter(); sols = solve_jax_many([k]); dt = time.perf_counter() - t0
+assert np.array_equal(np.asarray(sols[0].kernel, np.float64), k)
+print(f'wide 16x64x6 in {dt:.1f}s (incl. compiles)')
+""",
+    ),
+]
+if not FAST:
+    RUNGS += [
+        ('bench', 580, None),  # special: runs bench.py
+        ('tests_tpu', 580, 'TESTS'),  # special: pytest tests_tpu
+    ]
+
+
+def main() -> int:
+    for name, tmo, src in RUNGS:
+        if name == 'bench':
+            cmd = [sys.executable, 'bench.py']
+        elif src == 'TESTS':
+            cmd = [sys.executable, '-m', 'pytest', 'tests_tpu/', '-x', '-q']
+        else:
+            cmd = [sys.executable, '-u', '-c', src]
+        t0 = time.time()
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=tmo)
+        except subprocess.TimeoutExpired:
+            print(f'[{name}] TIMEOUT after {tmo}s — stopping ladder (chip may be wedged)')
+            return 1
+        dt = time.time() - t0
+        tail = (r.stdout or '').strip().splitlines()[-3:]
+        if r.returncode != 0:
+            err = (r.stderr or '').strip().splitlines()[-5:]
+            print(f'[{name}] FAIL rc={r.returncode} in {dt:.0f}s')
+            print('\n'.join('  ' + ln for ln in tail + err))
+            return 1
+        print(f'[{name}] ok in {dt:.0f}s: ' + (tail[-1] if tail else ''))
+    print('ladder complete')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())
