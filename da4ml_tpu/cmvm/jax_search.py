@@ -539,8 +539,12 @@ def solve_single_lanes(
             # the budget must hold for the *padded* lane bucket (power of two
             # and a mesh multiple, _bucket_lanes), not just the chunk length
             max_lanes = max(1, (nd * hbm_budget) // per_lane)
-            while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
-                max_lanes = max(1, max_lanes // 2)
+            if _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
+                # floor to a power of two first (bucket(pow2) == pow2 without
+                # a mesh), then halve until the mesh-rounded bucket also fits
+                max_lanes = 1 << (max_lanes.bit_length() - 1)
+                while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
+                    max_lanes //= 2
 
             next_pend: list[int] = []
             outE_parts, outq_parts, outl_parts, outc_parts, outm_parts = [], [], [], [], []

@@ -77,16 +77,22 @@ if not FAST:
 
 
 def main() -> int:
+    import os
+
     for name, tmo, src in RUNGS:
+        env = dict(os.environ)
         if name == 'bench':
             cmd = [sys.executable, 'bench.py']
+            # keep bench's own worst case (probe retries + budget + grace)
+            # inside this rung's timeout
+            env['DA4ML_BENCH_BUDGET_S'] = '240'
         elif src == 'TESTS':
             cmd = [sys.executable, '-m', 'pytest', 'tests_tpu/', '-x', '-q']
         else:
             cmd = [sys.executable, '-u', '-c', src]
         t0 = time.time()
         try:
-            r = subprocess.run(cmd, capture_output=True, text=True, timeout=tmo)
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=tmo, env=env)
         except subprocess.TimeoutExpired:
             print(f'[{name}] TIMEOUT after {tmo}s — stopping ladder (chip may be wedged)')
             return 1
