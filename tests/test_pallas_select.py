@@ -47,3 +47,45 @@ def test_pallas_select_hard_dc(rng, monkeypatch):
     sol = solve_jax_many([kernel], hard_dc=1)[0]
     _build_cse_fn.cache_clear()
     np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+def _np_select(Cs, Cd, nov, dlat, coef):
+    """Numpy reference of the fused select: first-flat-index argmax."""
+    S, P, _ = Cs.shape
+    w_mc, w_ov, pen, absolute = coef[0]
+    idx = np.arange(P)
+    s0 = (np.arange(S)[None, :, None, None] > 0) | (idx[None, None, :, None] < idx[None, None, None, :])
+    out = []
+    for c in (Cs, Cd):
+        cf = c.astype(np.float64)
+        score = w_mc * cf + w_ov * cf * nov[None] - pen * dlat[None]
+        valid = (cf >= 2) & s0[0] & ((absolute == 0) | (score >= 0))
+        out.append(np.where(valid, score, -np.inf))
+    score = np.stack(out)
+    flat = int(score.argmax())
+    return flat, bool(np.isfinite(score.reshape(-1)[flat]))
+
+
+@pytest.mark.parametrize('P', [24, 512])  # 512 exercises RB > 1 with a ragged last tile
+@pytest.mark.parametrize('coef_row', [(1.0, 0.0, 0.0, 0.0), (0.0, 1.0, 0.0, 1.0), (1.0, 0.0, 1e9, 1.0)])
+def test_make_select_tiled_matches_numpy(rng, P, coef_row):
+    """Kernel-level check incl. the row-tiled path end-to-end tests never hit."""
+    import jax
+
+    from da4ml_tpu.cmvm.pallas_select import _row_tile, make_select
+
+    B = 4
+    if P == 512:
+        assert P % _row_tile(P) != 0, 'pick P so the last tile is ragged'
+    Cs = rng.integers(0, 7, (B, P, P)).astype(np.int16)
+    Cd = rng.integers(0, 7, (B, P, P)).astype(np.int16)
+    nov = rng.uniform(0.5, 4.0, (P, P)).astype(np.float32)
+    dlat = rng.integers(0, 3, (P, P)).astype(np.float32)
+    coef = np.asarray([coef_row], np.float32)
+
+    sel = make_select(P, B, 'int16', interpret=jax.default_backend() != 'tpu')
+    flat, any_valid = jax.jit(sel)(Cs, Cd, nov, dlat, coef)
+    ref_flat, ref_valid = _np_select(Cs, Cd, nov.astype(np.float64), dlat.astype(np.float64), coef.astype(np.float64))
+    assert bool(any_valid) == ref_valid
+    if ref_valid:
+        assert int(flat) == ref_flat
