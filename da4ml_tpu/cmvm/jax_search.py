@@ -150,11 +150,26 @@ def _build_cse_fn(spec: _KernelSpec):
         return pad[:, :, idx]  # [P, O, S, B]
 
     def pair_meta(qmeta, lat):
-        """Pairwise (overlap weight, latency imbalance) [P, P] for scoring."""
+        """Pairwise (overlap weight, latency imbalance) [P, P] for scoring.
+
+        Computed once at stage entry and carried in the loop state; a greedy
+        step changes the metadata of only the new slot ``cur``, so the loop
+        refreshes just that row+column (``meta_update_cur``) instead of
+        re-deriving the full log2 chains every iteration.
+        """
         lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
         n_ov = _overlap_vec(lo[:, None], hi[:, None], st[:, None], lo[None, :], hi[None, :], st[None, :])
         dlat = jnp.abs(lat[:, None] - lat[None, :])
         return n_ov, dlat
+
+    def meta_update_cur(nov, dlat, qmeta, lat, cur):
+        """Refresh row+column ``cur`` of the pairwise metadata (symmetric)."""
+        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
+        vec = _overlap_vec(lo[cur], hi[cur], st[cur], lo, hi, st)
+        nov = nov.at[cur, :].set(vec).at[:, cur].set(vec)
+        dvec = jnp.abs(lat[cur] - lat)
+        dlat = dlat.at[cur, :].set(dvec).at[:, cur].set(dvec)
+        return nov, dlat
 
     # counts are bounded by O*B matches per pair; int16 storage halves the
     # bandwidth of the per-iteration scoring pass
@@ -212,7 +227,7 @@ def _build_cse_fn(spec: _KernelSpec):
         j_ax = jax.lax.broadcasted_iota(jnp.int32, (1, B, P, P), 3)
         return (s_ax > 0) | (i_ax < j_ax)
 
-    def select_pair(Cs, Cd, qmeta, lat, method):
+    def select_pair(Cs, Cd, nov, dlat, method):
         """Masked scoring + single-pass argmax over the [2, S, P, P] tensor.
 
         Ties resolve by first flattened index — deterministic, though not the
@@ -223,8 +238,7 @@ def _build_cse_fn(spec: _KernelSpec):
         valid = C >= 2.0
         valid &= _s0_mask()
 
-        # canonical id0/id1: (i, j) if i <= j else (j, i) — metadata symmetric
-        n_ov, dlat = pair_meta(qmeta, lat)
+        n_ov = nov  # symmetric [P, P]: covers both (i, j) and (j, i) pairs
 
         base_mc = count
         base_wmc = count * n_ov[None, None]
@@ -250,7 +264,7 @@ def _build_cse_fn(spec: _KernelSpec):
         any_valid = jnp.max(score) != -jnp.inf
         return any_valid, *_decode_flat(flat, P, B)
 
-    def select_pair_pallas(Cs, Cd, qmeta, lat, method):
+    def select_pair_pallas(Cs, Cd, nov, dlat, method):
         """Fused VMEM select (pallas): decision-identical with select_pair.
 
         One grid pass over the count tensor computes score + mask + local
@@ -259,7 +273,6 @@ def _build_cse_fn(spec: _KernelSpec):
         from .pallas_select import make_select
 
         sel_fn = make_select(P, B, str(Cs.dtype), interpret=jax.default_backend() != 'tpu')
-        nov, dlat = pair_meta(qmeta, lat)
         is_dc = (method == 1) | (method == 2)
         is_wdc = (method == 4) | (method == 5)
         coef = jnp.stack(
@@ -328,18 +341,18 @@ def _build_cse_fn(spec: _KernelSpec):
         op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
 
         def cond(state):
-            E, Cs, Cd, qmeta, lat, cur, _, go = state
+            E, Cs, Cd, nov, dlt, qmeta, lat, cur, _, go = state
             return go & (cur < P)
 
         def body(state):
-            E, Cs, Cd, qmeta, lat, cur, op_rec, _ = state
+            E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec, _ = state
             if spec.select == 'pallas':
-                any_valid, sub, s, i, j = select_pair_pallas(Cs, Cd, qmeta, lat, method)
+                any_valid, sub, s, i, j = select_pair_pallas(Cs, Cd, nov, dlt, method)
             else:
-                any_valid, sub, s, i, j = select_pair(Cs, Cd, qmeta, lat, method)
+                any_valid, sub, s, i, j = select_pair(Cs, Cd, nov, dlt, method)
 
             def do_update(args):
-                E, Cs, Cd, qmeta, lat, cur, op_rec = args
+                E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec = args
                 E2, new_row, _ = substitute(E, sub, s, i, j)
                 E2 = E2.at[cur].set(new_row)
                 Cs2, Cd2 = update_counts(Cs, Cd, E2, jnp.stack([i, j, cur]))
@@ -359,19 +372,21 @@ def _build_cse_fn(spec: _KernelSpec):
                 max1 = jnp.where(is_sub, -lo1, hi1) * sp
                 qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
                 lat = lat.at[cur].set(nlat)
+                nov2, dlt2 = meta_update_cur(nov, dlt, qmeta, lat, cur)
                 op_rec = op_rec.at[cur - cur0].set(jnp.stack([id0, id1, sub, shift]))
-                return E2, Cs2, Cd2, qmeta, lat, cur + 1, op_rec
+                return E2, Cs2, Cd2, nov2, dlt2, qmeta, lat, cur + 1, op_rec
 
             def no_update(args):
                 return args
 
-            args = (E, Cs, Cd, qmeta, lat, cur, op_rec)
-            E, Cs, Cd, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
-            return E, Cs, Cd, qmeta, lat, cur, op_rec, any_valid
+            args = (E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec)
+            E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
+            return E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec, any_valid
 
         Cs0, Cd0 = pair_counts(E0)
-        state = (E0, Cs0, Cd0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
-        E, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
+        nov0, dlt0 = pair_meta(qmeta0, lat0)
+        state = (E0, Cs0, Cd0, nov0, dlt0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
+        E, _, _, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
         return E, qmeta, lat, op_rec, cur
 
     return jax.jit(jax.vmap(lane_fn))
@@ -529,10 +544,11 @@ def solve_single_lanes(
             # matrices cannot OOM-crash the worker; excess lanes run in
             # sequential chunks of the same compiled program.
             itemsize = _count_itemsize(O, B)
-            # carried counts (+f32 scoring transients) dominate; stage entry
-            # also materializes the shifted digit stack and its abs copy
+            # carried counts (+f32 scoring transients) dominate; the carried
+            # pairwise metadata adds 2 f32 [P, P] planes; stage entry also
+            # materializes the shifted digit stack and its abs copy
             # (pair_counts), bf16 [P, O, S, B] each
-            per_lane = 2 * B * P * P * (itemsize + 4) + 4 * P * O * B * B + P * O * B + 16 * P
+            per_lane = 2 * B * P * P * (itemsize + 4) + 8 * P * P + 4 * P * O * B * B + P * O * B + 16 * P
             # under a sharded mesh the lane axis splits across devices, so the
             # per-device footprint is bucket/nd lanes
             nd = mesh.devices.size if (mesh is not None and sh is not None) else 1
