@@ -47,4 +47,13 @@ def shard_batch(x: np.ndarray, mesh: Mesh | None = None, axis_name: str = 'batch
     return jax.device_put(x, batch_sharding(mesh, axis_name)), n_pad
 
 
-__all__ = ['default_mesh', 'batch_sharding', 'shard_batch', 'pad_to_multiple']
+from .distributed import global_mesh, initialize as initialize_distributed  # noqa: E402
+
+__all__ = [
+    'default_mesh',
+    'batch_sharding',
+    'shard_batch',
+    'pad_to_multiple',
+    'global_mesh',
+    'initialize_distributed',
+]

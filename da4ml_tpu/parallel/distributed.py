@@ -1,0 +1,83 @@
+"""Multi-host initialization: the distributed runtime behind mesh sharding.
+
+The reference's only cross-worker transport is shared-memory OpenMP inside
+one process (meson.build:21 / api.cc:208 of calad0i/da4ml); scaling past
+one host here means the JAX distributed runtime + XLA collectives over
+ICI/DCN instead of a custom NCCL/MPI layer. After ``initialize()``,
+``jax.devices()`` spans every process, ``global_mesh()`` builds a mesh over
+all of them, and the existing entry points (``solve_jax_many(mesh=...)``,
+``DaisExecutor.predict_sharded``) shard their lane/sample axes across hosts
+with XLA inserting the collectives — the candidate argmin stays a host-side
+reduction over gathered per-lane costs, which is bytes per lane.
+
+Single-host multi-device needs none of this: a `Mesh` over local devices
+(``parallel.default_mesh``) is enough, as exercised by the virtual-device
+CI mesh.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> bool:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Arguments default to the standard JAX env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``)
+    or managed-cluster auto-detection. Returns True when a multi-process
+    runtime is active after the call, False for plain single-process use
+    (nothing to do, or no coordinator configured).
+    """
+    import jax
+
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, 'client', None) is not None:
+            return jax.process_count() > 1  # already initialized
+    except Exception:
+        pass  # private-module layout changed; fall through to initialize
+
+    coordinator_address = coordinator_address or os.environ.get('JAX_COORDINATOR_ADDRESS')
+    if num_processes is None and os.environ.get('JAX_NUM_PROCESSES'):
+        num_processes = int(os.environ['JAX_NUM_PROCESSES'])
+    if process_id is None and os.environ.get('JAX_PROCESS_ID'):
+        process_id = int(os.environ['JAX_PROCESS_ID'])
+
+    if coordinator_address is None and num_processes is None:
+        # No explicit config: let managed clusters (TPU pods, SLURM, ...)
+        # auto-detect. A bare single process raises (ValueError for missing
+        # config, RuntimeError when JAX already ran computations) — both
+        # mean "no cluster here", so report single-host. Failures under
+        # *explicit* configuration never take this path and always surface.
+        try:
+            jax.distributed.initialize(**kwargs)
+        except (ValueError, RuntimeError):
+            return False
+        return jax.process_count() > 1
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    return jax.process_count() > 1
+
+
+def global_mesh(axis_name: str = 'lanes'):
+    """A 1D mesh over every device of every participating process.
+
+    With the distributed runtime active this spans hosts (lane shards ride
+    ICI within a slice and DCN across slices, scheduled by XLA); otherwise
+    it is just the local-device mesh.
+    """
+    from . import default_mesh
+
+    return default_mesh(axis_name)

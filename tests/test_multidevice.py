@@ -115,3 +115,21 @@ def test_batch_sharding_spec(mesh):
     sh = batch_sharding(mesh)
     assert sh.spec == PartitionSpec('batch')
     assert sh.mesh.axis_names == ('batch',)
+
+
+def test_global_mesh_and_initialize_single_host():
+    """Single-host behavior of the multi-host entry points: initialize()
+    reports no multi-process runtime, global_mesh spans the local devices
+    and drives a sharded solve exactly."""
+    import numpy as np
+
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+    from da4ml_tpu.parallel import global_mesh, initialize_distributed
+
+    assert initialize_distributed() is False  # no coordinator configured
+    mesh = global_mesh('lanes')
+    assert mesh.devices.size == len(jax.devices())
+    rng = np.random.default_rng(3)
+    ks = [rng.integers(-8, 8, (6, 6)).astype(np.float64) for _ in range(4)]
+    for k, s in zip(ks, solve_jax_many(ks, mesh=mesh)):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
