@@ -251,7 +251,7 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
         k1 = _section_kernels('1_16x16_int4', n1, limited)
         single = solve_jax_many(k1)
         t0 = time.perf_counter()
-        wide = solve_jax_many(k1, method0_candidates=['wmc', 'mc'])
+        wide = solve_jax_many(k1, method0_candidates=['wmc', 'mc'], n_restarts=2 if limited else 4)
         return {
             'mean_cost_wide': round(float(np.mean([s.cost for s in wide])), 3),
             'mean_cost_single': round(float(np.mean([s.cost for s in single])), 3),

@@ -26,6 +26,7 @@ class solver_options_t(TypedDict):
     offload_fn: NotRequired[Callable | None]
     backend: NotRequired[str]
     method0_candidates: NotRequired[list[str] | None]
+    n_restarts: NotRequired[int]
 
 
 __all__ = [

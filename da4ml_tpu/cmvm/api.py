@@ -147,6 +147,7 @@ def solve(
     backend: str = 'cpu',
     n_workers: int = 0,
     method0_candidates: list[str] | None = None,
+    n_restarts: int = 1,
 ) -> Pipeline:
     """Full CMVM solve with optional sweep over all decompose depths.
 
@@ -156,7 +157,8 @@ def solve(
     ``method0_candidates`` widens the sweep with extra selection heuristics
     (argmin keeps the cheapest solution); on the jax backend the extra
     candidates batch into the same device call, on cpu/cpp they solve
-    sequentially.
+    sequentially. ``n_restarts`` adds random tie-break restarts as extra
+    device lanes (jax backend only; ignored on cpu/cpp).
     """
     kernel = np.asarray(kernel, dtype=np.float64)
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
@@ -178,6 +180,7 @@ def solve(
             carry_size=carry_size,
             search_all_decompose_dc=search_all_decompose_dc,
             method0_candidates=method0_candidates,
+            n_restarts=n_restarts,
         )
 
     if method0_candidates:

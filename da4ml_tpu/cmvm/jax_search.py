@@ -403,15 +403,27 @@ class _Lane:
     qintervals: list[QInterval]
     latencies: list[float]
     method: str
+    #: optional input-slot permutation (random-restart lanes): the device
+    #: search sees rows in ``perm`` order, which changes greedy tie-break
+    #: trajectories the way a different host scan order would; the emitted
+    #: solution is mapped back to the original input order, so every restart
+    #: is exact and only cost/latency differ
+    perm: NDArray | None = None
     # filled by preparation
     csd: NDArray | None = None
     shift0: NDArray | None = None
     shift1: NDArray | None = None
 
+    def slot(self, i: int) -> int:
+        """Original input index held by device slot ``i``."""
+        return int(self.perm[i]) if self.perm is not None else i
+
 
 def _prepare_lane(lane: _Lane) -> None:
-    csd, shift0, shift1 = csd_decompose(lane.kernel)
-    for i, q in enumerate(lane.qintervals):
+    kernel = lane.kernel if lane.perm is None else lane.kernel[lane.perm]
+    csd, shift0, shift1 = csd_decompose(kernel)
+    for i in range(kernel.shape[0]):
+        q = lane.qintervals[lane.slot(i)]
         if q.min == 0.0 and q.max == 0.0:
             csd[i] = 0
     lane.csd, lane.shift0, lane.shift1 = csd, shift0, shift1
@@ -462,7 +474,11 @@ def solve_single_lanes(
     results: dict[int, CombLogic] = {}
     for k in dummy_idx:
         ln = lanes[k]
-        state = _host_state_from(ln, np.zeros((0, 4), np.int32), ln.csd, 0, adder_size, carry_size)
+        csd, shift0 = ln.csd, ln.shift0
+        if ln.perm is not None:  # defensive: renumber back to input order
+            csd, shift0 = np.empty_like(csd), np.empty_like(shift0)
+            csd[ln.perm], shift0[ln.perm] = ln.csd, ln.shift0
+        state = _host_state_from(ln, np.zeros((0, 4), np.int32), csd, 0, adder_size, carry_size, shift0=shift0)
         results[k] = to_solution(state, adder_size, carry_size)
 
     active = [k for k in range(len(lanes)) if k not in results]
@@ -501,14 +517,14 @@ def solve_single_lanes(
             Eb[a, :ni, :no, :nb] = ln.csd
             for i in range(ni):
                 sf = 2.0 ** float(ln.shift0[i])
-                q = ln.qintervals[i]
+                q = ln.qintervals[ln.slot(i)]
                 lo, hi, stp = q.min * sf, q.max * sf, q.step * sf
                 # all-zero rows carry the lsb sentinel shift (2**127) and/or an
                 # inf step; they are never selected — store benign metadata
                 if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, stp)):
                     lo, hi, stp = 0.0, 0.0, 1.0
                 qb[a, i] = (lo, hi, stp)
-                lb[a, i] = ln.latencies[i]
+                lb[a, i] = ln.latencies[ln.slot(i)]
             mcodes[a] = _METHOD_CODES[ln.method]
 
         sh = None
@@ -636,7 +652,7 @@ def solve_single_lanes(
                 dm = jnp.concatenate(outm_parts) if len(outm_parts) > 1 else outm_parts[0]
             pend = next_pend
 
-        emit_jobs: list[tuple[int, NDArray, NDArray]] = []  # (lane idx, E_lane, rec)
+        emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)
         for a, k in enumerate(active):
             ln = lanes[k]
             ni, no, nb = ln.csd.shape
@@ -651,23 +667,38 @@ def solve_single_lanes(
                 rec = rec.copy()
                 rec[:, 0] = np.where(rec[:, 0] >= ni, rec[:, 0] - shift_down, rec[:, 0])
                 rec[:, 1] = np.where(rec[:, 1] >= ni, rec[:, 1] - shift_down, rec[:, 1])
-            emit_jobs.append((k, E_lane, rec))
+            shift0 = ln.shift0
+            if ln.perm is not None:
+                # restart lane: device slot k held input perm[k]; renumber
+                # back to the original input order (operand roles — and thus
+                # values — are untouched; ids are pure references)
+                perm = np.asarray(ln.perm)
+                E_un = E_lane.copy()
+                E_un[perm] = E_lane[:ni]
+                E_lane = E_un
+                shift0 = np.empty_like(ln.shift0)
+                shift0[perm] = ln.shift0
+                rec = rec.copy()
+                for c in (0, 1):
+                    v = rec[:, c]
+                    rec[:, c] = np.where(v < ni, perm[np.minimum(v, ni - 1)], v)
+            emit_jobs.append((k, E_lane, rec, shift0))
 
         if _native_emit_available():
             from ..native.bindings import emit_batch
 
             lane_tuples = []
-            for k, E_lane, rec in emit_jobs:
+            for k, E_lane, rec, shift0 in emit_jobs:
                 ln = lanes[k]
                 qints = np.asarray([(q.min, q.max, q.step) for q in ln.qintervals], np.float64).reshape(-1, 3)
                 lats = np.asarray(ln.latencies, np.float64)
-                lane_tuples.append((ln.shift0, ln.shift1, qints, lats, E_lane, rec))
-            for (k, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
+                lane_tuples.append((shift0, ln.shift1, qints, lats, E_lane, rec))
+            for (k, _, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
                 results[k] = sol
         else:
-            for k, E_lane, rec in emit_jobs:
+            for k, E_lane, rec, shift0 in emit_jobs:
                 ln = lanes[k]
-                state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size)
+                state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size, shift0=shift0)
                 results[k] = to_solution(state, adder_size, carry_size)
 
     return [results[k] for k in range(len(lanes))]
@@ -683,21 +714,23 @@ def _native_emit_available() -> bool:
         return False
 
 
-def _host_state_from(ln: _Lane, rec, E_lane, n_add: int, adder_size: int, carry_size: int) -> DAState:
+def _host_state_from(ln: _Lane, rec, E_lane, n_add: int, adder_size: int, carry_size: int, shift0=None) -> DAState:
     """Rebuild the DAState from the device op records.
 
     Op metadata (qint/latency/cost) is re-derived here in float64 from the
     recorded (id0, id1, sub, shift) decisions — the device's f32 metadata is
     used for scoring only, so recorded intervals are never narrowed by f32
-    rounding.
+    rounding. ``shift0`` overrides the lane's (permuted-space) row shifts
+    with the caller's unpermuted ones for restart lanes.
     """
     from .cost import cost_add
     from ..ir.types import qint_add
 
+    shift0 = ln.shift0 if shift0 is None else shift0
     ni, no, nb = ln.csd.shape
     ops: list[Op] = []
     for i in range(ni):
-        sf = 2.0 ** float(ln.shift0[i])
+        sf = 2.0 ** float(shift0[i])
         q = ln.qintervals[i]
         ops.append(Op(i, -1, -1, 0, QInterval(q.min * sf, q.max * sf, q.step * sf), ln.latencies[i], 0.0))
     for t in range(n_add):
@@ -711,7 +744,7 @@ def _host_state_from(ln: _Lane, rec, E_lane, n_add: int, adder_size: int, carry_
     for p, o, b in zip(*np.nonzero(E_lane)):
         expr[p][o].append(encode_digit(int(b), int(E_lane[p, o, b])))
     return DAState(
-        shift0=ln.shift0,
+        shift0=shift0,
         shift1=ln.shift1,
         expr=expr,
         n_bits=nb,
@@ -755,6 +788,7 @@ def solve_jax(
     carry_size: int = -1,
     search_all_decompose_dc: bool = True,
     method0_candidates: list[str] | None = None,
+    n_restarts: int = 1,
 ) -> Pipeline:
     """Drop-in `solve` with the candidate search running on TPU."""
     return solve_jax_many(
@@ -769,6 +803,7 @@ def solve_jax(
         carry_size=carry_size,
         search_all_decompose_dc=search_all_decompose_dc,
         method0_candidates=method0_candidates,
+        n_restarts=n_restarts,
     )[0]
 
 
@@ -785,16 +820,23 @@ def solve_jax_many(
     search_all_decompose_dc: bool = True,
     mesh=None,
     method0_candidates: list[str] | None = None,
+    n_restarts: int = 1,
 ) -> list[Pipeline]:
     """Batched CMVM solve: all (matrix × dc candidate) stage-0 searches run as
     one device batch, then all stage-1 searches. The argmin over dc candidates
     per matrix happens on host. ``mesh`` shards the lane axis over devices.
 
-    ``method0_candidates`` widens the sweep with extra selection heuristics —
-    each (matrix, dc) candidate is searched once per method and the global
-    argmin keeps the cheapest. The candidate axis is what the device batches
-    over, so extra methods trade device throughput for solution quality
-    (something the serial reference sweep cannot afford)."""
+    Two quality axes widen the sweep with extra device lanes — something the
+    serial reference sweep cannot afford:
+
+    - ``method0_candidates``: each (matrix, dc) candidate is searched once
+      per selection heuristic; the global argmin keeps the cheapest.
+    - ``n_restarts``: each stage-0 search additionally runs under r-1 random
+      input-slot permutations. Permuting slots changes greedy tie-break
+      trajectories exactly the way a different scan order changes the
+      host's; every restart stays exact (the emitted solution is renumbered
+      back to the original input order), so the argmin can only improve
+      cost."""
     from .decompose import kernel_decompose
 
     kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
@@ -812,7 +854,8 @@ def solve_jax_many(
     # budget the host shrinks dc and retries inside each solve (api.py _solve
     # / api.cc:84-139); here every rung of that shrink ladder is just another
     # device lane, so constrained solves stay on TPU end to end.
-    jobs: list[tuple[int, int, int]] = []  # (matrix idx, dc, method-pair idx)
+    n_restarts = max(1, int(n_restarts))
+    jobs: list[tuple[int, int, int, int]] = []  # (matrix idx, dc, method-pair idx, restart)
     for mi, kern in enumerate(kernels):
         n_in = kern.shape[0]
         log2_n = int(ceil(log2(max(n_in, 1))))
@@ -824,7 +867,7 @@ def solve_jax_many(
             # dc ladder: the host's shrink-and-retry, flattened into lanes
             # (descending order = host preference: first fitting dc wins)
             dcs = list(range(dc, -2, -1)) if hard_dc >= 0 else [dc]
-        jobs.extend((mi, dc, mp) for dc in dcs for mp in range(len(mpairs)))
+        jobs.extend((mi, dc, mp, r) for dc in dcs for mp in range(len(mpairs)) for r in range(n_restarts))
 
     # stage-0 lanes (kernel decomposition batched through the native library
     # when built — OpenMP over (matrix, dc) lanes)
@@ -835,24 +878,31 @@ def solve_jax_many(
     else:
         _decompose = lambda ps: [kernel_decompose(kernels[mi], dc) for mi, dc in ps]  # noqa: E731
     uniq_md: dict[tuple[int, int], int] = {}
-    for mi, dc, _ in jobs:
+    for mi, dc, _, _ in jobs:
         uniq_md.setdefault((mi, dc), len(uniq_md))
     splits_u = _decompose(list(uniq_md))
-    splits = [splits_u[uniq_md[(mi, dc)]] for mi, dc, _ in jobs]
+    splits = [splits_u[uniq_md[(mi, dc)]] for mi, dc, _, _ in jobs]
 
     lanes0: list[_Lane] = []
     mats1: list[NDArray] = []
-    for (mi, dc, mp), (mat0, mat1) in zip(jobs, splits):
+    for (mi, dc, mp, r), (mat0, mat1) in zip(jobs, splits):
         kern = kernels[mi]
         qints = qintervals_list[mi] or [QInterval(-128.0, 127.0, 1.0)] * kern.shape[0]
         lats = latencies_list[mi] or [0.0] * kern.shape[0]
-        lanes0.append(_Lane(mat0, list(qints), list(lats), _lane_method(mpairs[mp][0], dc, _hard_eff)))
+        method_0 = _lane_method(mpairs[mp][0], dc, _hard_eff)
+        perm = None
+        # restarts perturb greedy tie-breaks; 'dummy' runs no greedy loop,
+        # so a permuted dummy lane would be pure waste
+        if r > 0 and method_0 != 'dummy':  # deterministic per-(matrix, dc, restart) shuffle
+            prng = np.random.default_rng(0x5EED ^ (mi * 1000003 + (dc + 2) * 1009 + r))
+            perm = prng.permutation(mat0.shape[0])
+        lanes0.append(_Lane(mat0, list(qints), list(lats), method_0, perm=perm))
         mats1.append(mat1)
     sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
     lanes1: list[_Lane] = []
-    for (mi, dc, mp), sol0, mat1 in zip(jobs, sols0, mats1):
+    for (mi, dc, mp, r), sol0, mat1 in zip(jobs, sols0, mats1):
         qints1, lats1 = sol0.out_qint, sol0.out_latency
         lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(mpairs[mp][1], dc, _hard_eff)))
     sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
@@ -873,11 +923,11 @@ def solve_jax_many(
     # hard_dc >= 0 solve never leaves the device path.
     best_cost = [inf] * n_mat
     best_sols: list[tuple | None] = [None] * n_mat
-    first_fit: dict[tuple[int, int], tuple] = {}  # (matrix, method pair) -> pair
+    first_fit: dict[tuple[int, int, int], tuple] = {}  # (matrix, method pair, restart) -> pair
     terminal: list[tuple | None] = [None] * n_mat
-    for (mi, dc, mp), sol0, sol1 in zip(jobs, sols0, sols1):
+    for (mi, dc, mp, r), sol0, sol1 in zip(jobs, sols0, sols1):
         pair = (sol0, sol1)
-        if dc == -1 and terminal[mi] is None:
+        if dc == -1 and r == 0 and terminal[mi] is None:
             terminal[mi] = pair
         max_lat = max((lt for s in pair for lt in s.out_latency), default=0.0)
         if max_lat > allowed[mi]:
@@ -887,10 +937,10 @@ def solve_jax_many(
             if c < best_cost[mi]:
                 best_cost[mi] = c
                 best_sols[mi] = pair
-        elif (mi, mp) not in first_fit:
-            first_fit[(mi, mp)] = pair
+        elif (mi, mp, r) not in first_fit:
+            first_fit[(mi, mp, r)] = pair
     if not search_all_decompose_dc:
-        for (mi, _), pair in first_fit.items():
+        for (mi, _, _), pair in first_fit.items():
             c = float(pair[0].cost) + float(pair[1].cost)
             if c < best_cost[mi]:
                 best_cost[mi] = c

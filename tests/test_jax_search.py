@@ -124,3 +124,24 @@ def test_method_candidates_via_solver_options(rng):
         data = rng.integers(-8, 8, (16, 18)).astype(np.float64)
         out = comb.predict(data, backend='numpy')
         np.testing.assert_array_equal(out.reshape(16, 3, -1), data.reshape(16, 3, 6) @ w)
+
+
+def test_restart_lanes_exact_and_no_worse(rng):
+    """Random-restart lanes: every restart is renumbered back exactly, and
+    the argmin over the widened sweep never worsens the cost."""
+    kernels = [random_kernel(rng, 8, 5) for _ in range(4)]
+    base = solve_jax_many(kernels, method0='wmc')
+    wide = solve_jax_many(kernels, method0='wmc', n_restarts=3)
+    for k, b, w in zip(kernels, base, wide):
+        np.testing.assert_array_equal(np.asarray(w.kernel, np.float64), k)
+        assert w.cost <= b.cost, (w.cost, b.cost)
+    # restart solutions replay bit-exactly through the interpreter
+    data = rng.integers(-16, 16, (64, 8)).astype(np.float64)
+    for k, w in zip(kernels, wide):
+        np.testing.assert_array_equal(w.predict(data), data @ k)
+
+
+def test_restart_lanes_under_hard_dc(rng):
+    kernel = random_kernel(rng, 6, 4)
+    sol = solve_jax_many([kernel], hard_dc=1, n_restarts=2)[0]
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
