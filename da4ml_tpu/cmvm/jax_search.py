@@ -867,7 +867,14 @@ def solve_jax_many(
             # dc ladder: the host's shrink-and-retry, flattened into lanes
             # (descending order = host preference: first fitting dc wins)
             dcs = list(range(dc, -2, -1)) if hard_dc >= 0 else [dc]
-        jobs.extend((mi, dc, mp, r) for dc in dcs for mp in range(len(mpairs)) for r in range(n_restarts))
+        jobs.extend(
+            (mi, dc, mp, r)
+            for dc in dcs
+            for mp in range(len(mpairs))
+            # restarts perturb greedy tie-breaks; a 'dummy' stage-0 lane has
+            # no greedy loop, so its restarts would be byte-identical copies
+            for r in range(n_restarts if _lane_method(mpairs[mp][0], dc, _hard_eff) != 'dummy' else 1)
+        )
 
     # stage-0 lanes (kernel decomposition batched through the native library
     # when built — OpenMP over (matrix, dc) lanes)
