@@ -165,6 +165,14 @@ def solve(
         raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
     qintervals, latencies = _default_qint_lat(kernel, qintervals, latencies)
 
+    if backend == 'auto':  # fastest host path (the CLI default)
+        try:
+            from ..native import has_solver
+
+            backend = 'cpp' if has_solver() else 'cpu'
+        except Exception:
+            backend = 'cpu'
+
     if backend == 'jax':
         from .jax_search import solve_jax
 
