@@ -54,8 +54,35 @@ _METHOD_CODES = {'mc': 0, 'mc-dc': 1, 'mc-pdc': 2, 'wmc': 3, 'wmc-dc': 4, 'wmc-p
 
 #: observability counters; 'over_budget_accepts' counts matrices where no
 #: candidate met the hard_dc latency budget and the forced dc=-1 / wmc-dc
-#: terminal was accepted (the host solver's terminal break, api.py _solve)
-search_stats = {'over_budget_accepts': 0}
+#: terminal was accepted (the host solver's terminal break, api.py _solve);
+#: 'pmax_host_fallbacks' counts lanes/matrices rerouted to the host solver
+#: because their slot demand exceeded DA4ML_JAX_PMAX
+search_stats = {'over_budget_accepts': 0, 'pmax_host_fallbacks': 0}
+
+
+def _next_pow2(x: int) -> int:
+    """Smallest power of two >= max(x, 1)."""
+    return 1 << (max(x, 1) - 1).bit_length()
+
+
+def _pmax() -> int:
+    """Slot-count ceiling for the device search (env DA4ML_JAX_PMAX).
+
+    Beyond this the [S, P, P] pair-count state stops being HBM/compile
+    friendly; work estimated to exceed it is solved on the host instead so a
+    single huge matrix can never wedge the device (or its remote compiler).
+    Floored to a power of two so the stage ladder (which only visits pow2 P,
+    clamped to this ceiling for its last rung) agrees with the pre-route
+    estimate. Values <= 0 mean "no ceiling" (the repo-wide -1 convention).
+    """
+    try:
+        raw = int(os.environ.get('DA4ML_JAX_PMAX', '') or 4096)
+    except ValueError:
+        raw = 4096
+    if raw <= 0:
+        return 1 << 30
+    p2 = _next_pow2(raw)
+    return p2 if p2 == raw else p2 // 2
 
 
 # --------------------------------------------------------------------------
@@ -119,7 +146,6 @@ class _KernelSpec:
     P: int  # total slots (inputs + max CSE intermediates)
     O: int  # outputs
     B: int  # CSD bit planes
-    n_iters: int  # max CSE iterations this call may add
     adder_size: int
     carry_size: int
     select: str = 'xla'  # 'xla' | 'pallas' (DA4ML_JAX_SELECT)
@@ -139,7 +165,8 @@ def _build_cse_fn(spec: _KernelSpec):
     on small candidate tensors (cost is O(P^2) per iteration) and only the
     stragglers pay for large ones.
     """
-    P, O, B, n_iters = spec.P, spec.O, spec.B, spec.n_iters
+    P, O, B = spec.P, spec.O, spec.B
+    n_iters = P  # op-record capacity; a call adds at most P - cur0 <= P ops
     adder_size, carry_size = spec.adder_size, spec.carry_size
 
     def shifted_stack(Ef):
@@ -302,24 +329,26 @@ def _build_cse_fn(spec: _KernelSpec):
         target = jnp.where(sub == 1, -1, 1)
         sign_ok = (row_i != 0) & (shifted_j != 0) & (row_i * shifted_j == target)
 
-        def chain_scan(_):
-            # i == j: digits can chain (b, b+s, b+2s); greedily match ascending
-            def body(b, carry):
-                avail, matched = carry
-                ok = sign_ok[:, b] & avail[:, b] & jnp.where(b + s < B, avail[:, jnp.minimum(b + s, B - 1)], False)
-                avail = avail.at[:, b].set(avail[:, b] & ~ok)
-                avail = avail.at[:, jnp.minimum(b + s, B - 1)].set(
-                    jnp.where(b + s < B, avail[:, jnp.minimum(b + s, B - 1)] & ~ok, avail[:, jnp.minimum(b + s, B - 1)])
-                )
-                matched = matched.at[:, b].set(ok)
-                return avail, matched
+        # i == j: digits can chain (b, b+s, b+2s); greedily match ascending.
+        # B is a small static constant, so the ascending-bit scan is unrolled
+        # in Python rather than written as a fori_loop: nested control flow
+        # (loop-in-loop) inside the vmapped while body is disproportionately
+        # expensive for the TPU backend to compile, and under vmap the
+        # branch-free form costs nothing extra (a batched cond lowers to
+        # both-sides + select anyway).
+        avail = row_i != 0
+        matched = jnp.zeros((O, B), dtype=bool)
+        in_range = b_idx + s < B  # [B] traced per-bit guard
+        for b in range(B):
+            nxt = jnp.minimum(b + s, B - 1)
+            partner = jnp.where(in_range[b], jnp.take(avail, nxt, axis=1), False)
+            ok = sign_ok[:, b] & avail[:, b] & partner
+            avail = avail.at[:, b].set(avail[:, b] & ~ok)
+            cleared = jnp.take(avail, nxt, axis=1) & ~ok
+            avail = avail.at[:, nxt].set(jnp.where(in_range[b], cleared, jnp.take(avail, nxt, axis=1)))
+            matched = matched.at[:, b].set(ok)
 
-            avail0 = E[i] != 0
-            matched0 = jnp.zeros((O, B), dtype=bool)
-            _, matched = jax.lax.fori_loop(0, B, body, (avail0, matched0))
-            return matched
-
-        M = jax.lax.cond(i == j, chain_scan, lambda _: sign_ok, None)
+        M = jnp.where(i == j, matched, sign_ok)
 
         # clear matched digits: row i at b, row j at b+s
         M_up = jnp.zeros((O, B), dtype=bool)
@@ -436,7 +465,7 @@ def _lane_initial_digits(lane: _Lane) -> int:
 def _bucket_lanes(n: int, mesh) -> int:
     """Pad the lane axis to a power-of-two (mesh-divisible) bucket so repeated
     calls with nearby batch sizes reuse the compiled program."""
-    bucket = 1 << (max(n, 1) - 1).bit_length()
+    bucket = _next_pow2(n)
     if mesh is not None:
         nd = mesh.devices.size
         bucket = max(bucket, nd)
@@ -492,7 +521,12 @@ def solve_single_lanes(
             return -(-x // q) * q
 
         n_in_max = _ceil_to(max(lanes[k].csd.shape[0] for k in active), 8)
-        O = _ceil_to(max(lanes[k].csd.shape[1] for k in active), 8)
+        # O and the P ladder (below) round to powers of two: TPU compiles are
+        # expensive (remote, minutes at large shapes), so the class lattice is
+        # kept coarse — one compile per (pow2 P, pow2 O, even B) serves every
+        # stage and every config that fits it, and the persistent XLA cache
+        # makes the classes reusable across processes
+        O = max(8, _next_pow2(max(lanes[k].csd.shape[1] for k in active)))
         B = _ceil_to(max(lanes[k].csd.shape[2] for k in active), 2)
         digits_max = max(_lane_initial_digits(lanes[k]) for k in active)
         if step is None:
@@ -547,12 +581,38 @@ def solve_single_lanes(
             hbm_budget = int(float(os.environ.get('DA4ML_JAX_HBM_BUDGET', '') or (4 << 30)))
         except ValueError:
             hbm_budget = 4 << 30
+        pmax = _pmax()
         while pend:
-            P = int(st_cur[pend].max()) + step
-            n_iters = P - n_in_max
+            P = _next_pow2(int(st_cur[pend].max()) + step)
+            if P > pmax:
+                if int(st_cur[pend].max()) < pmax:
+                    P = pmax  # last, clamped rung (pmax is itself a pow2)
+                else:
+                    # safety net (normally pre-empted by the estimate in
+                    # solve_jax_many): finish the true stragglers on the host
+                    # from scratch rather than compiling an oversized device
+                    # program. Restart lanes of the same instance collapse to
+                    # one host solve — the host path ignores the permutation,
+                    # so the duplicates would be byte-identical.
+                    from .core import solve_single as _host_solve_single
+
+                    memo: dict[tuple, CombLogic] = {}
+                    for a in pend:
+                        k = active[a]
+                        ln = lanes[k]
+                        search_stats['pmax_host_fallbacks'] += 1
+                        key = (ln.kernel.tobytes(), ln.kernel.shape, ln.method)
+                        if key not in memo:
+                            memo[key] = _host_solve_single(
+                                ln.kernel, ln.method, ln.qintervals, ln.latencies, adder_size, carry_size
+                            )
+                        results[k] = memo[key]
+                        st_E.pop(a, None)
+                    pend = []
+                    break
             n_pend = len(pend)
             select = os.environ.get('DA4ML_JAX_SELECT', 'xla')
-            fn = _build_cse_fn(_KernelSpec(P, O, B, n_iters, adder_size, carry_size, select))
+            fn = _build_cse_fn(_KernelSpec(P, O, B, adder_size, carry_size, select))
 
             # HBM guard: the carried pair-count tensors dominate the loop
             # state (2 x [S, P, P] per lane, plus f32 scoring transients).
@@ -613,7 +673,7 @@ def solve_single_lanes(
                 cur_f = np.asarray(jax.device_get(cc))[:n_chunk]
                 if debug:
                     print(
-                        f'[jax_search] round P={P} O={O} B={B} bucket={bucket} n_iters={n_iters} '
+                        f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
                         f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_time.perf_counter() - _t0:.2f}s',
                         flush=True,
                     )
@@ -654,6 +714,8 @@ def solve_single_lanes(
 
         emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)
         for a, k in enumerate(active):
+            if k in results:  # solved on host by the PMAX safety net
+                continue
             ln = lanes[k]
             ni, no, nb = ln.csd.shape
             n_add = int(st_cur[a]) - n_in_max
@@ -844,6 +906,34 @@ def solve_jax_many(
     qintervals_list = qintervals_list or [None] * n_mat
     latencies_list = latencies_list or [None] * n_mat
 
+    # Pre-route matrices whose undecomposed (dc=-1) lane would outgrow the
+    # device slot ceiling: each CSE merge eliminates >= 2 digit pairs, so the
+    # slot demand is bounded by n_in + digits/2. Such matrices go to the host
+    # solver whole (its sorted-map state is size-proportional), keeping the
+    # device path for the shapes it is actually good at.
+    routed: dict[int, Pipeline] = {}
+    pmax = _pmax()
+    for mi, kern in enumerate(kernels):
+        digits = int((csd_decompose(kern)[0] != 0).sum())
+        if kern.shape[0] + digits // 2 > pmax:
+            search_stats['pmax_host_fallbacks'] += 1
+            routed[mi] = _host_api.solve(
+                kern,
+                method0=method0,
+                method1=method1,
+                hard_dc=hard_dc,
+                decompose_dc=decompose_dc,
+                qintervals=qintervals_list[mi],
+                latencies=latencies_list[mi],
+                adder_size=adder_size,
+                carry_size=carry_size,
+                search_all_decompose_dc=search_all_decompose_dc,
+                # sequential dc sweep: opting into the fork-based pool here
+                # would fork a process whose XLA runtime is already live
+                backend='auto',
+                method0_candidates=method0_candidates,
+            )
+
     # In sweep mode the host driver resolves methods against the effective
     # budget 10^9 when hard_dc < 0 (api.py solve -> _solve), which turns
     # 'auto' into method0 itself rather than its -dc variant.
@@ -857,6 +947,8 @@ def solve_jax_many(
     n_restarts = max(1, int(n_restarts))
     jobs: list[tuple[int, int, int, int]] = []  # (matrix idx, dc, method-pair idx, restart)
     for mi, kern in enumerate(kernels):
+        if mi in routed:
+            continue
         n_in = kern.shape[0]
         log2_n = int(ceil(log2(max(n_in, 1))))
         if search_all_decompose_dc:
@@ -955,6 +1047,9 @@ def solve_jax_many(
 
     results: list[Pipeline] = []
     for mi in range(n_mat):
+        if mi in routed:
+            results.append(routed[mi])
+            continue
         pair = best_sols[mi] or terminal[mi]
         if pair is None:  # hard_dc < 0 always selects; this cannot happen
             raise RuntimeError(f'no candidate solution for matrix {mi}')

@@ -82,7 +82,7 @@ def test_incremental_counts_match_numpy_oracle(seed):
     E0[0, :ni] = csd
     q0 = np.zeros((1, P, 3), np.float32)
     q0[:, :, 0], q0[:, :, 1], q0[:, :, 2] = -128.0, 127.0, 1.0
-    fn = _build_cse_fn(_KernelSpec(P, no, nb, K, -1, -1, 'xla'))
+    fn = _build_cse_fn(_KernelSpec(P, no, nb, -1, -1, 'xla'))
     E_dev, _, _, rec, cur = fn(
         jnp.asarray(E0),
         jnp.asarray(q0),

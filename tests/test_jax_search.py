@@ -145,3 +145,31 @@ def test_restart_lanes_under_hard_dc(rng):
     kernel = random_kernel(rng, 6, 4)
     sol = solve_jax_many([kernel], hard_dc=1, n_restarts=2)[0]
     np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+def test_pmax_reroutes_big_matrices_to_host(rng, monkeypatch):
+    """Matrices whose slot demand exceeds DA4ML_JAX_PMAX solve on the host
+    (exactly), while small ones in the same batch stay on device."""
+    from da4ml_tpu.cmvm import jax_search
+
+    monkeypatch.setenv('DA4ML_JAX_PMAX', '64')
+    big = random_kernel(rng, 8, 8)  # ~8 + digits/2 >> 64
+    small = random_kernel(rng, 4, 2)
+    before = jax_search.search_stats['pmax_host_fallbacks']
+    sols = solve_jax_many([big, small])
+    assert jax_search.search_stats['pmax_host_fallbacks'] > before
+    for k, s in zip((big, small), sols):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+
+
+def test_pmax_inladder_safety_net(rng, monkeypatch):
+    """solve_single_lanes finishes stragglers on the host when the stage
+    ladder would exceed PMAX mid-flight."""
+    from da4ml_tpu.cmvm.jax_search import _Lane, solve_single_lanes
+
+    monkeypatch.setenv('DA4ML_JAX_PMAX', '16')
+    kernel = random_kernel(rng, 8, 4)
+    qints = [QInterval(-128.0, 127.0, 1.0)] * 8
+    lane = _Lane(kernel, qints, [0.0] * 8, 'wmc')
+    (sol,) = solve_single_lanes([lane], -1, -1)
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
