@@ -7,16 +7,18 @@ scalar. Here the whole search is re-expressed as fixed-shape tensor programs:
 - A CSD expression set is a dense int8 tensor ``E[slot, out, bit]`` with
   digits in {-1, 0, +1}; slot = input or CSE intermediate.
 - Candidate pair counts ``C[sub, s, i, j]`` (matches of ``a ± (b << s)``)
-  are computed once per stage via shifted correlations (einsums on the MXU)
-  and then carried in the loop state: each greedy iteration scores the
-  count tensor (mc / wmc / dc variants, vectorized over the slot metadata),
-  picks the argmax, substitutes densely, and *incrementally recounts only
-  the pairs touching the three modified rows* ``{i, j, cur}`` — the same
-  dirty-row strategy as the reference's ``update_stats``
-  (state_opr.cc:285-345), expressed as tiny ``[3,O,S,B] x [P,O,B]``
-  einsums + scatters instead of sorted-map surgery. Per-iteration work
-  drops from O(S·P²·O·B) to O(S·P·O·B) + one bandwidth pass for the
-  argmax, which is what makes wide-output matrices tractable on device.
+  come from shifted correlations (einsums on the MXU); a greedy step
+  modifies only rows ``{i, j, cur}``, so each iteration recounts just the
+  pairs touching them — the reference's dirty-row ``update_stats`` strategy
+  (state_opr.cc:285-345) as tiny ``[3,O,S,B] x [P,O,B]`` einsums.
+- Selection (default ``top4``) never materializes the quadratic counts in
+  the loop state at all: it carries an exact per-row top-k (score, col)
+  cache ``[2, S, P, 8]``, rebuilt for the three dirty rows and merged for
+  the rest each iteration — O(S·P) per iteration, O(S·P) carried state.
+  The ``xla`` mode instead carries the full counts and rescans them with a
+  fused masked argmax every iteration (decision-identical with the host's
+  scan order up to tie-breaking; ``top4`` may deviate in greedy order —
+  not in exactness — when cache displacement understates a row max).
 - ``lax.while_loop`` drives the greedy iterations. Lanes = (matrix, dc
   candidate, method) triples, batched with ``vmap`` and shardable over a
   device mesh — each TPU core scores thousands of candidate substitutions
@@ -51,6 +53,11 @@ from .state import DAState, Op, encode_digit
 from . import api as _host_api
 
 _METHOD_CODES = {'mc': 0, 'mc-dc': 1, 'mc-pdc': 2, 'wmc': 3, 'wmc-dc': 4, 'wmc-pdc': 5, 'dummy': 6}
+
+#: slots per row of the top4 select's score cache (see _build_cse_fn); 8
+#: entries make understated row maxima — the cache's only approximation —
+#: rare while keeping the carried state O(S*P)
+_TOPK = 8
 
 #: observability counters; 'over_budget_accepts' counts matrices where no
 #: candidate met the hard_dc latency budget and the forced dc=-1 / wmc-dc
@@ -148,7 +155,7 @@ class _KernelSpec:
     B: int  # CSD bit planes
     adder_size: int
     carry_size: int
-    select: str = 'xla'  # 'xla' | 'pallas' (DA4ML_JAX_SELECT)
+    select: str = 'top4'  # 'top4' | 'xla' | 'pallas' (DA4ML_JAX_SELECT)
 
 
 @lru_cache(maxsize=64)
@@ -217,14 +224,15 @@ def _build_cse_fn(spec: _KernelSpec):
 
     s_rng = jnp.arange(B)
 
-    def update_counts(Cs, Cd, E, R):
-        """Recount pairs touching rows ``R = [i, j, cur]`` from the updated E.
+    def row_col_counts(Ef, R):
+        """Exact pair counts touching rows ``R``, from the digit tensor.
 
-        All other pairs are unchanged (their rows were not modified), so two
-        rank-3 einsums + row/column scatters refresh the exact counts.
+        rowC[k, s, r, p] = count of pairs (R[r] first operand, p second);
+        colC[k, s, p, r] = count of pairs (p first, R[r] second); k = 0 add,
+        1 sub. Two rank-3 einsums per orientation — the same dirty-row
+        strategy as the reference's ``update_stats`` (state_opr.cc:285-345).
         """
-        Ef = E.astype(jnp.bfloat16)
-        Er = Ef[R]  # [3, O, B]
+        Er = Ef[R]  # [|R|, O, B]
         # up[r,o,s,b] = Er[r,o,b+s]; down[r,o,s,b] = Er[r,o,b-s]
         i_up = s_rng[:, None] + b_idx[None, :]  # [S, B]
         i_dn = b_idx[None, :] - s_rng[:, None]
@@ -236,8 +244,19 @@ def _build_cse_fn(spec: _KernelSpec):
         # C[s, p, r] = sum_{o,b} E[p,o,b] * Er[r,o,b+s]   (row r as second elem)
         A2 = jnp.einsum('pob,rosb->spr', Ef, up, preferred_element_type=jnp.float32)
         D2 = jnp.einsum('pob,rosb->spr', jnp.abs(Ef), jnp.abs(up), preferred_element_type=jnp.float32)
-        s1, d1 = ((D1 + A1) * 0.5).astype(cdtype), ((D1 - A1) * 0.5).astype(cdtype)
-        s2, d2 = ((D2 + A2) * 0.5).astype(cdtype), ((D2 - A2) * 0.5).astype(cdtype)
+        rowC = jnp.stack([(D1 + A1) * 0.5, (D1 - A1) * 0.5])  # [2, S, |R|, P]
+        colC = jnp.stack([(D2 + A2) * 0.5, (D2 - A2) * 0.5])  # [2, S, P, |R|]
+        return rowC, colC
+
+    def update_counts(Cs, Cd, E, R):
+        """Recount pairs touching rows ``R = [i, j, cur]`` from the updated E.
+
+        All other pairs are unchanged (their rows were not modified), so the
+        dirty-row einsums + row/column scatters refresh the exact counts.
+        """
+        rowC, colC = row_col_counts(E.astype(jnp.bfloat16), R)
+        s1, d1 = rowC[0].astype(cdtype), rowC[1].astype(cdtype)
+        s2, d2 = colC[0].astype(cdtype), colC[1].astype(cdtype)
         # rows first, then columns: the column write also refreshes the
         # [R, R] block from the fully updated E (duplicate indices in R write
         # identical values, so scatter order is immaterial)
@@ -259,34 +278,11 @@ def _build_cse_fn(spec: _KernelSpec):
 
         Ties resolve by first flattened index — deterministic, though not the
         host's scan order (the contract is exactness at comparable cost).
+        ``nov``/``dlat`` are symmetric [P, P]: they cover both (i, j) and
+        (j, i) pairs.
         """
         C = jnp.stack([Cs, Cd]).astype(jnp.float32)  # [2, S, P, P]
-        count = C
-        valid = C >= 2.0
-        valid &= _s0_mask()
-
-        n_ov = nov  # symmetric [P, P]: covers both (i, j) and (j, i) pairs
-
-        base_mc = count
-        base_wmc = count * n_ov[None, None]
-        pen_dc = dlat[None, None]
-        score = jnp.where(
-            method == 0,
-            base_mc,
-            jnp.where(
-                method == 1,
-                base_mc - 1e9 * pen_dc,
-                jnp.where(
-                    method == 2,
-                    base_mc - 1e9 * pen_dc,
-                    jnp.where(method == 3, base_wmc, base_wmc - 256.0 * pen_dc),
-                ),
-            ),
-        )
-        # variants whose host scan starts at max_score = 0 require score >= 0
-        absolute = (method == 1) | (method == 3) | (method == 4)
-        valid &= jnp.where(absolute, score >= 0, True)
-        score = jnp.where(valid, score, -jnp.inf)
+        score = _score(C, nov[None, None], dlat[None, None], method, _s0_mask())
         flat = jnp.argmax(score)
         any_valid = jnp.max(score) != -jnp.inf
         return any_valid, *_decode_flat(flat, P, B)
@@ -314,6 +310,30 @@ def _build_cse_fn(spec: _KernelSpec):
         return any_valid, *_decode_flat(flat, P, B)
 
     b_idx = jnp.arange(B)
+
+    def record_decision(qmeta, lat, op_rec, sub, s, i, j, cur, cur0):
+        """Book-keep one accepted pair: new slot metadata + the op record.
+
+        Shared by both select modes so the emitted records can never diverge
+        for identical decisions. qint_add(q0, q1, shift, sub0=False,
+        sub1=sub) — f32 for scoring only; the host re-derives op metadata in
+        f64 from the records.
+        """
+        id0 = jnp.minimum(i, j)
+        id1 = jnp.maximum(i, j)
+        shift = jnp.where(i < j, s, -s)
+        sp = jnp.exp2(shift.astype(jnp.float32))
+        lo0, hi0, st0 = qmeta[id0, 0], qmeta[id0, 1], qmeta[id0, 2]
+        lo1, hi1, st1 = qmeta[id1, 0], qmeta[id1, 1], qmeta[id1, 2]
+        is_sub = sub == 1
+        dlat, _ = _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, sp, is_sub, adder_size, carry_size)
+        nlat = jnp.maximum(lat[id0], lat[id1]) + dlat
+        min1 = jnp.where(is_sub, -hi1, lo1) * sp
+        max1 = jnp.where(is_sub, -lo1, hi1) * sp
+        qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
+        lat = lat.at[cur].set(nlat)
+        op_rec = op_rec.at[cur - cur0].set(jnp.stack([id0, id1, sub, shift]))
+        return qmeta, lat, op_rec
 
     def substitute(E, sub, s, i, j):
         """Dense substitution of pair (row i bit b) + ±(row j bit b+s).
@@ -366,6 +386,175 @@ def _build_cse_fn(spec: _KernelSpec):
         new_row = jnp.where(i < j, anchor_lo, anchor_hi).astype(jnp.int8)
         return E, new_row, M.sum()
 
+    # ---- top4 select: an O(S*P) per-iteration score cache -----------------
+    #
+    # Instead of carrying the full [2, S, P, P] pair-count tensors and
+    # rescanning them every iteration (O(S*P^2) bandwidth — the scan path
+    # above), carry a per-(sub, s, row) cache of the _TOPK best (score, col)
+    # candidates. A greedy step changes scores only for pairs touching rows
+    # {i, j, cur}: those three rows are re-derived exactly from the dirty-row
+    # einsums, and every other row merges the three refreshed columns into
+    # its cache. Cached entries are always *valid* current scores (stale cols
+    # are invalidated before the merge), so any selected pair is sound and
+    # the emitted solution stays exact. The cache max can, however,
+    # *understate* a row's true max once more than _TOPK better-scoring
+    # candidates displaced an entry that later re-surfaces — so the greedy
+    # *order* may deviate from the full-rescan reference (select='xla' keeps
+    # decision identity; tests pin top4 cost to within a few % of it).
+
+    def _score(cnt, nov, dlat, method, pair_ok):
+        """Scoring identical to select_pair, validity folded to -inf."""
+        base_mc = cnt
+        base_wmc = cnt * nov
+        score = jnp.where(
+            method == 0,
+            base_mc,
+            jnp.where(
+                method == 1,
+                base_mc - 1e9 * dlat,
+                jnp.where(
+                    method == 2,
+                    base_mc - 1e9 * dlat,
+                    jnp.where(method == 3, base_wmc, base_wmc - 256.0 * dlat),
+                ),
+            ),
+        )
+        valid = (cnt >= 2.0) & pair_ok
+        absolute = (method == 1) | (method == 3) | (method == 4)
+        valid &= jnp.where(absolute, score >= 0, True)
+        return jnp.where(valid, score, -jnp.inf)
+
+    def _meta_rows(qmeta, lat, R):
+        """(n_overlap, |dlat|) of rows R against all slots: [|R|, P] each.
+
+        Symmetric in its two arguments, so the same slices serve pairs with
+        R as first or as second operand.
+        """
+        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
+        nov = _overlap_vec(lo[R][:, None], hi[R][:, None], st[R][:, None], lo[None, :], hi[None, :], st[None, :])
+        dlt = jnp.abs(lat[R][:, None] - lat[None, :])
+        return nov, dlt
+
+    def _extract_topk(vals, cols, k=_TOPK):
+        """Exact (score desc, col asc) top-k along the last axis.
+
+        ``cols`` must hold distinct ids per row (padding entries use -1 with
+        -inf score). The (max, then min-col-among-max) double pass realizes
+        the same tie order as a flattened first-index argmax.
+        """
+        big = jnp.iinfo(jnp.int32).max
+        out_v, out_c = [], []
+        v = vals
+        for _ in range(k):
+            m = jnp.max(v, axis=-1, keepdims=True)
+            fin = m != -jnp.inf
+            cand = jnp.where((v == m) & fin, cols, big)
+            c = jnp.min(cand, axis=-1, keepdims=True)
+            out_v.append(m[..., 0])
+            out_c.append(jnp.where(fin[..., 0], c[..., 0], -1))
+            v = jnp.where((cols == c) & (v == m), -jnp.inf, v)
+        return jnp.stack(out_v, -1), jnp.stack(out_c, -1)
+
+    # row-block for the stage-entry cache build; must divide P (the driver
+    # always passes pow2 P, but direct _build_cse_fn users may not)
+    _BLK = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1) if P % b == 0)
+
+    def init_cache(E, qmeta, lat, method):
+        """Build the top-k cache with one blocked pass over all pairs.
+
+        The full [2, S, P, P] score tensor is never materialized: a
+        lax.scan walks row blocks, scoring [2, S, BLK, P] at a time.
+        """
+        Ef = E.astype(jnp.bfloat16)
+        sh = shifted_stack(Ef)
+        sha = jnp.abs(sh)
+        iot = jnp.arange(P, dtype=jnp.int32)
+        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
+
+        def blk(carry, r0):
+            Erb = jax.lax.dynamic_slice(Ef, (r0, 0, 0), (_BLK, O, B))
+            A = jnp.einsum('iob,josb->sij', Erb, sh, preferred_element_type=jnp.float32)
+            D = jnp.einsum('iob,josb->sij', jnp.abs(Erb), sha, preferred_element_type=jnp.float32)
+            cnt = jnp.stack([(D + A) * 0.5, (D - A) * 0.5])  # [2, S, BLK, P]
+            rows = r0 + jnp.arange(_BLK, dtype=jnp.int32)
+            lob = jax.lax.dynamic_slice(lo, (r0,), (_BLK,))
+            hib = jax.lax.dynamic_slice(hi, (r0,), (_BLK,))
+            stb = jax.lax.dynamic_slice(st, (r0,), (_BLK,))
+            latb = jax.lax.dynamic_slice(lat, (r0,), (_BLK,))
+            nov = _overlap_vec(lob[:, None], hib[:, None], stb[:, None], lo[None, :], hi[None, :], st[None, :])
+            dlt = jnp.abs(latb[:, None] - lat[None, :])
+            ok = (s_rng[:, None, None] > 0) | (rows[None, :, None] < iot[None, None, :])  # [S, BLK, P]
+            sc = _score(cnt, nov[None, None], dlt[None, None], method, ok[None])
+            tvb, tcb = _extract_topk(sc, jnp.broadcast_to(iot, sc.shape))
+            return carry, (tvb, tcb)
+
+        _, (tvs, tcs) = jax.lax.scan(blk, 0, jnp.arange(0, P, _BLK))
+        # [nblk, 2, S, BLK, K] -> [2, S, P, K] (blocks are consecutive rows)
+        tv = jnp.moveaxis(tvs, 0, 2).reshape(2, B, P, _TOPK)
+        tc = jnp.moveaxis(tcs, 0, 2).reshape(2, B, P, _TOPK)
+        return tv, tc
+
+    def lane_fn_top4(E0, qmeta0, lat0, cur0, method):
+        op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
+        iot = jnp.arange(P, dtype=jnp.int32)
+
+        def cond(state):
+            _, _, _, _, _, cur, _, go = state
+            return go & (cur < P)
+
+        def body(state):
+            E, tv, tc, qmeta, lat, cur, op_rec, _ = state
+            rowmax = tv[..., 0]  # [2, S, P]
+            flat = jnp.argmax(rowmax)  # first flat index on ties (row-major)
+            any_valid = jnp.max(rowmax) != -jnp.inf
+            sub, rem = jnp.divmod(flat, B * P)
+            s, i = jnp.divmod(rem, P)
+            sub, s, i = sub.astype(jnp.int32), s.astype(jnp.int32), i.astype(jnp.int32)
+            j = tc[sub, s, i, 0]
+
+            def do_update(args):
+                E, tv, tc, qmeta, lat, cur, op_rec = args
+                E2, new_row, _ = substitute(E, sub, s, i, j)
+                E2 = E2.at[cur].set(new_row)
+                qmeta, lat, op_rec = record_decision(qmeta, lat, op_rec, sub, s, i, j, cur, cur0)
+
+                # --- exact cache maintenance for the three dirty rows/cols
+                R = jnp.stack([i, j, cur])
+                rowC, colC = row_col_counts(E2.astype(jnp.bfloat16), R)
+                novR, dltR = _meta_rows(qmeta, lat, R)  # [3, P] each
+                okR = (s_rng[:, None, None] > 0) | (R[None, :, None] < iot[None, None, :])  # [S, 3, P]
+                rowS = _score(rowC, novR[None, None], dltR[None, None], method, okR[None])
+                okC = (s_rng[:, None, None] > 0) | (iot[None, :, None] < R[None, None, :])  # [S, P, 3]
+                novC, dltC = novR.T, dltR.T  # symmetric metadata
+                colS = _score(colC, novC[None, None], dltC[None, None], method, okC[None])
+
+                # duplicate fresh columns (i == j chains) would break the
+                # distinct-col invariant of the cache; mask them out
+                dup = jnp.array([False, True, False]) & (j == i)
+                colS = jnp.where(dup[None, None, None, :], -jnp.inf, colS)
+                cols3 = jnp.where(dup, -1, R)
+                drop = (tc == R[0]) | (tc == R[1]) | (tc == R[2])
+                tv2 = jnp.where(drop, -jnp.inf, tv)
+                v_m = jnp.concatenate([tv2, colS], axis=-1)
+                c_m = jnp.concatenate([tc, jnp.broadcast_to(cols3, colS.shape).astype(jnp.int32)], axis=-1)
+                tvN, tcN = _extract_topk(v_m, c_m)
+                tvR, tcR = _extract_topk(rowS, jnp.broadcast_to(iot, rowS.shape))
+                tvN = tvN.at[:, :, R].set(tvR)
+                tcN = tcN.at[:, :, R].set(tcR)
+                return E2, tvN, tcN, qmeta, lat, cur + 1, op_rec
+
+            def no_update(args):
+                return args
+
+            args = (E, tv, tc, qmeta, lat, cur, op_rec)
+            E, tv, tc, qmeta, lat, cur, op_rec = jax.lax.cond(any_valid, do_update, no_update, args)
+            return E, tv, tc, qmeta, lat, cur, op_rec, any_valid
+
+        tv0, tc0 = init_cache(E0, qmeta0, lat0, method)
+        state = (E0, tv0, tc0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
+        E, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
+        return E, qmeta, lat, op_rec, cur
+
     def lane_fn(E0, qmeta0, lat0, cur0, method):
         op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
 
@@ -385,24 +574,8 @@ def _build_cse_fn(spec: _KernelSpec):
                 E2, new_row, _ = substitute(E, sub, s, i, j)
                 E2 = E2.at[cur].set(new_row)
                 Cs2, Cd2 = update_counts(Cs, Cd, E2, jnp.stack([i, j, cur]))
-
-                id0 = jnp.minimum(i, j)
-                id1 = jnp.maximum(i, j)
-                shift = jnp.where(i < j, s, -s)
-                sp = jnp.exp2(shift.astype(jnp.float32))
-                lo0, hi0, st0 = qmeta[id0, 0], qmeta[id0, 1], qmeta[id0, 2]
-                lo1, hi1, st1 = qmeta[id1, 0], qmeta[id1, 1], qmeta[id1, 2]
-                is_sub = sub == 1
-                dlat, _ = _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, sp, is_sub, adder_size, carry_size)
-                nlat = jnp.maximum(lat[id0], lat[id1]) + dlat
-                # qint_add(q0, q1, shift, sub0=False, sub1=sub) — f32 for
-                # scoring only; the host re-derives op metadata in f64
-                min1 = jnp.where(is_sub, -hi1, lo1) * sp
-                max1 = jnp.where(is_sub, -lo1, hi1) * sp
-                qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
-                lat = lat.at[cur].set(nlat)
+                qmeta, lat, op_rec = record_decision(qmeta, lat, op_rec, sub, s, i, j, cur, cur0)
                 nov2, dlt2 = meta_update_cur(nov, dlt, qmeta, lat, cur)
-                op_rec = op_rec.at[cur - cur0].set(jnp.stack([id0, id1, sub, shift]))
                 return E2, Cs2, Cd2, nov2, dlt2, qmeta, lat, cur + 1, op_rec
 
             def no_update(args):
@@ -418,7 +591,7 @@ def _build_cse_fn(spec: _KernelSpec):
         E, _, _, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
         return E, qmeta, lat, op_rec, cur
 
-    return jax.jit(jax.vmap(lane_fn))
+    return jax.jit(jax.vmap(lane_fn_top4 if spec.select == 'top4' else lane_fn))
 
 
 # --------------------------------------------------------------------------
@@ -611,20 +784,26 @@ def solve_single_lanes(
                     pend = []
                     break
             n_pend = len(pend)
-            select = os.environ.get('DA4ML_JAX_SELECT', 'xla')
+            select = os.environ.get('DA4ML_JAX_SELECT', 'top4')
             fn = _build_cse_fn(_KernelSpec(P, O, B, adder_size, carry_size, select))
 
-            # HBM guard: the carried pair-count tensors dominate the loop
-            # state (2 x [S, P, P] per lane, plus f32 scoring transients).
-            # Bound the lanes per device call so a wide batch of large
-            # matrices cannot OOM-crash the worker; excess lanes run in
+            # HBM guard: bound the lanes per device call so a wide batch of
+            # large matrices cannot OOM-crash the worker; excess lanes run in
             # sequential chunks of the same compiled program.
-            itemsize = _count_itemsize(O, B)
-            # carried counts (+f32 scoring transients) dominate; the carried
-            # pairwise metadata adds 2 f32 [P, P] planes; stage entry also
-            # materializes the shifted digit stack and its abs copy
-            # (pair_counts), bf16 [P, O, S, B] each
-            per_lane = 2 * B * P * P * (itemsize + 4) + 8 * P * P + 4 * P * O * B * B + P * O * B + 16 * P
+            if select == 'top4':
+                # no carried [S, P, P] state: the footprint is the shifted
+                # digit stack + abs copy at stage entry (bf16 [P, O, S, B]
+                # each), the blocked init scoring transient, the top-k cache
+                # (f32+int32 [2, S, P, K] each), and the merge transient
+                blk = min(128, P)
+                per_lane = 4 * P * O * B * B + 16 * B * blk * P + 16 * B * P * _TOPK + 96 * B * P + P * O * B + 32 * P
+            else:
+                itemsize = _count_itemsize(O, B)
+                # carried counts (+f32 scoring transients) dominate; the
+                # carried pairwise metadata adds 2 f32 [P, P] planes; stage
+                # entry also materializes the shifted digit stack and its abs
+                # copy (pair_counts), bf16 [P, O, S, B] each
+                per_lane = 2 * B * P * P * (itemsize + 4) + 8 * P * P + 4 * P * O * B * B + P * O * B + 16 * P
             # under a sharded mesh the lane axis splits across devices, so the
             # per-device footprint is bucket/nd lanes
             nd = mesh.devices.size if (mesh is not None and sh is not None) else 1

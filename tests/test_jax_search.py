@@ -173,3 +173,23 @@ def test_pmax_inladder_safety_net(rng, monkeypatch):
     lane = _Lane(kernel, qints, [0.0] * 8, 'wmc')
     (sol,) = solve_single_lanes([lane], -1, -1)
     np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+
+
+def test_top4_select_quality_vs_scan(rng, monkeypatch):
+    """The O(S*P) top-k score cache ('top4', the default) stays exact and
+    within a few % of the decision-identical full-rescan path ('xla')."""
+    from da4ml_tpu.cmvm.jax_search import _build_cse_fn
+
+    kernels = [random_kernel(rng, n, b) for n, b in [(6, 3), (8, 4), (8, 6), (12, 4)]]
+    monkeypatch.setenv('DA4ML_JAX_SELECT', 'top4')
+    _build_cse_fn.cache_clear()
+    top4 = solve_jax_many(kernels)
+    monkeypatch.setenv('DA4ML_JAX_SELECT', 'xla')
+    _build_cse_fn.cache_clear()
+    scan = solve_jax_many(kernels)
+    _build_cse_fn.cache_clear()
+    for k, st, ss in zip(kernels, top4, scan):
+        np.testing.assert_array_equal(np.asarray(st.kernel, np.float64), k)
+        np.testing.assert_array_equal(np.asarray(ss.kernel, np.float64), k)
+    mt, ms = np.mean([s.cost for s in top4]), np.mean([s.cost for s in scan])
+    assert mt <= ms * 1.03, (mt, ms)
