@@ -257,28 +257,31 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
             'mean_cost_single': round(float(np.mean([s.cost for s in single])), 3),
             'wall_s': round(time.perf_counter() - t0, 2),
         }
-    if name == 'pallas_select':
+    if name == 'select_modes':
+        # selection-mode microbench: top4 (default, O(S*P) score cache) vs
+        # the full-rescan xla path vs its fused-pallas variant
         from da4ml_tpu.cmvm.jax_search import _build_cse_fn
 
         k1 = _section_kernels('1_16x16_int4', n1, limited)
-        _, x_steady, _ = _jax_solve(k1)
-        os.environ['DA4ML_JAX_SELECT'] = 'pallas'
-        _build_cse_fn.cache_clear()
-        try:
-            _, p_steady, p_compile = _jax_solve(k1)
-        finally:
-            os.environ.pop('DA4ML_JAX_SELECT', None)
+        out = {}
+        for mode in ('top4', 'xla', 'pallas'):
+            os.environ['DA4ML_JAX_SELECT'] = mode
             _build_cse_fn.cache_clear()
-        return {
-            'jax_rate': round(len(k1) / p_steady, 3),
-            'vs_xla_select': round(x_steady / p_steady, 3),
-            'jax_compile_s': round(p_compile, 2),
-        }
+            try:
+                _, steady, compile_t = _jax_solve(k1)
+            finally:
+                os.environ.pop('DA4ML_JAX_SELECT', None)
+                _build_cse_fn.cache_clear()
+            out[f'{mode}_rate'] = round(len(k1) / steady, 3)
+            out[f'{mode}_compile_s'] = round(compile_t, 2)
+        out['top4_vs_xla'] = round(out['top4_rate'] / out['xla_rate'], 3)
+        out['pallas_vs_xla'] = round(out['pallas_rate'] / out['xla_rate'], 3)
+        return out
     return _run_config(name, _section_kernels(name, n1, limited), host_backend)
 
 
 _CONFIG_SECTIONS = ('1_16x16_int4', '2_jedi_mlp_layers', '3_dim_bits_sweep', '4_qconv3x3_im2col', '5_full_model_trace')
-_MICRO_SECTIONS = ('quality_sweep', 'dais_inference', 'pallas_select')
+_MICRO_SECTIONS = ('quality_sweep', 'dais_inference', 'select_modes')
 
 
 def main():
@@ -308,7 +311,7 @@ def main():
     wedged = False
     sections = _CONFIG_SECTIONS + _MICRO_SECTIONS
     for name in sections:
-        if name == 'pallas_select' and not is_tpu:
+        if name == 'select_modes' and not is_tpu:
             continue  # interpret-mode numbers are meaningless
         remaining = deadline - time.monotonic()
         if remaining < 30 or wedged:

@@ -96,3 +96,38 @@ def test_pallas_select_large_class(rng):
     k = (rng.integers(0, 16, (24, 24)) * rng.choice([-1.0, 1.0], (24, 24))).astype(np.float64)
     sols = _solve_costs([k], 'pallas')
     np.testing.assert_array_equal(np.asarray(sols[0].kernel, np.float64), k)
+
+
+def test_top4_select_on_tpu(rng):
+    """The default O(S*P) score-cache select: exact on hardware, cost within
+    a few % of the full-rescan reference path."""
+    kernels = [
+        (rng.integers(0, 2**b, (n, n)) * rng.choice([-1.0, 1.0], (n, n))).astype(np.float64)
+        for n, b in ((6, 4), (8, 4), (12, 4))
+    ]
+    sols_t = _solve_costs(kernels, 'top4')
+    sols_x = _solve_costs(kernels, 'xla')
+    for k, st, sx in zip(kernels, sols_t, sols_x):
+        np.testing.assert_array_equal(np.asarray(st.kernel, np.float64), k)
+    mt = float(np.mean([s.cost for s in sols_t]))
+    mx = float(np.mean([s.cost for s in sols_x]))
+    assert mt <= mx * 1.03, (mt, mx)
+
+
+def test_jedi_layer_shape_on_tpu(rng):
+    """A 16x64 6-bit layer (BASELINE config 2's widest class, P=512 stage):
+    must solve exactly on hardware within a sane wall-time budget.
+
+    This is the shape class whose compile crashed the remote TPU worker in
+    round 1's bench (BENCH/VERDICT r1); it pins the fix."""
+    import time
+
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    mag = rng.integers(0, 64, (16, 64)).astype(np.float64)
+    k = mag * rng.choice([-1.0, 1.0], (16, 64))
+    t0 = time.perf_counter()
+    (sol,) = solve_jax_many([k])
+    wall = time.perf_counter() - t0
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), k)
+    assert wall < 420.0, f'16x64 solve took {wall:.0f}s (compile + search)'
