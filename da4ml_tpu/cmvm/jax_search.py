@@ -72,20 +72,29 @@ def _next_pow2(x: int) -> int:
     return 1 << (max(x, 1) - 1).bit_length()
 
 
+def _select() -> str:
+    """The active selection mode (env DA4ML_JAX_SELECT): single source of
+    truth for the device loop and the mode-dependent slot ceiling."""
+    return os.environ.get('DA4ML_JAX_SELECT', 'top4')
+
+
 def _pmax() -> int:
     """Slot-count ceiling for the device search (env DA4ML_JAX_PMAX).
 
-    Beyond this the [S, P, P] pair-count state stops being HBM/compile
-    friendly; work estimated to exceed it is solved on the host instead so a
-    single huge matrix can never wedge the device (or its remote compiler).
-    Floored to a power of two so the stage ladder (which only visits pow2 P,
-    clamped to this ceiling for its last rung) agrees with the pre-route
-    estimate. Values <= 0 mean "no ceiling" (the repo-wide -1 convention).
+    Work estimated to exceed it is solved on the host instead, so a single
+    huge matrix can never wedge the device (or its remote compiler). The
+    default depends on the selection mode: the rescan paths carry [S, P, P]
+    pair counts (HBM/compile hostile beyond ~4k slots), while the default
+    top4 cache is O(S*P) and admits far larger instances. Floored to a power
+    of two so the stage ladder (which only visits pow2 P, clamped to this
+    ceiling for its last rung) agrees with the pre-route estimate. Values
+    <= 0 mean "no ceiling" (the repo-wide -1 convention).
     """
+    default = 32768 if _select() == 'top4' else 4096
     try:
-        raw = int(os.environ.get('DA4ML_JAX_PMAX', '') or 4096)
+        raw = int(os.environ.get('DA4ML_JAX_PMAX', '') or default)
     except ValueError:
-        raw = 4096
+        raw = default
     if raw <= 0:
         return 1 << 30
     p2 = _next_pow2(raw)
@@ -784,7 +793,7 @@ def solve_single_lanes(
                     pend = []
                     break
             n_pend = len(pend)
-            select = os.environ.get('DA4ML_JAX_SELECT', 'top4')
+            select = _select()
             fn = _build_cse_fn(_KernelSpec(P, O, B, adder_size, carry_size, select))
 
             # HBM guard: bound the lanes per device call so a wide batch of
