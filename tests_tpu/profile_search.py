@@ -1,0 +1,61 @@
+"""On-hardware search profiler (manual tool, not a pytest suite).
+
+Run on a machine with the TPU plugin active:
+
+    python tests_tpu/profile_search.py [n_matrices] [--trace DIR]
+
+Prints per-stage device round times (DA4ML_JAX_DEBUG) plus a phase
+breakdown of ``solve_jax_many`` for BASELINE config 1, and optionally a
+jax.profiler trace to inspect in TensorBoard/xprof. Use it to attribute
+steady-state time between device rounds, host prep, and emission before
+touching the kernel code.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and not sys.argv[1].startswith('-') else 64
+    trace_dir = None
+    if '--trace' in sys.argv:
+        trace_dir = sys.argv[sys.argv.index('--trace') + 1]
+    os.environ.setdefault('DA4ML_JAX_DEBUG', '1')
+
+    import jax
+
+    jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    print(f'backend: {jax.default_backend()}, devices: {jax.devices()}')
+
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    rng = np.random.default_rng(20260729)
+    kernels = [
+        (rng.integers(0, 16, (16, 16)) * rng.choice([-1.0, 1.0], (16, 16))).astype(np.float64) for _ in range(n)
+    ]
+
+    t0 = time.perf_counter()
+    solve_jax_many(kernels)
+    print(f'first call (compiles): {time.perf_counter() - t0:.2f}s')
+
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            t0 = time.perf_counter()
+            sols = solve_jax_many(kernels)
+            steady = time.perf_counter() - t0
+        print(f'trace written to {trace_dir}')
+    else:
+        t0 = time.perf_counter()
+        sols = solve_jax_many(kernels)
+        steady = time.perf_counter() - t0
+    print(f'steady: {steady:.2f}s = {n / steady:.1f} matrices/s, mean cost {np.mean([s.cost for s in sols]):.1f}')
+
+
+if __name__ == '__main__':
+    main()
