@@ -23,7 +23,19 @@ from typing import Any
 import numpy as np
 
 from ..trace import FixedVariableArray
-from ..trace.ops import avg_pool2d, conv1d, conv2d, max_pool2d, relu
+from ..trace.ops import (
+    avg_pool1d,
+    avg_pool2d,
+    conv1d,
+    conv2d,
+    depthwise_conv1d,
+    depthwise_conv2d,
+    max_pool1d,
+    max_pool2d,
+    relu,
+    upsample_nearest,
+    zero_pad,
+)
 from .plugin import TracerPluginBase
 
 _SUPPORTED_ACTIVATIONS = ('linear', 'relu')
@@ -87,17 +99,67 @@ class KerasTracer(TracerPluginBase):
                 y = y + _weight(layer.bias)
             return _apply_activation(y, layer.activation.__name__)
 
-        if name in ('MaxPooling2D', 'AveragePooling2D', 'GlobalAveragePooling2D', 'GlobalMaxPooling2D'):
+        if name in ('DepthwiseConv1D', 'DepthwiseConv2D', 'SeparableConv1D', 'SeparableConv2D'):
+            x = args[0]
+            if getattr(layer, 'data_format', 'channels_last') != 'channels_last':
+                raise NotImplementedError('Only channels_last convolutions are supported')
+            # Keras 3: Separable* exposes depthwise_kernel, Depthwise* plain kernel
+            dk_w = getattr(layer, 'depthwise_kernel', None)
+            dk = _weight(layer.kernel if dk_w is None else dk_w)
+            if name.endswith('1D'):
+                y = depthwise_conv1d(x, dk, stride=layer.strides[0], padding=layer.padding, dilation=layer.dilation_rate[0])
+            else:
+                y = depthwise_conv2d(x, dk, strides=layer.strides, padding=layer.padding, dilation=layer.dilation_rate)
+            if name.startswith('Separable'):
+                pk = _weight(layer.pointwise_kernel)  # 1D: [1, Cin*M, Cout]; 2D: [1, 1, Cin*M, Cout]
+                y = y @ pk.reshape(pk.shape[-2], pk.shape[-1])
+            if layer.use_bias:
+                y = y + _weight(layer.bias)
+            return _apply_activation(y, layer.activation.__name__)
+
+        if name in (
+            'MaxPooling1D',
+            'AveragePooling1D',
+            'MaxPooling2D',
+            'AveragePooling2D',
+            'GlobalAveragePooling1D',
+            'GlobalMaxPooling1D',
+            'GlobalAveragePooling2D',
+            'GlobalMaxPooling2D',
+        ):
             if getattr(layer, 'data_format', 'channels_last') != 'channels_last':
                 raise NotImplementedError('Only channels_last pooling is supported')
+        if name == 'MaxPooling1D':
+            return max_pool1d(args[0], layer.pool_size, layer.strides, layer.padding)
+        if name == 'AveragePooling1D':
+            return avg_pool1d(args[0], layer.pool_size, layer.strides, layer.padding)
         if name == 'MaxPooling2D':
             return max_pool2d(args[0], layer.pool_size, layer.strides, layer.padding)
         if name == 'AveragePooling2D':
             return avg_pool2d(args[0], layer.pool_size, layer.strides, layer.padding)
+        if name == 'GlobalAveragePooling1D':
+            return np.mean(args[0], axis=0, keepdims=bool(getattr(layer, 'keepdims', False)))
+        if name == 'GlobalMaxPooling1D':
+            return np.amax(args[0], axis=0, keepdims=bool(getattr(layer, 'keepdims', False)))
         if name == 'GlobalAveragePooling2D':
             return np.mean(args[0], axis=(0, 1), keepdims=bool(getattr(layer, 'keepdims', False)))
         if name == 'GlobalMaxPooling2D':
             return np.amax(args[0], axis=(0, 1), keepdims=bool(getattr(layer, 'keepdims', False)))
+
+        if name in ('ZeroPadding1D', 'ZeroPadding2D'):
+            if getattr(layer, 'data_format', 'channels_last') not in (None, 'channels_last'):
+                raise NotImplementedError('Only channels_last padding is supported')
+            pad = layer.padding  # Keras normalizes to ((t, b),) per spatial axis
+            pads = [tuple(int(v) for v in p) for p in (pad if isinstance(pad[0], (tuple, list)) else (pad,))]
+            return zero_pad(args[0], pads)
+
+        if name in ('UpSampling1D', 'UpSampling2D'):
+            if getattr(layer, 'data_format', 'channels_last') not in (None, 'channels_last'):
+                raise NotImplementedError('Only channels_last upsampling is supported')
+            if getattr(layer, 'interpolation', 'nearest') != 'nearest':
+                raise NotImplementedError('Only nearest-neighbor upsampling is traceable')
+            size = layer.size if name == 'UpSampling2D' else (layer.size,)
+            return upsample_nearest(args[0], tuple(int(s) for s in np.atleast_1d(size).ravel()))
 
         if name == 'Flatten':
             return args[0].reshape(-1)

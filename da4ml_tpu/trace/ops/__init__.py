@@ -1,4 +1,15 @@
-from .conv_utils import avg_pool2d, conv1d, conv2d, max_pool2d
+from .conv_utils import (
+    avg_pool1d,
+    avg_pool2d,
+    conv1d,
+    conv2d,
+    depthwise_conv1d,
+    depthwise_conv2d,
+    max_pool1d,
+    max_pool2d,
+    upsample_nearest,
+    zero_pad,
+)
 from .einsum_utils import einsum
 from .quantization import fixed_quantize, quantize, relu
 from .reduce_utils import reduce
@@ -13,6 +24,12 @@ __all__ = [
     'fixed_quantize',
     'conv1d',
     'conv2d',
+    'depthwise_conv1d',
+    'depthwise_conv2d',
+    'max_pool1d',
     'max_pool2d',
+    'avg_pool1d',
     'avg_pool2d',
+    'zero_pad',
+    'upsample_nearest',
 ]

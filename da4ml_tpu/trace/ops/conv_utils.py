@@ -197,3 +197,87 @@ def avg_pool2d(x: FixedVariableArray, pool_size=(2, 2), strides=None, padding: s
     Ho, Wo, _, _, C = P.shape
     arr = _fva()(P.reshape(Ho, Wo, kh * kw, C), x.solver_options, hwconf=x.hwconf)
     return np.sum(arr, axis=2) * (1.0 / (kh * kw))  # type: ignore[return-value]
+
+
+def _pool1d(x: FixedVariableArray, pool_size, strides, padding: str, reduce_max: bool) -> FixedVariableArray:
+    """[L, C] 1-d pooling via the 2-d kernels on a width-1 spatial axis."""
+    k = int(pool_size[0] if isinstance(pool_size, (tuple, list)) else pool_size)
+    s = k if strides is None else int(strides[0] if isinstance(strides, (tuple, list)) else strides)
+    v = _fva()(x._vars[:, None, :], x.solver_options, hwconf=x.hwconf)  # [L, 1, C]
+    fn = max_pool2d if reduce_max else avg_pool2d
+    out = fn(v, (k, 1), (s, 1), padding)
+    return _fva()(out._vars[:, 0, :], x.solver_options, hwconf=x.hwconf)
+
+
+def max_pool1d(x: FixedVariableArray, pool_size=2, strides=None, padding: str = 'valid') -> FixedVariableArray:
+    """[L, C] -> [Lo, C] window maximum."""
+    return _pool1d(x, pool_size, strides, padding, reduce_max=True)
+
+
+def avg_pool1d(x: FixedVariableArray, pool_size=2, strides=None, padding: str = 'valid') -> FixedVariableArray:
+    """[L, C] -> [Lo, C] window mean."""
+    return _pool1d(x, pool_size, strides, padding, reduce_max=False)
+
+
+def zero_pad(x: FixedVariableArray, pads: list[tuple[int, int]]) -> FixedVariableArray:
+    """Pad the leading spatial axes with exact zeros; channels untouched.
+
+    ``pads`` is [(before, after), ...] for the first len(pads) axes.
+    """
+    arr = _pad_spatial(x, list(pads))
+    return _fva()(arr, x.solver_options, hwconf=x.hwconf)
+
+
+def upsample_nearest(x: FixedVariableArray, size) -> FixedVariableArray:
+    """Nearest-neighbor upsampling over the leading spatial axes: pure
+    fan-out of existing variables (no new hardware ops)."""
+    sizes = size if isinstance(size, (tuple, list)) else (size,)
+    v = x._vars
+    for ax, s in enumerate(sizes):
+        v = np.repeat(v, int(s), axis=ax)
+    return _fva()(v, x.solver_options, hwconf=x.hwconf)
+
+
+def depthwise_conv1d(
+    x: FixedVariableArray,
+    kernel: np.ndarray,
+    stride: int = 1,
+    padding: str = 'valid',
+    dilation: int = 1,
+) -> FixedVariableArray:
+    """Depthwise 1-d convolution: [L, C] * [k, C, M] -> [Lo, C*M].
+
+    Lifted onto a width-1 spatial axis of the 2-d kernel (same pattern as
+    ``_pool1d``)."""
+    kernel = np.asarray(kernel, dtype=np.float64)
+    assert kernel.ndim == 3, f'kernel must be [k, c, mult], got shape {kernel.shape}'
+    v2 = _fva()(x._vars[:, None, :], x.solver_options, hwconf=x.hwconf)
+    y = depthwise_conv2d(v2, kernel[:, None], strides=(int(stride), 1), padding=padding, dilation=(int(dilation), 1))
+    return _fva()(y._vars[:, 0, :], x.solver_options, hwconf=x.hwconf)
+
+
+def depthwise_conv2d(
+    x: FixedVariableArray,
+    kernel: np.ndarray,
+    strides=(1, 1),
+    padding: str = 'valid',
+    dilation=(1, 1),
+) -> FixedVariableArray:
+    """Depthwise 2-d convolution: [H, W, C] * [kh, kw, C, M] -> [Ho, Wo, C*M].
+
+    Each input channel convolves with its own [kh, kw, M] filter bank — one
+    small CMVM per channel; output channel order matches Keras
+    (c * depth_multiplier + m).
+    """
+    kernel = np.asarray(kernel, dtype=np.float64)
+    assert kernel.ndim == 4, f'kernel must be [kh, kw, c, mult], got shape {kernel.shape}'
+    kh, kw, cin, mult = kernel.shape
+    assert x.shape[-1] == cin, f'channel mismatch: input {x.shape[-1]}, kernel {cin}'
+    P = _patches_2d(x, kh, kw, _as_pair(strides), _as_pair(dilation), padding)  # [Ho, Wo, kh, kw, C]
+    Ho, Wo = P.shape[0], P.shape[1]
+    outs = []
+    for c in range(cin):
+        patches = _fva()(P[..., c].reshape(Ho * Wo, kh * kw), x.solver_options, hwconf=x.hwconf)
+        outs.append((patches @ kernel[:, :, c, :].reshape(kh * kw, mult))._vars)  # [Ho*Wo, M]
+    stacked = np.stack(outs, axis=1)  # [Ho*Wo, C, M]
+    return _fva()(stacked.reshape(Ho, Wo, cin * mult), x.solver_options, hwconf=x.hwconf)

@@ -232,3 +232,49 @@ def test_torch_partial_flatten_rejected(rng):
 
     with pytest.raises(NotImplementedError, match='flatten'):
         trace_model(M(), HWConfig(1, -1, -1), inputs_kif=(1, 3, 0))
+
+
+def test_keras_1d_pool_pad_upsample(rng):
+    from keras import layers
+
+    model = keras.Sequential(
+        [
+            layers.Input((8, 2)),
+            layers.ZeroPadding1D(1),
+            layers.Conv1D(3, 3, activation='relu'),
+            layers.MaxPooling1D(2),
+            layers.UpSampling1D(2),
+            layers.AveragePooling1D(2),
+            layers.GlobalMaxPooling1D(),
+            layers.Dense(2),
+        ]
+    )
+    _int_weights_keras(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (8, 8, 2)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).reshape(8, -1).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_keras_depthwise_separable(rng):
+    from keras import layers
+
+    model = keras.Sequential(
+        [
+            layers.Input((5, 5, 2)),
+            layers.ZeroPadding2D(((1, 0), (0, 1))),
+            layers.DepthwiseConv2D((3, 3), depth_multiplier=2, activation='relu'),
+            layers.SeparableConv2D(3, (2, 2)),
+            layers.UpSampling2D((1, 2)),
+            layers.GlobalAveragePooling2D(),
+        ]
+    )
+    _int_weights_keras(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (6, 5, 5, 2)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    # GlobalAveragePooling divides by a non-pow2 count in f32; the
+    # fixed-point trace computes the same mean exactly, so compare with the
+    # f64 mean of the pre-pool f32 values instead of strict f32 equality
+    pre = keras.Model(model.inputs, model.layers[-2].output)
+    ref = np.asarray(pre(data.astype(np.float32))).astype(np.float64).mean(axis=(1, 2))
+    np.testing.assert_allclose(out, ref.reshape(6, -1), rtol=0, atol=1e-5)
