@@ -22,8 +22,25 @@ from typing import Any
 import numpy as np
 
 from ..trace import FixedVariableArray
-from ..trace.ops import avg_pool2d, conv1d, conv2d, max_pool2d, relu
+from ..trace.ops import (
+    avg_pool1d,
+    avg_pool2d,
+    conv1d,
+    conv2d,
+    depthwise_conv1d,
+    depthwise_conv2d,
+    max_pool1d,
+    max_pool2d,
+    relu,
+    upsample_nearest,
+    zero_pad,
+)
 from .plugin import TracerPluginBase
+
+
+def _one(v) -> int:
+    """A scalar kernel/stride parameter (torch 1-d modules store int or 1-tuple)."""
+    return int(v[0] if isinstance(v, (tuple, list)) else v)
 
 
 def _w(t) -> np.ndarray:
@@ -68,29 +85,66 @@ class TorchTracer(TracerPluginBase):
         if isinstance(mod, (nn.Dropout, nn.Identity)):
             return x
         if isinstance(mod, nn.Conv2d):
-            if mod.groups != 1:
-                raise NotImplementedError('Grouped convolutions are not supported')
+            depthwise = mod.groups == mod.in_channels and mod.out_channels % mod.in_channels == 0
+            if mod.groups != 1 and not depthwise:
+                raise NotImplementedError('Grouped convolutions are only supported when depthwise (groups == in_channels)')
             pad = mod.padding
             if pad == 'same' or pad == (0, 0) or pad == 'valid':
                 padding = 'same' if pad == 'same' else 'valid'
             else:
                 raise NotImplementedError(f'Explicit padding {pad} is not supported (use 0 or "same")')
-            k = _w(mod.weight).transpose(2, 3, 1, 0)  # [cout,cin,kh,kw] -> [kh,kw,cin,cout]
-            y = conv2d(_chw_to_hwc(x), k, strides=mod.stride, padding=padding, dilation=mod.dilation)
+            if depthwise and mod.groups != 1:
+                cin, mult = mod.in_channels, mod.out_channels // mod.in_channels
+                # [cin*mult, 1, kh, kw] -> [kh, kw, cin, mult]; torch groups
+                # output channels by input group, matching c*mult + m order
+                k = _w(mod.weight).reshape(cin, mult, *mod.kernel_size).transpose(2, 3, 0, 1)
+                y = depthwise_conv2d(_chw_to_hwc(x), k, strides=mod.stride, padding=padding, dilation=mod.dilation)
+            else:
+                k = _w(mod.weight).transpose(2, 3, 1, 0)  # [cout,cin,kh,kw] -> [kh,kw,cin,cout]
+                y = conv2d(_chw_to_hwc(x), k, strides=mod.stride, padding=padding, dilation=mod.dilation)
             if mod.bias is not None:
                 y = y + _w(mod.bias)
             return _hwc_to_chw(y)
         if isinstance(mod, nn.Conv1d):
-            if mod.groups != 1:
-                raise NotImplementedError('Grouped convolutions are not supported')
+            depthwise = mod.groups == mod.in_channels and mod.out_channels % mod.in_channels == 0
+            if mod.groups != 1 and not depthwise:
+                raise NotImplementedError('Grouped convolutions are only supported when depthwise (groups == in_channels)')
             pad = mod.padding
             if pad not in ('same', 'valid', (0,), 0):
                 raise NotImplementedError(f'Explicit padding {pad} is not supported (use 0 or "same")')
-            k = _w(mod.weight).transpose(2, 1, 0)  # [cout,cin,k] -> [k,cin,cout]
-            y = conv1d(_chw_to_hwc(x), k, stride=mod.stride[0], padding='same' if pad == 'same' else 'valid',
-                       dilation=mod.dilation[0])  # fmt: skip
+            if depthwise and mod.groups != 1:
+                cin, mult = mod.in_channels, mod.out_channels // mod.in_channels
+                k = _w(mod.weight).reshape(cin, mult, mod.kernel_size[0]).transpose(2, 0, 1)  # [k, cin, mult]
+                y = depthwise_conv1d(_chw_to_hwc(x), k, stride=mod.stride[0],
+                                     padding='same' if pad == 'same' else 'valid', dilation=mod.dilation[0])  # fmt: skip
+            else:
+                k = _w(mod.weight).transpose(2, 1, 0)  # [cout,cin,k] -> [k,cin,cout]
+                y = conv1d(_chw_to_hwc(x), k, stride=mod.stride[0], padding='same' if pad == 'same' else 'valid',
+                           dilation=mod.dilation[0])  # fmt: skip
             if mod.bias is not None:
                 y = y + _w(mod.bias)
+            return _hwc_to_chw(y)
+        if isinstance(mod, (nn.MaxPool1d, nn.AvgPool1d)):
+            if np.any(np.asarray(mod.padding)) or getattr(mod, 'ceil_mode', False):
+                raise NotImplementedError('Pooling padding/ceil_mode are not supported')
+            if np.any(np.asarray(getattr(mod, 'dilation', 1)) != 1):
+                raise NotImplementedError('Dilated pooling is not supported')
+            pool = max_pool1d if isinstance(mod, nn.MaxPool1d) else avg_pool1d
+            y = pool(_chw_to_hwc(x), _one(mod.kernel_size), _one(mod.stride), 'valid')
+            return _hwc_to_chw(y)
+        if isinstance(mod, nn.ZeroPad2d):
+            left, right, top, bottom = (int(v) for v in mod.padding)
+            y = zero_pad(_chw_to_hwc(x), [(top, bottom), (left, right)])
+            return _hwc_to_chw(y)
+        if isinstance(mod, nn.Upsample):
+            if mod.mode != 'nearest' or mod.size is not None:
+                raise NotImplementedError('Only nearest-neighbor scale_factor upsampling is traceable')
+            sf = mod.scale_factor
+            raw = tuple(sf) if isinstance(sf, (tuple, list)) else (sf,) * (x.ndim - 1)
+            if any(float(s) != int(s) for s in raw):
+                raise NotImplementedError(f'Non-integral upsampling scale_factor {sf} is not traceable')
+            sizes = tuple(int(s) for s in raw)
+            y = upsample_nearest(_chw_to_hwc(x), sizes)
             return _hwc_to_chw(y)
         if isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
             if np.any(np.asarray(mod.padding)) or getattr(mod, 'ceil_mode', False):

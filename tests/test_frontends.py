@@ -278,3 +278,56 @@ def test_keras_depthwise_separable(rng):
     pre = keras.Model(model.inputs, model.layers[-2].output)
     ref = np.asarray(pre(data.astype(np.float32))).astype(np.float64).mean(axis=(1, 2))
     np.testing.assert_allclose(out, ref.reshape(6, -1), rtol=0, atol=1e-5)
+
+
+class _TorchDepthwise(torch.nn.Module):
+    input_shape = (2, 6, 6)
+
+    def __init__(self):
+        super().__init__()
+        self.pad = torch.nn.ZeroPad2d((1, 0, 0, 1))
+        self.dw = torch.nn.Conv2d(2, 4, 3, groups=2)  # depthwise, mult 2
+        self.act = torch.nn.ReLU()
+        self.up = torch.nn.Upsample(scale_factor=2, mode='nearest')
+        self.pool = torch.nn.MaxPool2d(2)
+        self.flat = torch.nn.Flatten(0)
+
+    def forward(self, x):
+        return self.flat(self.pool(self.up(self.act(self.dw(self.pad(x))))))
+
+
+def test_torch_depthwise_pad_upsample(rng):
+    model = _TorchDepthwise()
+    _int_weights_torch(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (6, 2, 6, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        # batched reference: nn.Upsample requires the batch dim to interpret
+        # [N, C, H, W]; Flatten(0) then flattens per-batch — reshape instead
+        mb = torch.nn.Sequential(model.pad, model.dw, model.act, model.up, model.pool)
+        ref = mb(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref.reshape(6, -1))
+
+
+class _TorchPool1d(torch.nn.Module):
+    input_shape = (2, 8)
+
+    def __init__(self):
+        super().__init__()
+        self.dw = torch.nn.Conv1d(2, 2, 3, groups=2)
+        self.mp = torch.nn.MaxPool1d(2)
+        self.ap = torch.nn.AvgPool1d(2, stride=1)  # pow2 window: f32 mean stays exact
+        self.flat = torch.nn.Flatten(0)
+
+    def forward(self, x):
+        return self.flat(self.ap(self.mp(self.dw(x))))
+
+
+def test_torch_1d_depthwise_pooling(rng):
+    model = _TorchPool1d()
+    _int_weights_torch(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (6, 2, 8)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = np.stack([model(torch.tensor(d.astype(np.float32))).numpy() for d in data]).astype(np.float64)
+    np.testing.assert_array_equal(out, ref.reshape(6, -1))
