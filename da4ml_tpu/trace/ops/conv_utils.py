@@ -275,9 +275,21 @@ def depthwise_conv2d(
     assert x.shape[-1] == cin, f'channel mismatch: input {x.shape[-1]}, kernel {cin}'
     P = _patches_2d(x, kh, kw, _as_pair(strides), _as_pair(dilation), padding)  # [Ho, Wo, kh, kw, C]
     Ho, Wo = P.shape[0], P.shape[1]
-    outs = []
+    from ..fixed_variable_array import cmvm_multi
+
+    # one batched solve across channels: every (channel, patch-metadata)
+    # instance becomes a device lane on the jax backend. Fully-constant
+    # channels (degenerate) short-circuit to a plain numeric matmul.
+    jobs, job_cols, outs = [], [], [None] * cin
     for c in range(cin):
+        k_c = kernel[:, :, c, :].reshape(kh * kw, mult)
         patches = _fva()(P[..., c].reshape(Ho * Wo, kh * kw), x.solver_options, hwconf=x.hwconf)
-        outs.append((patches @ kernel[:, :, c, :].reshape(kh * kw, mult))._vars)  # [Ho*Wo, M]
+        if patches.collapsed:
+            outs[c] = (patches @ k_c)._vars
+        else:
+            jobs.append((k_c, patches))
+            job_cols.append(c)
+    for c, rows in zip(job_cols, cmvm_multi(jobs, x.solver_options)):
+        outs[c] = np.stack(rows, axis=0)
     stacked = np.stack(outs, axis=1)  # [Ho*Wo, C, M]
     return _fva()(stacked.reshape(Ho, Wo, cin * mult), x.solver_options, hwconf=x.hwconf)

@@ -123,3 +123,26 @@ def test_avg_pool2d(rng):
     for d, o in zip(data, outs):
         ref = d.reshape(3, 2, 3, 2).mean(axis=(1, 3))
         np.testing.assert_array_equal(o.reshape(3, 3), ref)
+
+
+def _np_depthwise2d(x, w, padding='valid'):
+    kh, kw, cin, mult = w.shape
+    cols = [_np_conv2d(x[..., c : c + 1], w[:, :, c : c + 1, :], padding=padding) for c in range(cin)]
+    return np.concatenate(cols, axis=-1)
+
+
+@pytest.mark.parametrize('backend', ['auto', 'jax'])
+@pytest.mark.parametrize('padding', ['valid', 'same'])
+def test_depthwise_conv2d(rng, padding, backend):
+    """Per-channel CMVMs (batched into one device call on the jax backend)."""
+    from da4ml_tpu.trace.ops import depthwise_conv2d
+
+    shape = (5, 5, 3)
+    inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, -1), solver_options={'backend': backend})
+    x = inp.quantize(np.ones(shape), np.full(shape, 3), np.zeros(shape, np.int64))
+    data = rng.integers(-8, 8, (16, *shape)).astype(np.float64)
+    w = rng.integers(-4, 4, (3, 3, 3, 2)).astype(np.float64)
+    comb = comb_trace(inp, depthwise_conv2d(x, w, padding=padding))
+    out = comb.predict(data.reshape(len(data), -1), backend='numpy')
+    ref = np.stack([_np_depthwise2d(d, w, padding=padding) for d in data])
+    np.testing.assert_array_equal(out, ref.reshape(len(data), -1))
