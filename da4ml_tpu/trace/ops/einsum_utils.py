@@ -160,6 +160,38 @@ def _run_plan(plan: EinsumPlan, x0, x1) -> np.ndarray:
 
     symbolic = isinstance(x0, FixedVariableArray) or isinstance(x1, FixedVariableArray)
     out = np.empty((plan.b, plan.m, plan.n), dtype=object if symbolic else np.float64)
+
+    # variable @ constant batches: all B blocks solve as one device batch on
+    # the jax backend (cmvm_multi); collapsed blocks keep the numeric path
+    x0_sym, x1_sym = isinstance(x0, FixedVariableArray), isinstance(x1, FixedVariableArray)
+    if (
+        symbolic
+        and (x0_sym != x1_sym)
+        and plan.b > 1
+        # the const side must be plain numbers (an object ndarray of
+        # FixedVariables takes the mmm path inside matmul instead)
+        and np.asarray(x1 if x0_sym else x0).dtype != object
+    ):
+        from ..fixed_variable_array import cmvm_multi
+
+        jobs, idxs = [], []
+        for bi in range(plan.b):
+            if x0_sym and not x0[bi].collapsed:
+                jobs.append((np.asarray(x1[bi], dtype=np.float64), x0[bi]))
+                idxs.append(bi)
+            elif x1_sym and not x1[bi].collapsed:
+                # const [M,K] @ var [K,N] == (var.T [N,K] @ const.T [K,M]).T
+                jobs.append((np.asarray(x0[bi], dtype=np.float64).T, x1[bi].transpose((1, 0))))
+                idxs.append(bi)
+            else:
+                block = x0[bi] @ x1[bi]
+                out[bi] = block._vars if isinstance(block, FixedVariableArray) else block
+        solver_options = (x0 if x0_sym else x1).solver_options
+        for bi, rows in zip(idxs, cmvm_multi(jobs, solver_options)):
+            block = np.stack(rows, axis=0)
+            out[bi] = block if x0_sym else block.T
+        return out.reshape(plan.stacked_shape).transpose(plan.out_perm)
+
     for bi in range(plan.b):
         block = x0[bi] @ x1[bi]
         out[bi] = block._vars if isinstance(block, FixedVariableArray) else block

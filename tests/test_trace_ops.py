@@ -230,3 +230,26 @@ def test_input_precision_widening():
     comb = comb_trace(inp, out)
     k, i, f = comb.inp_kifs
     assert (i >= 3).all() and (f >= 1).all()
+
+
+def test_einsum_batched_jax_backend(rng):
+    """Batched einsum blocks solve as one device batch on backend='jax'."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    shape = (3, 4, 5)
+    inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, -1), solver_options={'backend': 'jax'})
+    x = inp.quantize(np.ones(shape), np.full(shape, 3), np.zeros(shape, np.int64))
+    w = rng.integers(-4, 4, (3, 5, 2)).astype(np.float64)
+    for expr, ref_fn in (
+        ('bmk,bkn->bmn', lambda d: np.einsum('bmk,bkn->bmn', d, w)),
+        ('bkn,bmk->bmn', lambda d: np.einsum('bkn,bmk->bmn', w, d)),
+    ):
+        if expr == 'bmk,bkn->bmn':
+            y = np.einsum(expr, x, w)
+        else:  # const as the first operand exercises the transposed batch path
+            y = np.einsum(expr, w, x)
+        comb = comb_trace(inp, y)
+        data = rng.integers(-8, 8, (8, *shape)).astype(np.float64)
+        out = comb.predict(data.reshape(8, -1), backend='numpy')
+        ref = np.stack([ref_fn(d) for d in data])
+        np.testing.assert_array_equal(out, ref.reshape(8, -1))
