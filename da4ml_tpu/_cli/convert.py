@@ -63,6 +63,8 @@ def convert(
     n_threads: int = 0,
     inputs_kif: tuple[int, int, int] | None = None,
     solver_backend: str = 'auto',
+    n_restarts: int = 1,
+    method0_candidates: list[str] | None = None,
 ):
     from ..codegen import HLSModel, RTLModel, VHDLModel
 
@@ -84,7 +86,12 @@ def convert(
         inp, out = trace_model(
             model,
             HWConfig(*hwconf),
-            {'hard_dc': hard_dc, 'backend': solver_backend},
+            {
+                'hard_dc': hard_dc,
+                'backend': solver_backend,
+                'n_restarts': n_restarts,
+                **({'method0_candidates': method0_candidates} if method0_candidates else {}),
+            },
             verbose > 1,
             inputs_kif=inputs_kif,
         )
@@ -187,6 +194,8 @@ def convert_main(args: argparse.Namespace) -> int:
         n_threads=args.n_threads,
         inputs_kif=tuple(args.inputs_kif) if args.inputs_kif else None,
         solver_backend=args.solver_backend,
+        n_restarts=args.n_restarts,
+        method0_candidates=args.methods,
     )
     return 0
 
@@ -210,4 +219,18 @@ def add_convert_args(parser: argparse.ArgumentParser):
     parser.add_argument('--inputs-kif', '-ikif', type=int, nargs=3, default=None, help='Input precision (keep_neg, int, frac)')
     parser.add_argument(
         '--solver-backend', type=str, default='auto', choices=['auto', 'cpu', 'cpp', 'jax'], help='CMVM solver backend'
+    )
+    parser.add_argument(
+        '--n-restarts',
+        type=int,
+        default=1,
+        help='Random-restart lanes per CMVM solve (jax backend): widens the sweep, argmin keeps the cheapest',
+    )
+    parser.add_argument(
+        '--methods',
+        type=str,
+        nargs='+',
+        default=None,
+        choices=['mc', 'wmc', 'mc-dc', 'mc-pdc', 'wmc-dc', 'wmc-pdc'],
+        help='Selection heuristics to sweep (replaces the default wmc; the argmin keeps the cheapest)',
     )

@@ -242,3 +242,25 @@ def test_report_finds_build_dir_reports(tmp_path):
     assert res['WNS(ns)'] == 0.237
     assert res['LUT'] == 1244
     assert res['name'] == 'rptprj'
+
+
+def test_convert_keras_quality_flags(tmp_path):
+    """--n-restarts / --methods / --solver-backend jax flow through to the solver."""
+    keras = pytest.importorskip('keras')
+    from keras import layers
+
+    rng = np.random.default_rng(7)
+    model = keras.Sequential([layers.Input((6,)), layers.Dense(4, activation='relu'), layers.Dense(2)])
+    for w in model.weights:
+        w.assign(rng.integers(-4, 4, w.shape).astype(np.float32))
+    mpath = tmp_path / 'm.keras'
+    model.save(mpath)
+    outdir = tmp_path / 'prj'
+    rc = main(
+        [
+            'convert', str(mpath), str(outdir), '-n', '32', '-ikif', '1', '3', '0', '-v', '0',
+            '--solver-backend', 'jax', '--n-restarts', '2', '--methods', 'wmc', 'mc',
+        ]
+    )  # fmt: skip
+    assert rc == 0
+    assert (outdir / 'metadata.json').exists()
