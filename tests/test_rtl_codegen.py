@@ -107,3 +107,20 @@ def test_mem_file_x_entries():
     for content in em.mem_files.values():
         lines = content.strip().splitlines()
         assert all(set(ln) <= set('0123456789abcdefx') for ln in lines)
+
+
+def test_verilog_netlist_depthwise_conv():
+    """New conv ops lower to codegen-able primitives: netlist sim == interp."""
+    from da4ml_tpu.trace.ops import depthwise_conv2d, max_pool1d
+
+    rng = np.random.default_rng(5)
+    shape = (4, 4, 2)
+    inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(shape), np.full(shape, 3), np.zeros(shape, np.int64))
+    w = rng.integers(-4, 4, (2, 2, 2, 1)).astype(np.float64)
+    y = depthwise_conv2d(x, w)  # [3, 3, 2]
+    y = max_pool1d(y.reshape(9, 2), 3)  # reuse the spatial axis as a 1-d length
+    comb = comb_trace(inp, y)
+    data = rng.uniform(-8, 8, (64, int(np.prod(shape))))
+    golden = comb.predict(data, backend='numpy')
+    np.testing.assert_array_equal(simulate_comb(comb, data=data), golden)
