@@ -81,3 +81,19 @@ def test_hls_threads_match(tmp_path):
     model = HLSModel(comb, 'kern', tmp_path).write().compile()
     golden = model.predict(DATA, n_threads=1)
     np.testing.assert_array_equal(model.predict(DATA, n_threads=8), golden)
+
+
+def test_hls_depthwise_conv(tmp_path):
+    """Depthwise conv comb compiles and matches the interpreter through g++."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+    from da4ml_tpu.trace.ops import depthwise_conv2d
+
+    rng = np.random.default_rng(5)
+    shape = (4, 4, 2)
+    inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(shape), np.full(shape, 3), np.zeros(shape, np.int64))
+    w = rng.integers(-4, 4, (2, 2, 2, 2)).astype(np.float64)
+    comb = comb_trace(inp, depthwise_conv2d(x, w))
+    model = HLSModel(comb, 'kern', tmp_path).write().compile()
+    data = rng.uniform(-8, 8, (64, int(np.prod(shape))))
+    np.testing.assert_array_equal(model.predict(data), comb.predict(data, backend='numpy'))
