@@ -290,16 +290,19 @@ class CombLogic(NamedTuple):
 
     # -------------------------------------------------------------- predict
 
-    def predict(self, data: NDArray | Sequence[NDArray], backend: str = 'auto', n_threads: int = 0) -> NDArray[np.float64]:
+    def predict(
+        self, data: NDArray | Sequence[NDArray], backend: str = 'auto', n_threads: int = 0, mesh=None
+    ) -> NDArray[np.float64]:
         """Bit-exact batch inference via a runtime backend.
 
         backend: 'auto' (native C++ if built, else numpy), 'numpy', 'cpp', 'jax'.
+        ``mesh`` (jax) shards the sample axis over a device mesh.
         """
         if isinstance(data, Sequence):
             data = np.concatenate([np.asarray(a).reshape(len(a), -1) for a in data], axis=-1)
         from ..runtime import run_comb
 
-        return run_comb(self, np.asarray(data, dtype=np.float64), backend=backend, n_threads=n_threads)
+        return run_comb(self, np.asarray(data, dtype=np.float64), backend=backend, n_threads=n_threads, mesh=mesh)
 
 
 class Pipeline(NamedTuple):
@@ -391,8 +394,8 @@ class Pipeline(NamedTuple):
         with open(path) as f:
             return cls.from_dict(json.load(f))
 
-    def predict(self, data, backend: str = 'auto', n_threads: int = 0):
+    def predict(self, data, backend: str = 'auto', n_threads: int = 0, mesh=None):
         out = np.asarray(data, dtype=np.float64)
         for stage in self.stages:
-            out = stage.predict(out, backend=backend, n_threads=n_threads)
+            out = stage.predict(out, backend=backend, n_threads=n_threads, mesh=mesh)
         return out

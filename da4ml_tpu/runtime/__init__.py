@@ -11,8 +11,18 @@ import numpy as np
 from numpy.typing import NDArray
 
 
-def run_comb(comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: int = 0) -> NDArray[np.float64]:
-    """Execute a CombLogic over a (n_samples, n_in) batch with the given backend."""
+def run_comb(
+    comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: int = 0, mesh=None
+) -> NDArray[np.float64]:
+    """Execute a CombLogic over a (n_samples, n_in) batch with the given backend.
+
+    ``mesh`` (jax backend only) shards the sample axis over a device mesh —
+    multi-chip batch inference through the top-level predict API.
+    """
+    if mesh is not None and backend not in ('jax', 'auto'):
+        raise ValueError(f"mesh sharding requires backend='jax', got {backend!r}")
+    if mesh is not None:
+        backend = 'jax'
     binary = comb.to_binary()
     if backend == 'auto':
         try:
@@ -32,7 +42,7 @@ def run_comb(comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: 
     if backend == 'jax':
         from .jax_backend import run_binary
 
-        return run_binary(binary, data)
+        return run_binary(binary, data, mesh=mesh)
     raise ValueError(f'Unknown backend {backend!r} (expected auto/numpy/cpp/jax)')
 
 

@@ -388,5 +388,8 @@ def executor_for_binary(binary: NDArray[np.int32]) -> DaisExecutor:
     return ex
 
 
-def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64]) -> NDArray[np.float64]:
-    return executor_for_binary(binary)(data)
+def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64], mesh=None) -> NDArray[np.float64]:
+    ex = executor_for_binary(binary)
+    if mesh is not None:
+        return ex.predict_sharded(data, mesh)
+    return ex(data)

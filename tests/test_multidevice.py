@@ -133,3 +133,12 @@ def test_global_mesh_and_initialize_single_host():
     ks = [rng.integers(-8, 8, (6, 6)).astype(np.float64) for _ in range(4)]
     for k, s in zip(ks, solve_jax_many(ks, mesh=mesh)):
         np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+
+
+def test_predict_mesh_through_public_api(mesh, small_comb):
+    """CombLogic.predict(mesh=...) == numpy golden (top-level multi-chip API)."""
+    data = np.random.default_rng(0).uniform(-8, 8, (24, small_comb.shape[0]))
+    golden = small_comb.predict(data, backend='numpy')
+    np.testing.assert_array_equal(small_comb.predict(data, mesh=mesh), golden)
+    with pytest.raises(ValueError, match='mesh'):
+        small_comb.predict(data, backend='cpp', mesh=mesh)
