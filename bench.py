@@ -241,8 +241,16 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
         pass
     host_backend = _resolve_host_backend()
 
+    def _with_shape_classes(entry: dict) -> dict:
+        # distinct compiled device programs this section needed (pow2 shape
+        # classes; the persistent XLA cache makes them one-time costs)
+        from da4ml_tpu.cmvm.jax_search import _build_cse_fn
+
+        entry['shape_classes'] = _build_cse_fn.cache_info().currsize
+        return entry
+
     if name == '5_full_model_trace':
-        return _run_model_config(limited, host_backend)
+        return _with_shape_classes(_run_model_config(limited, host_backend))
     if name == 'dais_inference':
         return _run_inference_micro(limited)
     if name == 'quality_sweep':
@@ -277,7 +285,7 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
         out['top4_vs_xla'] = round(out['top4_rate'] / out['xla_rate'], 3)
         out['pallas_vs_xla'] = round(out['pallas_rate'] / out['xla_rate'], 3)
         return out
-    return _run_config(name, _section_kernels(name, n1, limited), host_backend)
+    return _with_shape_classes(_run_config(name, _section_kernels(name, n1, limited), host_backend))
 
 
 _CONFIG_SECTIONS = ('1_16x16_int4', '2_jedi_mlp_layers', '3_dim_bits_sweep', '4_qconv3x3_im2col', '5_full_model_trace')
