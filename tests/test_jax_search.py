@@ -193,3 +193,42 @@ def test_top4_select_quality_vs_scan(rng, monkeypatch):
         np.testing.assert_array_equal(np.asarray(ss.kernel, np.float64), k)
     mt, ms = np.mean([s.cost for s in top4]), np.mean([s.cost for s in scan])
     assert mt <= ms * 1.03, (mt, ms)
+
+
+def test_native_emit_matches_python_emission(rng, monkeypatch):
+    """solve_single_lanes' two host tails — native emit_batch vs the Python
+    _host_state_from + to_solution path — must produce identical solutions."""
+    from da4ml_tpu.cmvm import jax_search
+
+    if not jax_search._native_emit_available():
+        pytest.skip('native emission not built')
+    kernels = [random_kernel(rng, 6, 4) for _ in range(3)]
+    native = solve_jax_many(kernels)
+    monkeypatch.setattr(jax_search, '_native_emit_available', lambda: False)
+    python = solve_jax_many(kernels)
+    for k, a, b in zip(kernels, native, python):
+        np.testing.assert_array_equal(np.asarray(a.kernel, np.float64), k)
+        assert a.cost == b.cost and a.latency == b.latency
+        for sa, sb in zip(a.stages, b.stages):
+            assert len(sa.ops) == len(sb.ops)
+            for oa, ob in zip(sa.ops, sb.ops):
+                assert (oa.id0, oa.id1, oa.opcode, oa.data, oa.qint) == (ob.id0, ob.id1, ob.opcode, ob.data, ob.qint)
+
+
+def test_decompose_batch_matches_python(rng):
+    """Native kernel decomposition == the Python reference, for every dc."""
+    from da4ml_tpu.cmvm import jax_search
+    from da4ml_tpu.cmvm.decompose import kernel_decompose
+
+    if not jax_search._native_emit_available():
+        pytest.skip('native library not built')
+    from da4ml_tpu.native.bindings import decompose_batch
+
+    kernels = [random_kernel(rng, n, 4) for n in (4, 6, 8)]
+    dcs = [-1, 0, 2]
+    native = decompose_batch(kernels, dcs)
+    for k, dc, (m0, m1) in zip(kernels, dcs, native):
+        r0, r1 = kernel_decompose(k, dc)
+        np.testing.assert_array_equal(m0, r0)
+        np.testing.assert_array_equal(m1, r1)
+        np.testing.assert_array_equal(m0 @ m1, k)
