@@ -68,8 +68,9 @@ def _np_substitute(E, cur, sub, s, i, j):
     E[cur] = (M * row_i) if i < j else (M_up * row_j)
 
 
+@pytest.mark.parametrize('select', ['xla', 'top4'])
 @pytest.mark.parametrize('seed', [0, 1, 2])
-def test_incremental_counts_match_numpy_oracle(seed):
+def test_incremental_counts_match_numpy_oracle(seed, select):
     rng = np.random.default_rng(seed)
     kernel = (rng.integers(0, 16, (6, 8)) * rng.choice([-1, 1], (6, 8))).astype(np.float64)
     csd, _, _ = csd_decompose(kernel)
@@ -77,12 +78,14 @@ def test_incremental_counts_match_numpy_oracle(seed):
     K = 10
     P = ni + K
 
-    # device path: one call, K iterations, counts carried incrementally
+    # device path: one call, K iterations; 'xla' carries counts and
+    # rescans, 'top4' maintains the O(S*P) score cache — at this scale both
+    # must reproduce the full-recount oracle's decisions exactly
     E0 = np.zeros((1, P, no, nb), np.int8)
     E0[0, :ni] = csd
     q0 = np.zeros((1, P, 3), np.float32)
     q0[:, :, 0], q0[:, :, 1], q0[:, :, 2] = -128.0, 127.0, 1.0
-    fn = _build_cse_fn(_KernelSpec(P, no, nb, -1, -1, 'xla'))
+    fn = _build_cse_fn(_KernelSpec(P, no, nb, -1, -1, select))
     E_dev, _, _, rec, cur = fn(
         jnp.asarray(E0),
         jnp.asarray(q0),
