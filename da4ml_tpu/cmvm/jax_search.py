@@ -130,6 +130,28 @@ def _iceil_log2(x):
     return jnp.where(x > 0, jnp.ceil(jnp.log2(jnp.maximum(x, 1e-37))), 0.0)
 
 
+def _select_place(dst, src, R, axis: int):
+    """Write ``src``'s slices into ``dst`` at positions ``R`` along ``axis``.
+
+    Equivalent to ``dst.at[..., R, ...].set(src)`` but lowered as one fused
+    broadcast-select pass per row of ``R`` — a vector-indexed scatter into a
+    middle axis lowers to a TPU scatter kernel that dominated the whole CSE
+    loop body (~27 of ~30 ms/iteration at P=1024). Duplicate indices in ``R``
+    carry identical payloads at every call site (their slices are computed by
+    indexing with ``R`` itself), so sequential last-write-wins matches the
+    scatter semantics.
+    """
+    iot = jnp.arange(dst.shape[axis], dtype=jnp.int32)
+    mshape = [1] * dst.ndim
+    mshape[axis] = dst.shape[axis]
+    sl = [slice(None)] * dst.ndim
+    for r in range(R.shape[0]):
+        m = (iot == R[r]).reshape(mshape)
+        sl[axis] = slice(r, r + 1)
+        dst = jnp.where(m, src[tuple(sl)], dst)
+    return dst
+
+
 def _decode_flat(flat, P: int, B: int):
     """Flat candidate index -> (sub, s, i, j), layout (sub, s, i, j) row-major."""
     sub, rem = jnp.divmod(flat, B * P * P)
@@ -267,10 +289,9 @@ def _build_cse_fn(spec: _KernelSpec):
         s1, d1 = rowC[0].astype(cdtype), rowC[1].astype(cdtype)
         s2, d2 = colC[0].astype(cdtype), colC[1].astype(cdtype)
         # rows first, then columns: the column write also refreshes the
-        # [R, R] block from the fully updated E (duplicate indices in R write
-        # identical values, so scatter order is immaterial)
-        Cs = Cs.at[:, R, :].set(s1).at[:, :, R].set(s2)
-        Cd = Cd.at[:, R, :].set(d1).at[:, :, R].set(d2)
+        # [R, R] block from the fully updated E
+        Cs = _select_place(_select_place(Cs, s1, R, 1), s2, R, 2)
+        Cd = _select_place(_select_place(Cd, d1, R, 1), d2, R, 2)
         return Cs, Cd
 
     def _s0_mask():
@@ -548,8 +569,8 @@ def _build_cse_fn(spec: _KernelSpec):
                 c_m = jnp.concatenate([tc, jnp.broadcast_to(cols3, colS.shape).astype(jnp.int32)], axis=-1)
                 tvN, tcN = _extract_topk(v_m, c_m)
                 tvR, tcR = _extract_topk(rowS, jnp.broadcast_to(iot, rowS.shape))
-                tvN = tvN.at[:, :, R].set(tvR)
-                tcN = tcN.at[:, :, R].set(tcR)
+                tvN = _select_place(tvN, tvR, R, 2)
+                tcN = _select_place(tcN, tcR, R, 2)
                 return E2, tvN, tcN, qmeta, lat, cur + 1, op_rec
 
             def no_update(args):
