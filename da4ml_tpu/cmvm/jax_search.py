@@ -676,6 +676,22 @@ def _bucket_lanes(n: int, mesh) -> int:
     return bucket
 
 
+def _fetch_digits(E) -> NDArray:
+    """Device->host fetch of an int8 digit tensor ``[n, P, O, B]``.
+
+    int8 D2H through the remote-device tunnel is ~5x slower per byte than
+    int32 (measured 6.7 vs 33 MB/s), so the tensor is bitcast-packed to
+    int32 on device (O*B is always a multiple of 4: O is a pow2 >= 8) and
+    viewed back on host. Both ends are little-endian.
+    """
+    n, P, O, B = E.shape
+    if (O * B) % 4:  # direct _build_cse_fn users with unpadded shapes
+        return np.asarray(jax.device_get(E))
+    packed = jax.lax.bitcast_convert_type(E.reshape(n, P, (O * B) // 4, 4), jnp.int32)
+    host = np.ascontiguousarray(np.asarray(jax.device_get(packed)))
+    return host.view(np.int8).reshape(n, P, O, B)
+
+
 def _as_comb(sol) -> CombLogic:
     """Materialize a solution handle (native RawComb or CombLogic)."""
     return sol if isinstance(sol, CombLogic) else sol.to_comb()
@@ -902,7 +918,7 @@ def solve_single_lanes(
                     else:
                         fin_here.append((a, x))
                 if fin_here:
-                    E_fin = np.asarray(jax.device_get(jnp.take(cE, jnp.asarray([x for _, x in fin_here]), axis=0)))
+                    E_fin = _fetch_digits(jnp.take(cE, jnp.asarray([x for _, x in fin_here]), axis=0))
                     for y, (a, _) in enumerate(fin_here):
                         st_E[a] = E_fin[y]
                 if cont_pos:
