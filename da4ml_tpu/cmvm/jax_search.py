@@ -195,7 +195,9 @@ def _build_cse_fn(spec: _KernelSpec):
 
     Lane inputs:  E0 [P,O,B] int8, qmeta0 [P,3] f32 (lo,hi,step), lat0 [P] f32,
                   cur0 [] int32 (next free slot; resumable), method [] int32
-    Lane outputs: E_final, qmeta/lat final, op records
+    Lane outputs: E_final — bitcast-packed int32 [P, O*B//4] when (O*B) % 4
+                  == 0 (view back with ``_unpack_digits``), raw int8 [P,O,B]
+                  otherwise —, qmeta/lat final, op records
                   [n_iters x (id0,id1,sub,shift)] int32, cur final [] int32.
 
     The function is *resumable*: a lane capped at ``cur == P`` can be re-entered
@@ -206,6 +208,18 @@ def _build_cse_fn(spec: _KernelSpec):
     P, O, B = spec.P, spec.O, spec.B
     n_iters = P  # op-record capacity; a call adds at most P - cur0 <= P ops
     adder_size, carry_size = spec.adder_size, spec.carry_size
+
+    def _pack_digits(E):
+        """Final digit tensor int8 [P, O, B] -> int32 [P, O*B//4].
+
+        Packed INSIDE the compiled program (free fusion, no extra XLA
+        program) because int8 D2H through the remote-device tunnel is ~5x
+        slower per byte than int32 (measured 6.7 vs 33 MB/s); the host views
+        the bytes back (``_unpack_digits``). Both ends are little-endian.
+        """
+        if (O * B) % 4:  # direct users with unpadded shapes
+            return E
+        return jax.lax.bitcast_convert_type(E.reshape(P, (O * B) // 4, 4), jnp.int32)
 
     def shifted_stack(Ef):
         """sh[p, o, s, b] = Ef[p, o, b + s] (zero beyond B) — the candidate
@@ -583,7 +597,7 @@ def _build_cse_fn(spec: _KernelSpec):
         tv0, tc0 = init_cache(E0, qmeta0, lat0, method)
         state = (E0, tv0, tc0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
         E, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
-        return E, qmeta, lat, op_rec, cur
+        return _pack_digits(E), qmeta, lat, op_rec, cur
 
     def lane_fn(E0, qmeta0, lat0, cur0, method):
         op_rec = jnp.zeros((n_iters, 4), dtype=jnp.int32)
@@ -619,7 +633,7 @@ def _build_cse_fn(spec: _KernelSpec):
         nov0, dlt0 = pair_meta(qmeta0, lat0)
         state = (E0, Cs0, Cd0, nov0, dlt0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
         E, _, _, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
-        return E, qmeta, lat, op_rec, cur
+        return _pack_digits(E), qmeta, lat, op_rec, cur
 
     return jax.jit(jax.vmap(lane_fn_top4 if spec.select == 'top4' else lane_fn))
 
@@ -676,20 +690,12 @@ def _bucket_lanes(n: int, mesh) -> int:
     return bucket
 
 
-def _fetch_digits(E) -> NDArray:
-    """Device->host fetch of an int8 digit tensor ``[n, P, O, B]``.
-
-    int8 D2H through the remote-device tunnel is ~5x slower per byte than
-    int32 (measured 6.7 vs 33 MB/s), so the tensor is bitcast-packed to
-    int32 on device (O*B is always a multiple of 4: O is a pow2 >= 8) and
-    viewed back on host. Both ends are little-endian.
-    """
-    n, P, O, B = E.shape
-    if (O * B) % 4:  # direct _build_cse_fn users with unpadded shapes
-        return np.asarray(jax.device_get(E))
-    packed = jax.lax.bitcast_convert_type(E.reshape(n, P, (O * B) // 4, 4), jnp.int32)
-    host = np.ascontiguousarray(np.asarray(jax.device_get(packed)))
-    return host.view(np.int8).reshape(n, P, O, B)
+def _unpack_digits(host: NDArray, O: int, B: int) -> NDArray:
+    """View a ``_pack_digits`` int32 fetch back as int8 ``[n, P, O, B]``."""
+    if host.dtype == np.int8:  # unpacked fallback ((O*B) % 4 != 0)
+        return host
+    n, P = host.shape[:2]
+    return np.ascontiguousarray(host).view(np.int8).reshape(n, P, O, B)
 
 
 def _as_comb(sol) -> CombLogic:
@@ -791,11 +797,17 @@ def solve_single_lanes(
 
         debug = bool(int(os.environ.get('DA4ML_JAX_DEBUG', '0') or '0'))
         pend = list(range(n_act))
-        dE = jnp.asarray(Eb)
-        dq = jnp.asarray(qb)
-        dl = jnp.asarray(lb)
-        dc_ = jnp.full((n_act,), n_in_max, dtype=jnp.int32)
-        dm = jnp.asarray(mcodes)
+        # Between rungs the search state lives on the HOST (numpy, one entry
+        # per lane), not device-resident: re-slicing device state with
+        # data-dependent shapes (take of the finished subset, pads, concats)
+        # creates a fresh tiny XLA program per shape, and through the remote
+        # compiler each costs ~1.5s on first call — ~46s of a 71s first solve
+        # at the conv config. With host-side state every device program has a
+        # fixed shape per (P, O, B, bucket) class; the extra cost is one
+        # packed full-batch fetch + re-upload per rung (~0.1s/10MB).
+        hE: list[NDArray] = [Eb[a] for a in range(n_act)]
+        hq: list[NDArray] = [qb[a] for a in range(n_act)]
+        hl: list[NDArray] = [lb[a] for a in range(n_act)]
         try:
             hbm_budget = int(float(os.environ.get('DA4ML_JAX_HBM_BUDGET', '') or (4 << 30)))
         except ValueError:
@@ -864,77 +876,60 @@ def solve_single_lanes(
                     max_lanes //= 2
 
             next_pend: list[int] = []
-            outE_parts, outq_parts, outl_parts, outc_parts, outm_parts = [], [], [], [], []
             for lo in range(0, n_pend, max_lanes):
                 hi = min(lo + max_lanes, n_pend)
+                chunk = pend[lo:hi]
                 n_chunk = hi - lo
-                if lo == 0 and n_chunk == n_pend:
-                    cE, cq, cl, cc, cm = dE, dq, dl, dc_, dm
-                else:
-                    cE, cq, cl, cc, cm = dE[lo:hi], dq[lo:hi], dl[lo:hi], dc_[lo:hi], dm[lo:hi]
                 bucket = _bucket_lanes(n_chunk, mesh)
-                pad_lane = (0, bucket - cE.shape[0])
-                pad_slot = (0, P - cE.shape[1])
-                cE = jnp.pad(cE, (pad_lane, pad_slot, (0, 0), (0, 0)))
-                lanes0, slots0 = cq.shape[0], cq.shape[1]
-                cq = jnp.pad(cq, (pad_lane, pad_slot, (0, 0)))
-                # padded rows must keep the benign-metadata invariant (step
-                # 1.0, not 0): their zero digit rows are never selectable,
-                # but scoring reads the step column unguarded
-                cq = cq.at[:, slots0:, 2].set(1.0)
-                cq = cq.at[lanes0:, :, 2].set(1.0)
-                cl = jnp.pad(cl, (pad_lane, pad_slot))
-                cc = jnp.pad(cc, pad_lane, constant_values=n_in_max)
-                cm = jnp.pad(cm, pad_lane)
-                args = (cE, cq, cl, cc, cm)
-                if sh is not None:
-                    args = tuple(jax.device_put(a, sh) for a in args)
+                # padded host arrays at the rung's exact device shape; pad
+                # rows keep the benign-metadata invariant (step 1.0, not 0):
+                # zero digit rows are never selectable, but scoring reads the
+                # step column unguarded. Padding lanes start at cur = P so
+                # their loop exits immediately.
+                cE = np.zeros((bucket, P, O, B), np.int8)
+                cq = np.zeros((bucket, P, 3), np.float32)
+                cq[:, :, 2] = 1.0
+                cl = np.zeros((bucket, P), np.float32)
+                cc = np.full((bucket,), P, np.int32)
+                cm = np.zeros((bucket,), np.int32)
+                for x, a in enumerate(chunk):
+                    pa = hE[a].shape[0]
+                    cE[x, :pa] = hE[a]
+                    cq[x, :pa] = hq[a]
+                    cl[x, :pa] = hl[a]
+                    cc[x] = st_cur[a]
+                    cm[x] = mcodes[a]
+                args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE, cq, cl, cc, cm))
 
                 if debug:
                     import time as _time
 
                     _t0 = _time.perf_counter()
-                cE, cq, cl, c_rec, cc = fn(*args)
-                cur_f = np.asarray(jax.device_get(cc))[:n_chunk]
+                oE, oq, ol, o_rec, ocur = fn(*args)
+                cur_f = np.asarray(jax.device_get(ocur))[:n_chunk]
                 if debug:
                     print(
                         f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
                         f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_time.perf_counter() - _t0:.2f}s',
                         flush=True,
                     )
-                op_rec = np.asarray(jax.device_get(c_rec))[:n_chunk]
+                op_rec = np.asarray(jax.device_get(o_rec))[:n_chunk]
+                E_all = _unpack_digits(np.asarray(jax.device_get(oE)), O, B)[:n_chunk]
+                q_all = np.asarray(jax.device_get(oq))[:n_chunk]
+                l_all = np.asarray(jax.device_get(ol))[:n_chunk]
 
-                cont_pos: list[int] = []
-                fin_here: list[tuple[int, int]] = []  # (lane index, position in chunk)
-                for x in range(n_chunk):
-                    a = pend[lo + x]
+                for x, a in enumerate(chunk):
                     c0, c1 = int(st_cur[a]), int(cur_f[x])
                     if c1 > c0:
                         recs[a].append(op_rec[x, : c1 - c0].copy())
                     st_cur[a] = c1
+                    # .copy(): a bare slice would be a view pinning the whole
+                    # bucket-sized fetch buffer until emission
                     if c1 >= P:  # budget exhausted -> resume with a larger P
                         next_pend.append(a)
-                        cont_pos.append(x)
+                        hE[a], hq[a], hl[a] = E_all[x].copy(), q_all[x].copy(), l_all[x].copy()
                     else:
-                        fin_here.append((a, x))
-                if fin_here:
-                    E_fin = _fetch_digits(jnp.take(cE, jnp.asarray([x for _, x in fin_here]), axis=0))
-                    for y, (a, _) in enumerate(fin_here):
-                        st_E[a] = E_fin[y]
-                if cont_pos:
-                    keep = jnp.asarray(cont_pos)
-                    outE_parts.append(jnp.take(cE, keep, 0))
-                    outq_parts.append(jnp.take(cq, keep, 0))
-                    outl_parts.append(jnp.take(cl, keep, 0))
-                    outc_parts.append(jnp.take(cc[:n_chunk], keep, 0))
-                    outm_parts.append(jnp.take(cm[:n_chunk], keep, 0))
-
-            if next_pend:
-                dE = jnp.concatenate(outE_parts) if len(outE_parts) > 1 else outE_parts[0]
-                dq = jnp.concatenate(outq_parts) if len(outq_parts) > 1 else outq_parts[0]
-                dl = jnp.concatenate(outl_parts) if len(outl_parts) > 1 else outl_parts[0]
-                dc_ = jnp.concatenate(outc_parts) if len(outc_parts) > 1 else outc_parts[0]
-                dm = jnp.concatenate(outm_parts) if len(outm_parts) > 1 else outm_parts[0]
+                        st_E[a] = E_all[x].copy()
             pend = next_pend
 
         emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)

@@ -20,7 +20,7 @@ jax = pytest.importorskip('jax')
 import jax.numpy as jnp  # noqa: E402
 
 from da4ml_tpu.cmvm.csd import csd_decompose  # noqa: E402
-from da4ml_tpu.cmvm.jax_search import _KernelSpec, _build_cse_fn  # noqa: E402
+from da4ml_tpu.cmvm.jax_search import _KernelSpec, _build_cse_fn, _unpack_digits  # noqa: E402
 
 
 def _full_counts(E):
@@ -117,4 +117,4 @@ def test_incremental_counts_match_numpy_oracle(seed, select):
 
     assert n_dev > 0, 'no CSE opportunity in this kernel; pick another seed'
     assert rec_dev == rec_ref
-    np.testing.assert_array_equal(np.asarray(E_dev)[0], E_ref)
+    np.testing.assert_array_equal(_unpack_digits(np.asarray(E_dev), no, nb)[0], E_ref)
