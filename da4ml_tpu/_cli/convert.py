@@ -111,10 +111,11 @@ def convert(
             comb, 'model', outdir, latency_cutoff=latency_cutoff, part=part_name,
             clock_period=clock_period, clock_uncertainty=clock_uncertainty / 100,
         )  # fmt: skip
-    elif flavor in ('vitis', 'hls'):
+    elif flavor in ('vitis', 'hls', 'hlslib', 'oneapi'):
         da_model = HLSModel(
-            comb, 'model', outdir, latency_cutoff=latency_cutoff, part=part_name, clock_period=clock_period
-        )
+            comb, 'model', outdir, latency_cutoff=latency_cutoff, part=part_name, clock_period=clock_period,
+            flavor='vitis' if flavor == 'hls' else flavor,
+        )  # fmt: skip
     else:
         raise ValueError(f'Unknown flavor: {flavor}')
 
@@ -206,7 +207,9 @@ def add_convert_args(parser: argparse.ArgumentParser):
     parser.add_argument('--n-test-sample', '-n', type=int, default=1024, help='Validation sample count (0 disables)')
     parser.add_argument('--clock-period', '-c', type=float, default=5.0, help='Clock period in ns')
     parser.add_argument('--clock-uncertainty', '-unc', type=float, default=10.0, help='Clock uncertainty in percent')
-    parser.add_argument('--flavor', type=str, default='verilog', choices=['verilog', 'vhdl', 'vitis', 'hls'])
+    parser.add_argument(
+        '--flavor', type=str, default='verilog', choices=['verilog', 'vhdl', 'vitis', 'hls', 'hlslib', 'oneapi']
+    )
     parser.add_argument('--latency-cutoff', '-lc', type=float, default=5, help='Latency cutoff for pipelining (<=0: comb)')
     parser.add_argument('--part-name', '-p', type=str, default='xcvu13p-flga2577-2-e', help='FPGA part name')
     parser.add_argument('--verbose', '-v', default=1, type=int, help='0 silent, 1 info, 2 debug')

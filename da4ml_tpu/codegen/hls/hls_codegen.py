@@ -23,10 +23,11 @@ def _i32(x: int) -> int:
 class HLSCombEmitter:
     """Emit one HLS kernel function for a CombLogic stage."""
 
-    def __init__(self, comb: CombLogic, name: str, print_latency: bool = False):
+    def __init__(self, comb: CombLogic, name: str, print_latency: bool = False, flavor: str = 'vitis'):
         self.comb = comb
         self.name = name
         self.print_latency = print_latency
+        self.flavor = flavor
         self.kifs = [minimal_kif(op.qint) for op in comb.ops]
         self.widths = [k + i + f for k, i, f in self.kifs]
         self.tables: dict[int, str] = {}
@@ -132,11 +133,13 @@ class HLSCombEmitter:
         comb = self.comb
         rc = comb.ref_count
         n_in, n_out = comb.shape
-        lines = [
-            f'static void {self.name}(const int64_t in[{max(n_in, 1)}], int64_t out[{max(n_out, 1)}]) {{',
-            '#pragma HLS INLINE off',
-            '#pragma HLS PIPELINE II=1',
-        ]
+        lines = [f'static void {self.name}(const int64_t in[{max(n_in, 1)}], int64_t out[{max(n_out, 1)}]) {{']
+        if self.flavor == 'vitis':
+            lines += ['#pragma HLS INLINE off', '#pragma HLS PIPELINE II=1']
+        # Intel flavors: II is a component-level property (hls_component_ii on
+        # the synthesis top, hls_model._write_synth_files), not a body pragma
+        # — Intel's `#pragma ii` binds to the loop that follows it, and these
+        # bodies are loop-free straight-line code.
         for n in range(len(comb.ops)):
             if rc[n] == 0:
                 continue
@@ -151,10 +154,16 @@ class HLSCombEmitter:
         return '\n'.join(lines)
 
 
-def emit_hls_kernel(model: CombLogic | Pipeline, name: str, print_latency: bool = False) -> str:
-    """Emit the full kernel header: helpers include, tables, stage fns, top fn."""
+def emit_hls_kernel(model: CombLogic | Pipeline, name: str, print_latency: bool = False, flavor: str = 'vitis') -> str:
+    """Emit the full kernel header: helpers include, tables, stage fns, top fn.
+
+    ``flavor`` selects the synthesis-tool dialect of the wrapping only
+    (vitis / hlslib / oneapi, reference hls_model.py:45); the kernel body is
+    the same explicit int64 integer code for all three, so g++ emulation and
+    bit-exactness are flavor-independent.
+    """
     stages = model.stages if isinstance(model, Pipeline) else (model,)
-    emitters = [HLSCombEmitter(s, f'{name}_s{si}', print_latency) for si, s in enumerate(stages)]
+    emitters = [HLSCombEmitter(s, f'{name}_s{si}', print_latency, flavor) for si, s in enumerate(stages)]
     fns = [em.emit_function() for em in emitters]
 
     n_in = stages[0].shape[0]
@@ -172,7 +181,7 @@ def emit_hls_kernel(model: CombLogic | Pipeline, name: str, print_latency: bool 
     lines.extend(fns)
     lines.append('')
     lines.append(f'inline void {name}(const int64_t in[{max(n_in, 1)}], int64_t out[{max(n_out, 1)}]) {{')
-    if len(stages) > 1:
+    if len(stages) > 1 and flavor == 'vitis':
         lines.append('#pragma HLS dataflow')
     buf = 'in'
     for si, stage in enumerate(stages):

@@ -1,9 +1,10 @@
 """HLS project writer: C++ kernel emission, g++-compiled bit-exact emulation,
-and a Vitis csynth script.
+and a per-flavor synthesis harness (Vitis HLS / Intel HLS / oneAPI).
 
     <path>/
       src/           {name}.hh kernel + dais_hls.hh helpers + bridge.cc
-      tcl/           Vitis HLS csynth script
+                     + hls_top.cc (vitis/hlslib) or hls_top_oneapi.cpp
+      tcl/           build_vitis.tcl, build_hlslib.sh or build_oneapi.sh
       model/         comb.json / pipeline.json (reloadable IR)
       metadata.json
 
@@ -34,9 +35,16 @@ _SRC_DIR = Path(__file__).parent / 'source'
 
 
 class HLSModel:
-    """Write, build and drive one HLS C++ project for a DAIS program."""
+    """Write, build and drive one HLS C++ project for a DAIS program.
 
-    flavor = 'vitis'
+    ``flavor`` selects the synthesis dialect (reference hls_model.py:45):
+    'vitis' (AMD Vitis HLS: HLS pragmas + Vitis TCL), 'hlslib' (Intel HLS
+    compiler: ``component`` top, ii pragma, i++ build script) or 'oneapi'
+    (Intel oneAPI: SYCL single_task harness, icpx build script). The kernel
+    body and the g++ emulation bridge are identical across flavors — the
+    explicit int64 integer code replaces the reference's per-flavor
+    ap_fixed/ac_fixed type libraries, so bit-exactness is flavor-independent.
+    """
 
     def __init__(
         self,
@@ -47,7 +55,12 @@ class HLSModel:
         print_latency: bool = False,
         part: str = 'xcvu13p-flga2577-2-e',
         clock_period: float = 5.0,
+        flavor: str = 'vitis',
     ):
+        flavor = flavor.lower()
+        if flavor not in ('vitis', 'hlslib', 'oneapi'):
+            raise ValueError(f'unsupported HLS flavor {flavor!r}; expected vitis, hlslib or oneapi')
+        self.flavor = flavor
         if isinstance(solution, CombLogic) and latency_cutoff > 0:
             from ...trace.pipeline import to_pipeline
 
@@ -84,7 +97,7 @@ class HLSModel:
     def write(self) -> 'HLSModel':
         src = self.path / 'src'
         src.mkdir(parents=True, exist_ok=True)
-        (src / f'{self.name}.hh').write_text(emit_hls_kernel(self.solution, self.name, self.print_latency))
+        (src / f'{self.name}.hh').write_text(emit_hls_kernel(self.solution, self.name, self.print_latency, self.flavor))
         shutil.copy(_SRC_DIR / 'dais_hls.hh', src / 'dais_hls.hh')
         (src / 'bridge.cc').write_text(self._emit_bridge())
 
@@ -94,32 +107,7 @@ class HLSModel:
         else:
             self.solution.save(self.path / 'model' / 'comb.json')
 
-        tdir = self.path / 'tcl'
-        tdir.mkdir(exist_ok=True)
-        (tdir / 'build_vitis.tcl').write_text(
-            f"""open_project -reset {self.name}_prj
-set_top {self.name}_top
-add_files src/{self.name}.hh
-add_files src/dais_hls.hh
-add_files src/hls_top.cc
-open_solution -reset sol1
-set_part {self.part}
-create_clock -period {self.clock_period}
-csynth_design
-export_design -format ip_catalog
-"""
-        )
-        n_in = self.solution.shape[0]
-        n_out = self.solution.shape[1]
-        (src / 'hls_top.cc').write_text(
-            f'// Synthesis top: array interface around the inlined kernel.\n'
-            f'#include "{self.name}.hh"\n'
-            f'extern "C" void {self.name}_top(const int64_t in[{max(n_in, 1)}], int64_t out[{max(n_out, 1)}]) {{\n'
-            f'#pragma HLS INTERFACE mode=ap_memory port=in\n'
-            f'#pragma HLS INTERFACE mode=ap_memory port=out\n'
-            f'    {self.name}(in, out);\n'
-            f'}}\n'
-        )
+        self._write_synth_files(src)
 
         lat_lo, lat_hi = self.solution.latency
         metadata = {
@@ -136,6 +124,86 @@ export_design -format ip_catalog
         }
         (self.path / 'metadata.json').write_text(json.dumps(metadata, indent=2))
         return self
+
+    def _write_synth_files(self, src: Path) -> None:
+        """Per-flavor synthesis top + build script (the emulation path above
+        is shared). Vendor tools are optional: scripts are emitted for use on
+        a machine that has them (reference parity: hls_model.py:117-123)."""
+        n_in = max(self.solution.shape[0], 1)
+        n_out = max(self.solution.shape[1], 1)
+        tdir = self.path / 'tcl'
+        tdir.mkdir(exist_ok=True)
+        if self.flavor == 'vitis':
+            (tdir / 'build_vitis.tcl').write_text(
+                f"""open_project -reset {self.name}_prj
+set_top {self.name}_top
+add_files src/{self.name}.hh
+add_files src/dais_hls.hh
+add_files src/hls_top.cc
+open_solution -reset sol1
+set_part {self.part}
+create_clock -period {self.clock_period}
+csynth_design
+export_design -format ip_catalog
+"""
+            )
+            (src / 'hls_top.cc').write_text(
+                f'// Synthesis top: array interface around the inlined kernel.\n'
+                f'#include "{self.name}.hh"\n'
+                f'extern "C" void {self.name}_top(const int64_t in[{n_in}], int64_t out[{n_out}]) {{\n'
+                f'#pragma HLS INTERFACE mode=ap_memory port=in\n'
+                f'#pragma HLS INTERFACE mode=ap_memory port=out\n'
+                f'    {self.name}(in, out);\n'
+                f'}}\n'
+            )
+        elif self.flavor == 'hlslib':
+            (src / 'hls_top.cc').write_text(
+                f'// Intel HLS synthesis top: a component function (II pinned\n'
+                f'// at the component level; the kernel body is loop-free).\n'
+                f'#include "{self.name}.hh"\n'
+                f'#include <HLS/hls.h>\n'
+                f'hls_component_ii(1) component void {self.name}_top(const int64_t in[{n_in}], int64_t out[{n_out}]) {{\n'
+                f'    {self.name}(in, out);\n'
+                f'}}\n'
+            )
+            (tdir / 'build_hlslib.sh').write_text(
+                f'#!/bin/sh\n# Intel HLS compiler flow (run where i++ is installed)\n'
+                f'i++ -march="{self._intel_target()}" --clock {self.clock_period}ns -I src src/hls_top.cc -o {self.name}_prj\n'
+            )
+        else:  # oneapi
+            (src / 'hls_top_oneapi.cpp').write_text(
+                f'// oneAPI FPGA synthesis harness: SYCL single_task around the kernel.\n'
+                f'#include <sycl/sycl.hpp>\n'
+                f'#include "{self.name}.hh"\n'
+                f'class {self.name}_kernel;\n'
+                f'void {self.name}_top(sycl::queue& q, sycl::buffer<int64_t, 1>& b_in, sycl::buffer<int64_t, 1>& b_out) {{\n'
+                f'    q.submit([&](sycl::handler& h) {{\n'
+                f'        auto acc_in = b_in.get_access<sycl::access::mode::read>(h);\n'
+                f'        auto acc_out = b_out.get_access<sycl::access::mode::write>(h);\n'
+                f'        h.single_task<{self.name}_kernel>([=]() {{\n'
+                f'            int64_t in[{n_in}], out[{n_out}];\n'
+                f'            for (int e = 0; e < {n_in}; ++e) in[e] = acc_in[e];\n'
+                f'            {self.name}(in, out);\n'
+                f'            for (int e = 0; e < {n_out}; ++e) acc_out[e] = out[e];\n'
+                f'        }});\n'
+                f'    }});\n'
+                f'}}\n'
+            )
+            (tdir / 'build_oneapi.sh').write_text(
+                f'#!/bin/sh\n# oneAPI FPGA flow (run where icpx is installed)\n'
+                f'icpx -fsycl -fintelfpga -Xshardware -Xstarget="{self._intel_target()}" '
+                f'-I src src/hls_top_oneapi.cpp -o {self.name}_prj\n'
+            )
+
+    def _intel_target(self) -> str:
+        """Device target for the Intel flavors' build scripts.
+
+        The class default ``part`` is an AMD Virtex part (the reference's
+        default synthesis target); i++/icpx would reject it, so Intel-flavor
+        scripts fall back to an Intel FPGA family unless the caller passed an
+        Intel part explicitly.
+        """
+        return 'Agilex7' if self.part.startswith(('xc', 'XC')) else self.part
 
     def _emit_bridge(self) -> str:
         in_f, in_w, in_s, out_f = self._io_consts()

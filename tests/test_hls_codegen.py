@@ -76,6 +76,38 @@ def test_hls_project_files(tmp_path):
     assert '#pragma HLS PIPELINE II=1' in text
 
 
+@pytest.mark.parametrize('flavor', ['vitis', 'hlslib', 'oneapi'])
+def test_hls_flavors(flavor, tmp_path):
+    """Every flavor (reference hls_model.py:45) writes its synthesis harness
+    and stays bit-exact through the shared g++ emulation bridge."""
+    comb = _trace(CASES['sum'][0])
+    model = HLSModel(comb, 'kern', tmp_path, flavor=flavor).write().compile()
+    np.testing.assert_array_equal(model.predict(DATA, backend='emu'), comb.predict(DATA, backend='numpy'))
+    text = (tmp_path / 'src' / 'kern.hh').read_text()
+    if flavor == 'vitis':
+        assert (tmp_path / 'src' / 'hls_top.cc').exists()
+        assert (tmp_path / 'tcl' / 'build_vitis.tcl').exists()
+        assert '#pragma HLS PIPELINE II=1' in text
+    elif flavor == 'hlslib':
+        top = (tmp_path / 'src' / 'hls_top.cc').read_text()
+        assert 'hls_component_ii(1) component void' in top
+        assert (tmp_path / 'tcl' / 'build_hlslib.sh').exists()
+        assert '#pragma HLS' not in text
+    else:
+        assert 'single_task' in (tmp_path / 'src' / 'hls_top_oneapi.cpp').read_text()
+        assert (tmp_path / 'tcl' / 'build_oneapi.sh').exists()
+        assert '#pragma HLS' not in text
+    import json
+
+    assert json.loads((tmp_path / 'metadata.json').read_text())['flavor'] == flavor
+
+
+def test_hls_flavor_rejected(tmp_path):
+    comb = _trace(CASES['sum'][0])
+    with pytest.raises(ValueError, match='flavor'):
+        HLSModel(comb, 'kern', tmp_path, flavor='catapult')
+
+
 def test_hls_threads_match(tmp_path):
     comb = _trace(CASES['matmul_frac'][0])
     model = HLSModel(comb, 'kern', tmp_path).write().compile()
