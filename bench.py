@@ -191,14 +191,27 @@ def _run_inference_micro(limited: bool):
     out_dev = ex(data)
     dev_t = time.perf_counter() - t0
 
+    # device-resident rate: input already on device, output not fetched —
+    # the steady state when inference feeds another device computation (the
+    # end-to-end rate above is dominated by tunnel transfers on this setup)
+    import jax
+
+    x_dev = jax.device_put(ex._int_inputs(data))
+    jax.block_until_ready(ex.fn_int(x_dev))
+    t0 = time.perf_counter()
+    jax.block_until_ready(ex.fn_int(x_dev))
+    res_t = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     out_host = comb.predict(data, n_threads=HOST_THREADS)
     host_t = time.perf_counter() - t0
     return {
         'n_samples': n_samples,
         'device_rate': round(n_samples / dev_t, 1),
+        'device_resident_rate': round(n_samples / res_t, 1),
         'host_rate': round(n_samples / host_t, 1),
         'speedup': round(host_t / dev_t, 3),
+        'speedup_resident': round(host_t / res_t, 3),
         'bit_exact': bool(np.array_equal(out_dev, out_host)),
     }
 
@@ -321,7 +334,7 @@ def main():
 
     # wall-clock budget: degrade to fewer sections rather than timing out
     # without printing the JSON line
-    budget_s = float(os.environ.get('DA4ML_BENCH_BUDGET_S', '420'))
+    budget_s = float(os.environ.get('DA4ML_BENCH_BUDGET_S', '540'))
     deadline = time.monotonic() + budget_s
 
     # Every section runs in its own bounded subprocess: a device hang or a
