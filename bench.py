@@ -314,7 +314,7 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
 
 
 _CONFIG_SECTIONS = ('1_16x16_int4', '2_jedi_mlp_layers', '3_dim_bits_sweep', '4_qconv3x3_im2col', '5_full_model_trace')
-_MICRO_SECTIONS = ('quality_sweep', 'dais_inference', 'select_modes')
+_MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference')
 
 
 def main():
@@ -334,7 +334,7 @@ def main():
 
     # wall-clock budget: degrade to fewer sections rather than timing out
     # without printing the JSON line
-    budget_s = float(os.environ.get('DA4ML_BENCH_BUDGET_S', '540'))
+    budget_s = float(os.environ.get('DA4ML_BENCH_BUDGET_S', '600'))
     deadline = time.monotonic() + budget_s
 
     # Every section runs in its own bounded subprocess: a device hang or a
