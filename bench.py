@@ -282,13 +282,23 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
         from da4ml_tpu.cmvm.jax_search import solve_jax_many
 
         k1 = _section_kernels('1_16x16_int4', n1, limited)
+        host_sols, _ = _host_solve(k1, host_backend)
+        host_costs = np.asarray([s.cost for s in host_sols])
         single = solve_jax_many(k1)
         t0 = time.perf_counter()
-        wide = solve_jax_many(k1, method0_candidates=['wmc', 'mc'], n_restarts=2 if limited else 4)
+        methods = ['wmc', 'mc'] if limited else ['wmc', 'mc', 'wmc-dc']
+        wide = solve_jax_many(k1, method0_candidates=methods, n_restarts=2 if limited else 6)
+        wall = time.perf_counter() - t0
+        wide_costs = np.asarray([s.cost for s in wide])
         return {
-            'mean_cost_wide': round(float(np.mean([s.cost for s in wide])), 3),
+            'mean_cost_wide': round(float(wide_costs.mean()), 3),
             'mean_cost_single': round(float(np.mean([s.cost for s in single])), 3),
-            'wall_s': round(time.perf_counter() - t0, 2),
+            'mean_cost_host': round(float(host_costs.mean()), 3),
+            # per-matrix comparison vs the reference solver; include_host=True
+            # (the portfolio mode) makes win_or_tie n/n by construction
+            'win_or_tie': f'{int((wide_costs <= host_costs).sum())}/{len(k1)}',
+            'strict_win': f'{int((wide_costs < host_costs).sum())}/{len(k1)}',
+            'wall_s': round(wall, 2),
         }
     if name == 'select_modes':
         # selection-mode microbench: top4 (default, O(S*P) score cache) vs

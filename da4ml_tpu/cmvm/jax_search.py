@@ -1122,6 +1122,7 @@ def solve_jax_many(
     mesh=None,
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
+    include_host: bool = False,
 ) -> list[Pipeline]:
     """Batched CMVM solve: all (matrix × dc candidate) stage-0 searches run as
     one device batch, then all stage-1 searches. The argmin over dc candidates
@@ -1137,7 +1138,13 @@ def solve_jax_many(
       trajectories exactly the way a different scan order changes the
       host's; every restart stays exact (the emitted solution is renumbered
       back to the original input order), so the argmin can only improve
-      cost."""
+      cost.
+    - ``include_host``: fold the native solver's solution into each
+      matrix's argmin. The device search's greedy tie-breaks differ from
+      the host scan order, so individual matrices can come out a few
+      adders better or worse; with the host lane in the portfolio the
+      result is never worse than the reference solver per matrix, at the
+      price of one serial host solve each."""
     from .decompose import kernel_decompose
 
     kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
@@ -1152,26 +1159,32 @@ def solve_jax_many(
     # device path for the shapes it is actually good at.
     routed: dict[int, Pipeline] = {}
     pmax = _pmax()
+
+    def _solve_on_host(mi: int) -> Pipeline:
+        """One equivalently-parameterized reference solve (shared by the
+        pre-route fallback and the include_host portfolio lane, so the two
+        cannot drift). Sequential dc sweep: opting into the fork-based pool
+        here would fork a process whose XLA runtime is already live."""
+        return _host_api.solve(
+            kernels[mi],
+            method0=method0,
+            method1=method1,
+            hard_dc=hard_dc,
+            decompose_dc=decompose_dc,
+            qintervals=qintervals_list[mi],
+            latencies=latencies_list[mi],
+            adder_size=adder_size,
+            carry_size=carry_size,
+            search_all_decompose_dc=search_all_decompose_dc,
+            backend='auto',
+            method0_candidates=method0_candidates,
+        )
+
     for mi, kern in enumerate(kernels):
         digits = int((csd_decompose(kern)[0] != 0).sum())
         if kern.shape[0] + digits // 2 > pmax:
             search_stats['pmax_host_fallbacks'] += 1
-            routed[mi] = _host_api.solve(
-                kern,
-                method0=method0,
-                method1=method1,
-                hard_dc=hard_dc,
-                decompose_dc=decompose_dc,
-                qintervals=qintervals_list[mi],
-                latencies=latencies_list[mi],
-                adder_size=adder_size,
-                carry_size=carry_size,
-                search_all_decompose_dc=search_all_decompose_dc,
-                # sequential dc sweep: opting into the fork-based pool here
-                # would fork a process whose XLA runtime is already live
-                backend='auto',
-                method0_candidates=method0_candidates,
-            )
+            routed[mi] = _solve_on_host(mi)
 
     # In sweep mode the host driver resolves methods against the effective
     # budget 10^9 when hard_dc < 0 (api.py solve -> _solve), which turns
@@ -1295,4 +1308,12 @@ def solve_jax_many(
         if best_sols[mi] is None:
             search_stats['over_budget_accepts'] += 1
         results.append(Pipeline(stages=(_as_comb(pair[0]), _as_comb(pair[1]))))
+
+    if include_host:
+        for mi in range(n_mat):
+            if mi in routed:  # already a host solution
+                continue
+            host_sol = _solve_on_host(mi)
+            if float(host_sol.cost) < float(results[mi].cost):
+                results[mi] = host_sol
     return results

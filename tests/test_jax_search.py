@@ -111,6 +111,20 @@ def test_method_candidates_quality(rng):
         assert w.cost <= b.cost, (w.cost, b.cost)
 
 
+def test_include_host_portfolio(rng):
+    """include_host folds the native solver into the argmin: the result can
+    never cost more than the reference solver's per matrix, and exactness
+    holds regardless of which lane wins."""
+    from da4ml_tpu.cmvm import api as host_api
+
+    kernels = [random_kernel(rng, 8, 4) for _ in range(4)]
+    host = [host_api.solve(k, backend='auto') for k in kernels]
+    port = solve_jax_many(kernels, include_host=True)
+    for k, h, p in zip(kernels, host, port):
+        np.testing.assert_array_equal(np.asarray(p.kernel, np.float64), k)
+        assert p.cost <= h.cost, (p.cost, h.cost)
+
+
 def test_method_candidates_via_solver_options(rng):
     """method0_candidates routes through solver_options on every backend."""
     from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
