@@ -211,6 +211,22 @@ class KerasTracer(TracerPluginBase):
             for v in vals[1:]:
                 out = fn(out, v)
             return out
+        if name == 'Multiply':
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            out = vals[0]
+            for v in vals[1:]:
+                out = out * v  # variable x variable -> explicit multiplier ops
+            return out
+        if name in ('Cropping1D', 'Cropping2D'):
+            if getattr(layer, 'data_format', 'channels_last') != 'channels_last':
+                raise NotImplementedError('Only channels_last cropping is supported')
+            crop = layer.cropping
+            if name == 'Cropping1D':
+                (lo, hi) = crop
+                return args[0][lo : args[0].shape[0] - hi]
+            (t, b), (lft, r) = crop
+            x = args[0]
+            return x[t : x.shape[0] - b, lft : x.shape[1] - r]
         if name == 'Average':
             vals = args[0] if isinstance(args[0], (list, tuple)) else args
             out = vals[0]

@@ -210,6 +210,22 @@ class TorchTracer(TracerPluginBase):
             return args[0].reshape(-1)
         if fn in (torch.matmul,):
             return args[0] @ args[1]
+        if fn is operator.getitem:
+            # slicing/cropping: model tensors are batched [N, ...], traced
+            # arrays are per-sample — only a [:, ...] tuple (full slice on
+            # the batch axis, then feature-axis slices) maps cleanly. A bare
+            # x[0] / x[2:5] would index the batch axis: not traceable.
+            idx = args[1]
+            if not (isinstance(idx, tuple) and idx and idx[0] == slice(None)):
+                raise NotImplementedError('Indexing that touches the batch axis is not traceable')
+            return args[0][idx[1:]]
+        if fn in (torch.maximum, torch.max, torch.minimum, torch.min) and len(args) == 2:
+            # elementwise two-tensor form only; torch.max(y, dim) is a
+            # reduction returning (values, indices) — reject int dims rather
+            # than silently clamping elementwise
+            if not hasattr(args[1], 'ndim'):
+                raise NotImplementedError('torch.max/min with a dim argument is not supported; use elementwise maximum/minimum')
+            return (np.maximum if fn in (torch.maximum, torch.max) else np.minimum)(args[0], args[1])
         raise NotImplementedError(f'Function {getattr(fn, "__name__", fn)!r} is not supported by the torch tracer')
 
     # ------------------------------------------------------------ model walk

@@ -93,7 +93,7 @@ def _make_comb():
     return comb_trace(inp, (x @ w).relu(i=np.full(4, 6), f=np.full(4, 2)))
 
 
-@pytest.mark.parametrize('flavor', ['verilog', 'vhdl', 'vitis'])
+@pytest.mark.parametrize('flavor', ['verilog', 'vhdl', 'vitis', 'hlslib', 'oneapi'])
 def test_convert_from_json(tmp_path, flavor):
     comb = _make_comb()
     model_json = tmp_path / 'comb.json'

@@ -53,6 +53,31 @@ def test_keras_functional_residual(rng):
     np.testing.assert_array_equal(out, ref)
 
 
+def test_keras_multiply_cropping(rng):
+    from keras import layers
+
+    i = keras.Input((8, 8, 2))
+    a = layers.Cropping2D(((1, 1), (2, 1)))(i)
+    b = layers.Cropping2D(((1, 1), (2, 1)))(i)
+    m = layers.Multiply()([a, b])
+    o = layers.Flatten()(m)
+    model = keras.Model(i, o)
+    data = rng.integers(-4, 4, (8, 8, 8, 2)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).reshape(8, -1).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_keras_cropping1d(rng):
+    from keras import layers
+
+    model = keras.Sequential([keras.Input((10, 2)), layers.Cropping1D((2, 3)), layers.Flatten()])
+    data = rng.integers(-4, 4, (8, 10, 2)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).reshape(8, -1).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_keras_conv2d_model(rng):
     from keras import layers
 
@@ -307,6 +332,28 @@ def test_torch_depthwise_pad_upsample(rng):
         mb = torch.nn.Sequential(model.pad, model.dw, model.act, model.up, model.pool)
         ref = mb(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
     np.testing.assert_array_equal(out, ref.reshape(6, -1))
+
+
+class _TorchSliceMax(torch.nn.Module):
+    input_shape = (8,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(8, 8)
+
+    def forward(self, x):
+        y = self.fc(x)
+        return torch.maximum(y[:, :4], y[:, 4:])
+
+
+def test_torch_getitem_maximum(rng):
+    model = _TorchSliceMax()
+    _int_weights_torch(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (6, 8)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = model(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
 
 
 class _TorchPool1d(torch.nn.Module):

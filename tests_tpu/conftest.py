@@ -13,6 +13,12 @@ import sys
 import numpy as np
 import pytest
 
+# Pallas kernel tracing stacks ~900 Python frames on top of pytest's own
+# (assertion rewriting adds more); mid-suite that exceeds the default 1000
+# recursion limit while the same test passes in isolation. Headroom, not a
+# behavioral change.
+sys.setrecursionlimit(max(sys.getrecursionlimit(), 10_000))
+
 
 def _tpu_backend() -> bool:
     """Bounded-subprocess probe: TPU plugin init can hang, not just fail."""
