@@ -68,6 +68,37 @@ def _np_substitute(E, cur, sub, s, i, j):
     E[cur] = (M * row_i) if i < j else (M_up * row_j)
 
 
+@pytest.mark.parametrize('dup', [False, True])
+def test_select_place_matches_scatter(dup):
+    """_select_place is the loop body's replacement for vector-indexed mid-axis
+    scatters (a TPU scatter kernel dominated the whole iteration); it must be
+    value-identical to `.at[...].set` for distinct and duplicate row indices
+    (duplicates always carry identical payload slices at the call sites)."""
+    from da4ml_tpu.cmvm.jax_search import _select_place
+
+    rng = np.random.default_rng(3)
+    S, P, K = 4, 16, 5
+    base = jnp.asarray(rng.standard_normal((2, S, P, K)).astype(np.float32))
+    R_np = np.asarray([2, 2, 9] if dup else [2, 7, 9], np.int32)
+    src_np = rng.standard_normal((2, S, 3, K)).astype(np.float32)
+    if dup:  # duplicate indices must carry identical payloads (call-site invariant)
+        src_np[:, :, 1] = src_np[:, :, 0]
+    src = jnp.asarray(src_np)
+    R = jnp.asarray(R_np)
+    got = _select_place(base, src, R, 2)
+    want = base.at[:, :, R_np].set(src)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    base3 = jnp.asarray(rng.standard_normal((S, P, P)).astype(np.float32))
+    srcr_np = rng.standard_normal((S, 3, P)).astype(np.float32)
+    if dup:
+        srcr_np[:, 1] = srcr_np[:, 0]
+    srcr = jnp.asarray(srcr_np)
+    np.testing.assert_array_equal(
+        np.asarray(_select_place(base3, srcr, R, 1)), np.asarray(base3.at[:, R_np, :].set(srcr))
+    )
+
+
 @pytest.mark.parametrize('select', ['xla', 'top4'])
 @pytest.mark.parametrize('seed', [0, 1, 2])
 def test_incremental_counts_match_numpy_oracle(seed, select):
