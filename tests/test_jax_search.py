@@ -95,6 +95,28 @@ def test_jax_predict_bit_exact(rng):
     np.testing.assert_array_equal(sol.predict(x, backend='numpy'), x @ kernel)
 
 
+@pytest.mark.parametrize('seed', [0, 1])
+def test_jax_heterogeneous_qintervals_fuzz(seed):
+    """Exactness under fuzzed per-row qintervals/latencies and finite
+    adder/carry sizes — the f32 scoring metadata on device must never leak
+    into the emitted (f64-rederived) op metadata."""
+    rng = np.random.default_rng(1000 + seed)
+    kernels, qints_l, lats_l = [], [], []
+    for _ in range(4):
+        n_in = int(rng.integers(3, 9))
+        kernels.append(random_kernel(rng, n_in, int(rng.integers(2, 6))))
+        frac = 2.0 ** -rng.integers(0, 4, n_in)
+        lo = -rng.integers(1, 128, n_in).astype(np.float64) * frac
+        hi = rng.integers(1, 128, n_in).astype(np.float64) * frac
+        qints_l.append([QInterval(float(lo[i]), float(hi[i]), float(frac[i])) for i in range(n_in)])
+        lats_l.append([float(v) for v in rng.integers(0, 4, n_in)])
+    sols = solve_jax_many(
+        kernels, qintervals_list=qints_l, latencies_list=lats_l, adder_size=int(rng.integers(2, 9)), carry_size=8
+    )
+    for k, s in zip(kernels, sols):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+
+
 def test_backend_dispatch(rng):
     kernel = random_kernel(rng, 4, 3)
     sol = solve(kernel, backend='jax')
