@@ -113,8 +113,14 @@ def test_jax_heterogeneous_qintervals_fuzz(seed):
     sols = solve_jax_many(
         kernels, qintervals_list=qints_l, latencies_list=lats_l, adder_size=int(rng.integers(2, 9)), carry_size=8
     )
-    for k, s in zip(kernels, sols):
+    for k, s, qints in zip(kernels, sols, qints_l):
         np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+        # inputs on each row's exact qinterval grid; predict must be bit-exact
+        # (this is what would break if the device's f32 scoring metadata ever
+        # leaked into the emitted op metadata instead of the f64 rederivation)
+        cols = [q.step * rng.integers(round(q.min / q.step), round(q.max / q.step) + 1, 32) for q in qints]
+        x = np.stack(cols, axis=1).astype(np.float64)
+        np.testing.assert_array_equal(s.predict(x, backend='numpy'), x @ k)
 
 
 def test_backend_dispatch(rng):
