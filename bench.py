@@ -39,7 +39,12 @@ def probe_tpu(attempts: int = 2, timeout: float = 90.0):
 
     The probe inherits the parent environment unchanged, so the platform it
     reports is the one the timed run below will actually initialize.
+    ``DA4ML_BENCH_PLATFORM=cpu`` skips probing entirely (explicit override).
+    A probe *timeout* (wedged tunnel, can stay down for hours) is not
+    retried — only fast init errors are, matching the round-1 failure mode.
     """
+    if os.environ.get('DA4ML_BENCH_PLATFORM') == 'cpu':
+        return None, 'platform forced to cpu (DA4ML_BENCH_PLATFORM)'
     err = None
     for i in range(attempts):
         try:
@@ -55,7 +60,7 @@ def probe_tpu(attempts: int = 2, timeout: float = 90.0):
             tail = (r.stderr or '').strip().splitlines()
             err = (tail[-1] if tail else f'probe rc={r.returncode}')[:300]
         except subprocess.TimeoutExpired:
-            err = f'TPU init probe timed out after {timeout:.0f}s'
+            return None, f'TPU init probe timed out after {timeout:.0f}s (wedged tunnel; not retried)'
         if i + 1 < attempts:
             time.sleep(10.0 * (i + 1))
     return None, err
@@ -331,14 +336,16 @@ def main():
     n1 = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     detail: dict = {'host_threads': HOST_THREADS, 'nproc': os.cpu_count()}
 
+    forced_cpu = os.environ.get('DA4ML_BENCH_PLATFORM') == 'cpu'
     platform, probe_err = probe_tpu()
     limited = platform is None
     is_tpu = platform not in (None, 'cpu')  # a 'cpu' platform is a valid host, not a TPU
     if limited:
-        detail['tpu_error'] = probe_err
+        # a deliberate cpu run is not a TPU failure — report it separately
+        detail['platform_forced' if forced_cpu else 'tpu_error'] = probe_err
         os.environ['DA4ML_BENCH_PLATFORM'] = 'cpu'
         os.environ['JAX_PLATFORMS'] = 'cpu'
-    detail['platform'] = platform or 'cpu-fallback'
+    detail['platform'] = platform or ('cpu-forced' if forced_cpu else 'cpu-fallback')
     detail['host_backend'] = _resolve_host_backend()
     detail['limited_cpu_fallback'] = limited
 
