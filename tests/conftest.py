@@ -10,6 +10,9 @@ import os
 # the JAX_PLATFORMS env var); override via jax.config so tests run on the
 # virtual CPU mesh instead of contending for the real chip.
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# single-threaded native runtime for deterministic tests regardless of the
+# invoking environment (the reference pins this in pyproject; CI also sets it)
+os.environ.setdefault('DA_DEFAULT_THREADS', '1')
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()
