@@ -95,6 +95,27 @@ def test_jax_predict_bit_exact(rng):
     np.testing.assert_array_equal(sol.predict(x, backend='numpy'), x @ kernel)
 
 
+def test_hbm_chunked_lanes_identical(rng, monkeypatch, capsys):
+    """A tiny device-memory budget forces the lane batch through multiple
+    sequential chunks of the same compiled program; results must be
+    byte-identical to the unchunked solve (same decisions, same ops)."""
+    kernels = [random_kernel(rng, 6, 4) for _ in range(6)]
+    base = solve_jax_many(kernels)
+    monkeypatch.setenv('DA4ML_JAX_HBM_BUDGET', str(1 << 20))
+    monkeypatch.setenv('DA4ML_JAX_DEBUG', '1')
+    chunked = solve_jax_many(kernels)
+    rounds = [ln for ln in capsys.readouterr().out.splitlines() if '[jax_search] round' in ln]
+    # at least one rung must have split its lanes (a chunk starting past 0)
+    assert any(not ln.split('chunk=')[1].startswith('0+') for ln in rounds), rounds
+    for k, b, c in zip(kernels, base, chunked):
+        np.testing.assert_array_equal(np.asarray(c.kernel, np.float64), k)
+        assert c.cost == b.cost and c.latency == b.latency
+        for sb, sc in zip(b.stages, c.stages):
+            assert len(sb.ops) == len(sc.ops)
+            for ob, oc_ in zip(sb.ops, sc.ops):
+                assert (ob.id0, ob.id1, ob.opcode, ob.data) == (oc_.id0, oc_.id1, oc_.opcode, oc_.data)
+
+
 @pytest.mark.parametrize('seed', [0, 1])
 def test_jax_heterogeneous_qintervals_fuzz(seed):
     """Exactness under fuzzed per-row qintervals/latencies and finite
