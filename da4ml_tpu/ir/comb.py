@@ -395,7 +395,16 @@ class Pipeline(NamedTuple):
             return cls.from_dict(json.load(f))
 
     def predict(self, data, backend: str = 'auto', n_threads: int = 0, mesh=None):
-        out = np.asarray(data, dtype=np.float64)
+        data = np.asarray(data, dtype=np.float64)
+        if mesh is not None and backend not in ('jax', 'auto'):
+            raise ValueError(f"mesh sharding requires backend='jax', got {backend!r}")
+        if backend == 'jax' or mesh is not None:
+            # fused device path: all stages + exact inter-stage re-scaling
+            # compile to ONE XLA program — no host round-trip per boundary
+            from ..runtime.jax_backend import run_pipeline
+
+            return run_pipeline([s.to_binary() for s in self.stages], data, mesh=mesh)
+        out = data
         for stage in self.stages:
-            out = stage.predict(out, backend=backend, n_threads=n_threads, mesh=mesh)
+            out = stage.predict(out, backend=backend, n_threads=n_threads)
         return out

@@ -370,6 +370,73 @@ class DaisExecutor:
         return out[: len(data)] * self._out_scale()
 
 
+class PipelineExecutor:
+    """Fused on-device execution of a hardware pipeline's stages.
+
+    ``Pipeline.predict`` chains per-stage predicts, which on the jax backend
+    pays a device->host->device float round-trip at every stage boundary.
+    Here every stage's integer kernel plus the *exact* inter-stage
+    re-scaling runs as one jitted XLA program. Boundary j carries
+    ``s[j] = out_shift_prev[j] - f_prev[out_idx_j] + inp_shift_next[j] +
+    f_next[j]``: the next stage's ``floor(out_float * 2**(inp_shift + f))``
+    on the grid-aligned boundary value is exactly an arithmetic shift of the
+    previous stage's output code (floor division for negative ``s``), so the
+    fused path is bit-exact with the chained one.
+
+    Reference analog: the clocked II=1 emulation loop of the Verilator
+    binder (src/da4ml/codegen/rtl/common_source/binder_util.hh:11-40 of
+    calad0i/da4ml) — one process drives all stages.
+    """
+
+    def __init__(self, progs: list[DaisProgram]):
+        if not progs:
+            raise ValueError('PipelineExecutor needs at least one stage')
+        self.stages = [DaisExecutor(p) for p in progs]
+        shifts: list[NDArray[np.int64]] = []
+        for pa, pb in zip(progs[:-1], progs[1:]):
+            if pa.n_out != pb.n_in:
+                raise ValueError(f'stage boundary mismatch: {pa.n_out} outputs feed {pb.n_in} inputs')
+            f_out = np.where(pa.out_idxs >= 0, pa.fractionals[np.maximum(pa.out_idxs, 0)], 0)
+            f_in = np.zeros(pb.n_in, dtype=np.int64)
+            for i in range(pb.n_ops):
+                if pb.opcode[i] == -1:
+                    f_in[int(pb.id0[i])] = int(pb.fractionals[i])
+            shifts.append((pa.out_shifts.astype(np.int64) - f_out + pb.inp_shifts.astype(np.int64) + f_in))
+
+        exs = self.stages
+
+        def fn(x):
+            for k, ex in enumerate(exs):
+                x = ex.fn_int(x.astype(ex.dtype))
+                if k < len(shifts):
+                    # shift in the WIDER of the two boundary dtypes: widening
+                    # first keeps a 32->64-bit up-shift from overflowing, and
+                    # a 64->32-bit boundary must right-shift the full value
+                    # BEFORE the next stage's input cast wraps it (floor then
+                    # mod-2^32, matching the chained path's float floor +
+                    # astype). Clamp each branch's amount — both sides of the
+                    # where are evaluated and negative shifts are undefined.
+                    wd = exs[k].dtype if exs[k].use_i64 else exs[k + 1].dtype
+                    s = jnp.asarray(shifts[k], dtype=wd)
+                    x = x.astype(wd)
+                    x = jnp.where(s >= 0, x << jnp.maximum(s, 0), x >> jnp.maximum(-s, 0))
+            return x
+
+        self.fn_int = jax.jit(fn)
+
+    def __call__(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
+        x = self.stages[0]._int_inputs(data)
+        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        return out * self.stages[-1]._out_scale()
+
+    def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:
+        from ..parallel import shard_batch
+
+        x, _ = shard_batch(self.stages[0]._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
+        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        return out[: len(data)] * self.stages[-1]._out_scale()
+
+
 _executor_cache: OrderedDict[bytes, DaisExecutor] = OrderedDict()
 _EXECUTOR_CACHE_CAP = 256
 
@@ -390,6 +457,28 @@ def executor_for_binary(binary: NDArray[np.int32]) -> DaisExecutor:
 
 def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64], mesh=None) -> NDArray[np.float64]:
     ex = executor_for_binary(binary)
+    if mesh is not None:
+        return ex.predict_sharded(data, mesh)
+    return ex(data)
+
+
+_pipeline_cache: OrderedDict[bytes, PipelineExecutor] = OrderedDict()
+
+
+def run_pipeline(binaries: list[NDArray[np.int32]], data: NDArray[np.float64], mesh=None) -> NDArray[np.float64]:
+    """Fused multi-stage execution: one device program for the whole pipeline."""
+    # length-prefixed segments: plain concatenation would let two different
+    # stage lists with identical byte streams collide
+    key = b''.join(
+        len(bs := np.asarray(b, dtype=np.int32).tobytes()).to_bytes(8, 'little') + bs for b in binaries
+    )
+    ex = _pipeline_cache.get(key)
+    if ex is None:
+        while len(_pipeline_cache) >= _EXECUTOR_CACHE_CAP:
+            _pipeline_cache.popitem(last=False)
+        _pipeline_cache[key] = ex = PipelineExecutor([decode(b) for b in binaries])
+    else:
+        _pipeline_cache.move_to_end(key)
     if mesh is not None:
         return ex.predict_sharded(data, mesh)
     return ex(data)

@@ -33,6 +33,43 @@ def test_to_pipeline_exact():
     np.testing.assert_array_equal(rep, golden[:32])
 
 
+def test_pipeline_fused_jax_predict_exact():
+    """backend='jax' runs all stages + inter-stage rescaling as one device
+    program; it must bit-match the per-stage numpy chain."""
+    _, _, comb = build_comb(latency_cutoff=4)
+    pipe = to_pipeline(comb, 4, retiming=False)
+    assert len(pipe.stages) >= 2
+    rng = np.random.default_rng(1)
+    data = rng.uniform(-8, 8, (128, N))
+    golden = pipe.predict(data, backend='numpy')
+    np.testing.assert_array_equal(pipe.predict(data, backend='jax'), golden)
+
+
+def test_pipeline_fused_jax_predict_sharded():
+    import jax
+    from jax.sharding import Mesh
+
+    _, _, comb = build_comb(latency_cutoff=4)
+    pipe = to_pipeline(comb, 4, retiming=False)
+    rng = np.random.default_rng(2)
+    data = rng.uniform(-8, 8, (8 * len(jax.devices()) + 3, N))  # pad path too
+    golden = pipe.predict(data, backend='numpy')
+    mesh = Mesh(np.asarray(jax.devices()), ('batch',))
+    np.testing.assert_array_equal(pipe.predict(data, mesh=mesh), golden)
+
+
+def test_pipeline_mesh_requires_jax_backend():
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+
+    _, _, comb = build_comb(latency_cutoff=4)
+    pipe = to_pipeline(comb, 4, retiming=False)
+    mesh = Mesh(np.asarray(jax.devices()), ('batch',))
+    with pytest.raises(ValueError, match='mesh sharding'):
+        pipe.predict(np.zeros((4, N)), backend='cpp', mesh=mesh)
+
+
 def test_to_pipeline_stage_latency_bound():
     _, _, comb = build_comb(latency_cutoff=4)
     pipe = to_pipeline(comb, 4, retiming=False)
