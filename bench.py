@@ -210,6 +210,29 @@ def _run_inference_micro(limited: bool):
     t0 = time.perf_counter()
     out_host = comb.predict(data, n_threads=HOST_THREADS)
     host_t = time.perf_counter() - t0
+
+    # multi-stage pipeline: fused single-program vs per-stage chained jax
+    from da4ml_tpu.trace import to_pipeline
+
+    pipe = to_pipeline(comb, 3.0)
+    out_f = pipe.predict(data, backend='jax')  # compiles
+    t0 = time.perf_counter()
+    out_f = pipe.predict(data, backend='jax')
+    fused_t = time.perf_counter() - t0
+    chain = [s.to_binary() for s in pipe.stages]
+
+    def _chained(d):
+        from da4ml_tpu.runtime.jax_backend import run_binary
+
+        out = d
+        for b in chain:
+            out = run_binary(b, out)
+        return out
+
+    _chained(data)
+    t0 = time.perf_counter()
+    out_c = _chained(data)
+    chain_t = time.perf_counter() - t0
     return {
         'n_samples': n_samples,
         'device_rate': round(n_samples / dev_t, 1),
@@ -218,6 +241,11 @@ def _run_inference_micro(limited: bool):
         'speedup': round(host_t / dev_t, 3),
         'speedup_resident': round(host_t / res_t, 3),
         'bit_exact': bool(np.array_equal(out_dev, out_host)),
+        'pipeline_stages': len(pipe.stages),
+        'pipeline_fused_rate': round(n_samples / fused_t, 1),
+        'pipeline_chained_rate': round(n_samples / chain_t, 1),
+        'pipeline_fused_vs_chained': round(chain_t / fused_t, 3),
+        'pipeline_bit_exact': bool(np.array_equal(out_f, out_host) and np.array_equal(out_c, out_host)),
     }
 
 
