@@ -32,6 +32,7 @@ from ..trace.ops import (
     depthwise_conv2d,
     max_pool1d,
     max_pool2d,
+    leaky_relu,
     relu,
     upsample_nearest,
     zero_pad,
@@ -169,12 +170,19 @@ class KerasTracer(TracerPluginBase):
             return args[0].transpose([d - 1 for d in layer.dims])
 
         if name == 'ReLU':
-            if getattr(layer, 'negative_slope', 0.0) or getattr(layer, 'threshold', 0.0):
-                raise NotImplementedError('Leaky/thresholded ReLU is not supported')
-            y = relu(args[0])
+            if getattr(layer, 'threshold', 0.0):
+                raise NotImplementedError('Thresholded ReLU is not supported')
+            slope = float(getattr(layer, 'negative_slope', 0.0) or 0.0)
+            y = leaky_relu(args[0], slope) if slope else relu(args[0])
             if layer.max_value is not None:
                 y = np.minimum(y, float(layer.max_value))
             return y
+        if name == 'LeakyReLU':
+            slope = float(getattr(layer, 'negative_slope', getattr(layer, 'alpha', 0.3)))
+            return leaky_relu(args[0], slope)
+        if name == 'PReLU':
+            alpha = np.asarray(layer.get_weights()[0], np.float64)
+            return leaky_relu(args[0], alpha)
         if name == 'Activation':
             return _apply_activation(args[0], layer.activation.__name__)
 

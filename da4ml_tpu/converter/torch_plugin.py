@@ -29,6 +29,7 @@ from ..trace.ops import (
     conv2d,
     depthwise_conv1d,
     depthwise_conv2d,
+    leaky_relu,
     max_pool1d,
     max_pool2d,
     relu,
@@ -78,6 +79,13 @@ class TorchTracer(TracerPluginBase):
             return y
         if isinstance(mod, nn.ReLU):
             return relu(x)
+        if isinstance(mod, nn.LeakyReLU):
+            return leaky_relu(x, float(mod.negative_slope))
+        if isinstance(mod, nn.PReLU):
+            alpha = _w(mod.weight)
+            if alpha.size > 1:  # per-channel: broadcast over trailing spatial dims
+                alpha = alpha.reshape((alpha.size,) + (1,) * (x.ndim - 1))
+            return leaky_relu(x, alpha)
         if isinstance(mod, nn.Flatten):
             if mod.start_dim not in (0, 1) or mod.end_dim != -1:
                 raise NotImplementedError('Only full flattening (start_dim 0/1, end_dim -1) is supported')
@@ -198,6 +206,9 @@ class TorchTracer(TracerPluginBase):
             return args[0] * args[1]
         if fn in (torch.relu, F.relu):
             return relu(args[0])
+        if fn is F.leaky_relu:
+            slope = float(kwargs.get('negative_slope', args[1] if len(args) > 1 else 0.01))
+            return leaky_relu(args[0], slope)
         if fn in (torch.cat,):
             dim = kwargs.get('dim', args[1] if len(args) > 1 else 0)
             vals = args[0]

@@ -11,13 +11,14 @@ from .conv_utils import (
     zero_pad,
 )
 from .einsum_utils import einsum
-from .quantization import fixed_quantize, quantize, relu
+from .quantization import fixed_quantize, leaky_relu, quantize, relu
 from .reduce_utils import reduce
 from .sorting import sort
 
 __all__ = [
     'einsum',
     'quantize',
+    'leaky_relu',
     'relu',
     'reduce',
     'sort',

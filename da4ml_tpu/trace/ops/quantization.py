@@ -69,6 +69,14 @@ def relu(x, i=None, f=None, round_mode: str = 'TRN'):
     return x
 
 
+def leaky_relu(x, alpha):
+    """``relu(x) - alpha * relu(-x)`` — exact for symbolic arrays: ``alpha``
+    is a trace-time constant, so the negative branch lowers to a CSD
+    constant multiply (shared lowering for the LeakyReLU/PReLU front-end
+    layers and ReLU ``negative_slope``)."""
+    return relu(x) - relu(-x) * alpha
+
+
 def quantize(x, k, i, f, overflow_mode: str = 'WRAP', round_mode: str = 'TRN'):
     from ..fixed_variable import FixedVariable
     from ..fixed_variable_array import FixedVariableArray

@@ -334,6 +334,46 @@ def test_torch_depthwise_pad_upsample(rng):
     np.testing.assert_array_equal(out, ref.reshape(6, -1))
 
 
+def test_keras_leaky_prelu(rng):
+    from keras import layers
+
+    i = keras.Input((6,))
+    a = layers.LeakyReLU(negative_slope=0.25)(i)
+    p = layers.PReLU()(a)
+    model = keras.Model(i, p)
+    # pow2 alphas stay exact in the f32 reference model
+    model.layers[-1].set_weights([np.full((6,), 0.5, np.float32)])
+    data = rng.integers(-8, 8, (16, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 4, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+class _TorchLeaky(torch.nn.Module):
+    input_shape = (6,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(6, 6)
+        self.lk = torch.nn.LeakyReLU(0.25)
+        self.pr = torch.nn.PReLU(6, init=0.5)
+
+    def forward(self, x):
+        return self.pr(self.lk(self.fc(x)))
+
+
+def test_torch_leaky_prelu(rng):
+    model = _TorchLeaky()
+    _int_weights_torch(model, rng, -3, 3)
+    with torch.no_grad():
+        model.pr.weight.fill_(0.5)
+    data = rng.integers(-4, 4, (8, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = model(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
 class _TorchSliceMax(torch.nn.Module):
     input_shape = (8,)
 
