@@ -79,6 +79,10 @@ class TorchTracer(TracerPluginBase):
             return y
         if isinstance(mod, nn.ReLU):
             return relu(x)
+        if isinstance(mod, nn.ReLU6):
+            return np.minimum(relu(x), 6.0)
+        if isinstance(mod, nn.Hardtanh):
+            return np.minimum(np.maximum(x, float(mod.min_val)), float(mod.max_val))
         if isinstance(mod, nn.LeakyReLU):
             return leaky_relu(x, float(mod.negative_slope))
         if isinstance(mod, nn.PReLU):
@@ -209,6 +213,15 @@ class TorchTracer(TracerPluginBase):
         if fn is F.leaky_relu:
             slope = float(kwargs.get('negative_slope', args[1] if len(args) > 1 else 0.01))
             return leaky_relu(args[0], slope)
+        if fn in (torch.clamp, torch.clip):
+            lo = kwargs.get('min', args[1] if len(args) > 1 else None)
+            hi = kwargs.get('max', args[2] if len(args) > 2 else None)
+            y = args[0]
+            if lo is not None:
+                y = np.maximum(y, float(lo))
+            if hi is not None:
+                y = np.minimum(y, float(hi))
+            return y
         if fn in (torch.cat,):
             dim = kwargs.get('dim', args[1] if len(args) > 1 else 0)
             vals = args[0]

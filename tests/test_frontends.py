@@ -362,6 +362,29 @@ class _TorchLeaky(torch.nn.Module):
         return self.pr(self.lk(self.fc(x)))
 
 
+class _TorchClamp(torch.nn.Module):
+    input_shape = (6,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(6, 6)
+        self.r6 = torch.nn.ReLU6()
+        self.ht = torch.nn.Hardtanh(-2.0, 3.0)
+
+    def forward(self, x):
+        return torch.clamp(self.ht(self.r6(self.fc(x))), min=-1.0, max=2.5)
+
+
+def test_torch_relu6_hardtanh_clamp(rng):
+    model = _TorchClamp()
+    _int_weights_torch(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (8, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = model(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_torch_leaky_prelu(rng):
     model = _TorchLeaky()
     _int_weights_torch(model, rng, -3, 3)
