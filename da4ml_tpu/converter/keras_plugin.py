@@ -39,7 +39,7 @@ from ..trace.ops import (
 )
 from .plugin import TracerPluginBase
 
-_SUPPORTED_ACTIVATIONS = ('linear', 'relu')
+_SUPPORTED_ACTIVATIONS = ('linear', 'relu', 'relu6', 'leaky_relu')
 
 
 def _weight(w) -> np.ndarray:
@@ -51,6 +51,10 @@ def _apply_activation(x, name: str):
         return x
     if name == 'relu':
         return relu(x)
+    if name == 'relu6':
+        return np.minimum(relu(x), 6.0)
+    if name == 'leaky_relu':
+        return leaky_relu(x, 0.2)  # keras.activations.leaky_relu default slope
     raise NotImplementedError(
         f'Activation {name!r} is not traceable: DA semantics need an explicit output precision. '
         f'Supported: {_SUPPORTED_ACTIVATIONS}.'

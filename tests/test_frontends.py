@@ -334,6 +334,26 @@ def test_torch_depthwise_pad_upsample(rng):
     np.testing.assert_array_equal(out, ref.reshape(6, -1))
 
 
+def test_keras_string_activations(rng):
+    from keras import layers
+
+    model = keras.Sequential([keras.Input((6,)), layers.Dense(6, activation='relu6')])
+    _int_weights_keras(model, rng)
+    data = rng.integers(-4, 4, (16, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+    # activation='leaky_relu': the 0.2 default slope is not binary-
+    # representable, so keras's f32 product differs from the exact trace in
+    # the last ulp — tolerance-checked, unlike every representable-slope case
+    m2 = keras.Sequential([keras.Input((6,)), layers.Dense(6, activation='leaky_relu')])
+    _int_weights_keras(m2, rng)
+    out2 = _trace_predict(m2, data, inputs_kif=(1, 3, 0))
+    ref2 = np.asarray(m2(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_allclose(out2, ref2, rtol=1e-6)
+
+
 def test_keras_leaky_prelu(rng):
     from keras import layers
 
