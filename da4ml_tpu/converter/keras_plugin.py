@@ -34,6 +34,7 @@ from ..trace.ops import (
     max_pool2d,
     leaky_relu,
     relu,
+    relu6,
     upsample_nearest,
     zero_pad,
 )
@@ -52,7 +53,7 @@ def _apply_activation(x, name: str):
     if name == 'relu':
         return relu(x)
     if name == 'relu6':
-        return np.minimum(relu(x), 6.0)
+        return relu6(x)
     if name == 'leaky_relu':
         return leaky_relu(x, 0.2)  # keras.activations.leaky_relu default slope
     raise NotImplementedError(

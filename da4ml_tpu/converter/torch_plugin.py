@@ -33,6 +33,7 @@ from ..trace.ops import (
     max_pool1d,
     max_pool2d,
     relu,
+    relu6,
     upsample_nearest,
     zero_pad,
 )
@@ -80,7 +81,7 @@ class TorchTracer(TracerPluginBase):
         if isinstance(mod, nn.ReLU):
             return relu(x)
         if isinstance(mod, nn.ReLU6):
-            return np.minimum(relu(x), 6.0)
+            return relu6(x)
         if isinstance(mod, nn.Hardtanh):
             return np.minimum(np.maximum(x, float(mod.min_val)), float(mod.max_val))
         if isinstance(mod, nn.LeakyReLU):
@@ -217,10 +218,11 @@ class TorchTracer(TracerPluginBase):
             lo = kwargs.get('min', args[1] if len(args) > 1 else None)
             hi = kwargs.get('max', args[2] if len(args) > 2 else None)
             y = args[0]
+            # scalar or tensor bounds (per-channel clamp broadcasts like Hardtanh)
             if lo is not None:
-                y = np.maximum(y, float(lo))
+                y = np.maximum(y, np.asarray(lo, dtype=np.float64))
             if hi is not None:
-                y = np.minimum(y, float(hi))
+                y = np.minimum(y, np.asarray(hi, dtype=np.float64))
             return y
         if fn in (torch.cat,):
             dim = kwargs.get('dim', args[1] if len(args) > 1 else 0)

@@ -11,7 +11,7 @@ from .conv_utils import (
     zero_pad,
 )
 from .einsum_utils import einsum
-from .quantization import fixed_quantize, leaky_relu, quantize, relu
+from .quantization import fixed_quantize, leaky_relu, quantize, relu, relu6
 from .reduce_utils import reduce
 from .sorting import sort
 
@@ -20,6 +20,7 @@ __all__ = [
     'quantize',
     'leaky_relu',
     'relu',
+    'relu6',
     'reduce',
     'sort',
     'fixed_quantize',

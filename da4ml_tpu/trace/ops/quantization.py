@@ -77,6 +77,12 @@ def leaky_relu(x, alpha):
     return relu(x) - relu(-x) * alpha
 
 
+def relu6(x):
+    """``min(relu(x), 6)`` — shared exact lowering for the MobileNet-style
+    activation in both front-ends."""
+    return np.minimum(relu(x), 6.0)
+
+
 def quantize(x, k, i, f, overflow_mode: str = 'WRAP', round_mode: str = 'TRN'):
     from ..fixed_variable import FixedVariable
     from ..fixed_variable_array import FixedVariableArray

@@ -382,6 +382,39 @@ class _TorchLeaky(torch.nn.Module):
         return self.pr(self.lk(self.fc(x)))
 
 
+def test_keras_relu_negative_slope_max_value(rng):
+    from keras import layers
+
+    model = keras.Sequential([keras.Input((6,)), layers.ReLU(negative_slope=0.25, max_value=4.0)])
+    data = rng.integers(-8, 8, (16, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 4, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
+class _TorchFnLeaky(torch.nn.Module):
+    input_shape = (6,)
+
+    def __init__(self):
+        super().__init__()
+        self.fc = torch.nn.Linear(6, 6)
+
+    def forward(self, x):
+        import torch.nn.functional as F
+
+        return F.leaky_relu(self.fc(x), 0.25)
+
+
+def test_torch_functional_leaky_relu(rng):
+    model = _TorchFnLeaky()
+    _int_weights_torch(model, rng, -3, 3)
+    data = rng.integers(-4, 4, (8, 6)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    with torch.no_grad():
+        ref = model(torch.tensor(data.astype(np.float32))).numpy().astype(np.float64)
+    np.testing.assert_array_equal(out, ref)
+
+
 class _TorchClamp(torch.nn.Module):
     input_shape = (6,)
 
