@@ -131,3 +131,20 @@ def test_jedi_layer_shape_on_tpu(rng):
     wall = time.perf_counter() - t0
     np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), k)
     assert wall < 420.0, f'16x64 solve took {wall:.0f}s (compile + search)'
+
+
+def test_fused_pipeline_on_tpu(rng):
+    """The fused multi-stage pipeline program is bit-exact on hardware and
+    agrees with the chained per-stage path."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+
+    inp = FixedVariableArrayInput(8, hwconf=HWConfig(1, -1, 3))
+    x = inp.quantize(np.ones(8), np.full(8, 3), np.full(8, 2))
+    w1 = rng.integers(-8, 8, (8, 8)).astype(np.float64)
+    w2 = rng.integers(-8, 8, (8, 4)).astype(np.float64)
+    comb = comb_trace(inp, ((x @ w1).relu()) @ w2)
+    pipe = to_pipeline(comb, 3, retiming=False)
+    assert len(pipe.stages) >= 2
+    data = rng.uniform(-8, 8, (512, 8))
+    golden = pipe.predict(data, backend='numpy')
+    np.testing.assert_array_equal(pipe.predict(data, backend='jax'), golden)
