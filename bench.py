@@ -374,6 +374,16 @@ def main():
         os.environ['DA4ML_BENCH_PLATFORM'] = 'cpu'
         os.environ['JAX_PLATFORMS'] = 'cpu'
     detail['platform'] = platform or ('cpu-forced' if forced_cpu else 'cpu-fallback')
+    if limited and not forced_cpu:
+        # a real-TPU outage at capture time: attach the committed snapshot of
+        # the last successful on-TPU measurement, clearly labeled as a PRIOR
+        # measurement (docs/bench_snapshot.json) — never as the live result
+        try:
+            snap_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'docs', 'bench_snapshot.json')
+            with open(snap_path) as fh:
+                detail['last_known_tpu'] = json.load(fh)
+        except Exception as e:  # make a missing/invalid snapshot visible, not silent
+            detail['last_known_tpu_error'] = f'{type(e).__name__}: {e}'[:200]
     detail['host_backend'] = _resolve_host_backend()
     detail['limited_cpu_fallback'] = limited
 
