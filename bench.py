@@ -72,11 +72,26 @@ def _rand_kernel(rng, n_in, n_out, bits):
 
 
 def _host_solve(kernels, backend):
+    """Host baseline solve, threaded as wide as this machine allows.
+
+    Requesting more OpenMP workers than cores only adds scheduler noise, so
+    the measured run uses min(16, nproc) workers; ``_host_16t_rate`` derives
+    the BASELINE 16-thread figure from it by assuming perfect scaling of the
+    missing cores (an upper bound on the real 16-thread host — the dc sweep
+    has too few lanes to scale perfectly).
+    """
     from da4ml_tpu.cmvm import solve
 
+    workers = min(HOST_THREADS, os.cpu_count() or 1)
     t0 = time.perf_counter()
-    sols = [solve(k, backend=backend, n_workers=HOST_THREADS) for k in kernels]
+    sols = [solve(k, backend=backend, n_workers=workers) for k in kernels]
     return sols, time.perf_counter() - t0
+
+
+def _host_16t_rate(n: int, host_t: float) -> float:
+    """Derived perfect-scaling 16-thread host rate (matrices/s)."""
+    workers = min(HOST_THREADS, os.cpu_count() or 1)
+    return n / host_t * (HOST_THREADS / workers)
 
 
 def _jax_solve(kernels):
@@ -109,13 +124,12 @@ def _run_config(name, kernels, host_backend):
         'config': name,
         'n_matrices': n,
         'host_rate': round(n / host_t, 3),
+        # the BASELINE comparison point: measured host rate scaled to 16
+        # perfect threads (methodology: docs/benchmarks.md)
+        'host_rate_16thread_derived': round(_host_16t_rate(n, host_t), 3),
         'jax_rate': round(n / jax_t, 3),
         'speedup': round(host_t / jax_t, 3),
-        # conservative bound for the BASELINE 16-thread target when the
-        # bench host has fewer cores than threads (nproc is in detail):
-        # assumes the host would scale perfectly to 16 threads, which the
-        # per-solve dc sweep (<= ~6 lanes) cannot actually reach
-        'speedup_vs_perfect_16thread': round(host_t / jax_t / max(1.0, 16.0 / max(os.cpu_count() or 1, 1)), 3),
+        'speedup_vs_16thread': round((n / jax_t) / _host_16t_rate(n, host_t), 3),
         'jax_compile_s': round(compile_t, 2),
         **_parity(kernels, jax_sols, host_sols),
     }
@@ -323,14 +337,18 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
         wide = solve_jax_many(k1, method0_candidates=methods, n_restarts=2 if limited else 6)
         wall = time.perf_counter() - t0
         wide_costs = np.asarray([s.cost for s in wide])
+        portfolio = solve_jax_many(k1, include_host=True)
+        portfolio_costs = np.asarray([s.cost for s in portfolio])
         return {
             'mean_cost_wide': round(float(wide_costs.mean()), 3),
             'mean_cost_single': round(float(np.mean([s.cost for s in single])), 3),
             'mean_cost_host': round(float(host_costs.mean()), 3),
-            # per-matrix comparison vs the reference solver; include_host=True
-            # (the portfolio mode) makes win_or_tie n/n by construction
-            'win_or_tie': f'{int((wide_costs <= host_costs).sum())}/{len(k1)}',
-            'strict_win': f'{int((wide_costs < host_costs).sum())}/{len(k1)}',
+            'mean_cost_portfolio': round(float(portfolio_costs.mean()), 3),
+            # pure device sweep vs a fresh host solve, per matrix
+            'win_or_tie_device_only': f'{int((wide_costs <= host_costs).sum())}/{len(k1)}',
+            'strict_win_device_only': f'{int((wide_costs < host_costs).sum())}/{len(k1)}',
+            # include_host portfolio (the documented never-worse mode)
+            'win_or_tie_portfolio': f'{int((portfolio_costs <= host_costs).sum())}/{len(k1)}',
             'wall_s': round(wall, 2),
         }
     if name == 'select_modes':

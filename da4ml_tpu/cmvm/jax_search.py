@@ -59,7 +59,10 @@ _METHOD_CODES = {'mc': 0, 'mc-dc': 1, 'mc-pdc': 2, 'wmc': 3, 'wmc-dc': 4, 'wmc-p
 #: rare while keeping the carried state O(S*P). Smaller values trade a
 #: little solution cost for iteration speed (K=4 measured ~27% faster at
 #: ~0.4% worse cost on large shapes); env DA4ML_JAX_TOPK overrides.
-_TOPK = int(os.environ.get('DA4ML_JAX_TOPK', '') or 8)
+try:
+    _TOPK = int(os.environ.get('DA4ML_JAX_TOPK', '') or 8)
+except ValueError:
+    _TOPK = 8
 
 #: observability counters; 'over_budget_accepts' counts matrices where no
 #: candidate met the hard_dc latency budget and the forced dc=-1 / wmc-dc

@@ -195,15 +195,27 @@ export_design -format ip_catalog
                 f'-I src src/hls_top_oneapi.cpp -o {self.name}_prj\n'
             )
 
+    #: Intel FPGA family prefixes i++/icpx accept as -Xstarget values
+    _INTEL_FAMILIES = ('agilex', 'arria', 'cyclone', 'stratix', 'max')
+
     def _intel_target(self) -> str:
         """Device target for the Intel flavors' build scripts.
 
         The class default ``part`` is an AMD Virtex part (the reference's
         default synthesis target); i++/icpx would reject it, so Intel-flavor
-        scripts fall back to an Intel FPGA family unless the caller passed an
-        Intel part explicitly.
+        scripts fall back to an Intel FPGA family unless the caller passed a
+        recognizable Intel part. Unrecognized strings are substituted too
+        (with a warning) rather than pasted into a build script that the
+        Intel tools would reject.
         """
-        return 'Agilex7' if self.part.startswith(('xc', 'XC')) else self.part
+        part = self.part
+        if part.lower().startswith(self._INTEL_FAMILIES):
+            return part
+        if not part.lower().startswith('xc'):
+            import warnings
+
+            warnings.warn(f'part {part!r} is not a recognizable Intel FPGA family; using Agilex7 in the Intel build script')
+        return 'Agilex7'
 
     def _emit_bridge(self) -> str:
         in_f, in_w, in_s, out_f = self._io_consts()

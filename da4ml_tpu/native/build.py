@@ -15,18 +15,32 @@ from pathlib import Path
 _HERE = Path(__file__).parent
 SRC_DIR = _HERE / 'src'
 LIB_PATH = _HERE / '_da4ml_native.so'
+FINGERPRINT_PATH = _HERE / '_da4ml_native.fingerprint'
 
 
 def _sources() -> list[Path]:
     return sorted(SRC_DIR.glob('*.cc'))
 
 
+def _fingerprint() -> str:
+    """Content hash of every native source/header — mtimes are unreliable
+    (git checkouts give all files the same timestamp)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in sorted(SRC_DIR.glob('*.cc')) + sorted(SRC_DIR.glob('*.hh')):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
 def needs_build() -> bool:
     if not LIB_PATH.exists():
         return True
-    lib_mtime = LIB_PATH.stat().st_mtime
-    deps = list(SRC_DIR.glob('*.cc')) + list(SRC_DIR.glob('*.hh'))
-    return any(p.stat().st_mtime > lib_mtime for p in deps)
+    try:
+        return FINGERPRINT_PATH.read_text().strip() != _fingerprint()
+    except OSError:
+        return True
 
 
 def build(force: bool = False, verbose: bool = False) -> Path:
@@ -51,6 +65,7 @@ def build(force: bool = False, verbose: bool = False) -> Path:
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f'native build failed:\n{proc.stderr}')
+    FINGERPRINT_PATH.write_text(_fingerprint() + '\n')
     return LIB_PATH
 
 

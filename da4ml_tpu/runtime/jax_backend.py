@@ -417,6 +417,10 @@ class PipelineExecutor:
                     # astype). Clamp each branch's amount — both sides of the
                     # where are evaluated and negative shifts are undefined.
                     wd = exs[k].dtype if exs[k].use_i64 else exs[k + 1].dtype
+                    if wd == jnp.int32 and np.any(shifts[k] > 0) and jax.config.read('jax_enable_x64'):
+                        # an up-shift between two int32 stages must not wrap
+                        # before the next stage's input cast does the wrapping
+                        wd = jnp.int64
                     s = jnp.asarray(shifts[k], dtype=wd)
                     x = x.astype(wd)
                     x = jnp.where(s >= 0, x << jnp.maximum(s, 0), x >> jnp.maximum(-s, 0))
