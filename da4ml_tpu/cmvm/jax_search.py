@@ -192,6 +192,7 @@ class _KernelSpec:
     adder_size: int
     carry_size: int
     select: str = 'top4'  # 'top4' | 'xla' | 'pallas' (DA4ML_JAX_SELECT)
+    R_in: int = 0  # provided input rows (0 = full P); the rest are device-padded
 
 
 @lru_cache(maxsize=64)
@@ -322,25 +323,65 @@ def _build_cse_fn(spec: _KernelSpec):
         j_ax = jax.lax.broadcasted_iota(jnp.int32, (1, B, P, P), 3)
         return (s_ax > 0) | (i_ax < j_ax)
 
-    def select_pair(Cs, Cd, nov, dlat, method):
-        """Masked scoring + single-pass argmax over the [2, S, P, P] tensor.
+    def _host_rank_parts(sub, s, i, j):
+        """The host scan-order rank of candidate (sub, s, i, j), split into an
+        id-major part and a (sub, shift) minor part (both int32-safe).
 
-        Ties resolve by first flattened index — deterministic, though not the
-        host's scan order (the contract is exactness at comparable cost).
-        ``nov``/``dlat`` are symmetric [P, P]: they cover both (i, j) and
-        (j, i) pairs.
+        The host heuristics scan the freq map sorted by (id1, id0, sub,
+        shift) ascending and update on ``>=``, so among equal scores the
+        LARGEST key wins (heuristics.py / indexers.cc). id1 = max(i, j),
+        id0 = min(i, j); shift = +s when i < j else -s.
+        """
+        id0 = jnp.minimum(i, j)
+        id1 = jnp.maximum(i, j)
+        shift = jnp.where(i < j, s, -s)
+        major = id1 * P + id0
+        minor = sub * (2 * B + 1) + shift + B
+        return major, minor
+
+    def _rank_decode(major, minor):
+        """Invert _host_rank_parts back to (sub, s, i, j)."""
+        id1, id0 = jnp.divmod(major, P)
+        sub, sk = jnp.divmod(minor, 2 * B + 1)
+        shift = sk - B
+        i = jnp.where(shift >= 0, id0, id1)
+        j = jnp.where(shift >= 0, id1, id0)
+        return sub.astype(jnp.int32), jnp.abs(shift).astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
+
+    def _argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax):
+        """Argmax with ties resolved exactly as the host scan: among maxima,
+        take the largest (id1, id0, sub, shift) key — a three-pass reduce
+        (max score, then max id-major, then max minor)."""
+        m = jnp.max(score)
+        tie = score == m
+        major, minor = _host_rank_parts(sub_ax, s_ax, i_ax, j_ax)
+        r1 = jnp.max(jnp.where(tie, major, -1))
+        tie &= major == r1
+        r2 = jnp.max(jnp.where(tie, minor, -1))
+        return m != -jnp.inf, *_rank_decode(r1, r2)
+
+    def select_pair(Cs, Cd, nov, dlat, method):
+        """Masked scoring + host-order argmax over the [2, S, P, P] tensor.
+
+        Decision-identical with the host solver's scan (``>=`` over the
+        sorted freq map). ``nov``/``dlat`` are symmetric [P, P]: they cover
+        both (i, j) and (j, i) pairs.
         """
         C = jnp.stack([Cs, Cd]).astype(jnp.float32)  # [2, S, P, P]
         score = _score(C, nov[None, None], dlat[None, None], method, _s0_mask())
-        flat = jnp.argmax(score)
-        any_valid = jnp.max(score) != -jnp.inf
-        return any_valid, *_decode_flat(flat, P, B)
+        shp = (2, B, P, P)
+        sub_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+        s_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+        i_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
+        j_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
+        return _argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax)
 
     def select_pair_pallas(Cs, Cd, nov, dlat, method):
         """Fused VMEM select (pallas): decision-identical with select_pair.
 
-        One grid pass over the count tensor computes score + mask + local
-        argmax per tile without materializing the f32 score tensor in HBM.
+        One grid pass over the count tensor computes score + mask + the
+        host-order tie reduction per tile without materializing the f32
+        score tensor in HBM.
         """
         from .pallas_select import make_select
 
@@ -355,8 +396,8 @@ def _build_cse_fn(spec: _KernelSpec):
                 jnp.where((method == 1) | (method == 3) | (method == 4), 1.0, 0.0),
             ]
         ).reshape(1, 4)
-        flat, any_valid = sel_fn(Cs, Cd, nov, dlat, coef)
-        return any_valid, *_decode_flat(flat, P, B)
+        r1, r2, any_valid = sel_fn(Cs, Cd, nov, dlat, coef)
+        return any_valid, *_rank_decode(r1, r2)
 
     b_idx = jnp.arange(B)
 
@@ -485,11 +526,12 @@ def _build_cse_fn(spec: _KernelSpec):
         return nov, dlt
 
     def _extract_topk(vals, cols, k=_TOPK):
-        """Exact (score desc, col asc) top-k along the last axis.
+        """Exact (score desc, col desc) top-k along the last axis.
 
         ``cols`` must hold distinct ids per row (padding entries use -1 with
-        -inf score). The (max, then min-col-among-max) double pass realizes
-        the same tie order as a flattened first-index argmax.
+        -inf score). Within one cache row (fixed sub, s, i) the host scan
+        key (id1, id0, sub, shift) is strictly increasing in the column j,
+        so col-desc tie order realizes the host's ``>=``-scan preference.
         """
         big = jnp.iinfo(jnp.int32).max
         out_v, out_c = [], []
@@ -497,8 +539,8 @@ def _build_cse_fn(spec: _KernelSpec):
         for _ in range(k):
             m = jnp.max(v, axis=-1, keepdims=True)
             fin = m != -jnp.inf
-            cand = jnp.where((v == m) & fin, cols, big)
-            c = jnp.min(cand, axis=-1, keepdims=True)
+            cand = jnp.where((v == m) & fin, cols, -big)
+            c = jnp.max(cand, axis=-1, keepdims=True)
             out_v.append(m[..., 0])
             out_c.append(jnp.where(fin[..., 0], c[..., 0], -1))
             v = jnp.where((cols == c) & (v == m), -jnp.inf, v)
@@ -554,12 +596,15 @@ def _build_cse_fn(spec: _KernelSpec):
         def body(state):
             E, tv, tc, qmeta, lat, cur, op_rec, _ = state
             rowmax = tv[..., 0]  # [2, S, P]
-            flat = jnp.argmax(rowmax)  # first flat index on ties (row-major)
-            any_valid = jnp.max(rowmax) != -jnp.inf
-            sub, rem = jnp.divmod(flat, B * P)
-            s, i = jnp.divmod(rem, P)
-            sub, s, i = sub.astype(jnp.int32), s.astype(jnp.int32), i.astype(jnp.int32)
-            j = tc[sub, s, i, 0]
+            # host-order selection across rows: each row's cached best col is
+            # already its host-preferred candidate (col-desc tie order), so
+            # ranking rows by the full (id1, id0, sub, shift) key reproduces
+            # the host scan exactly
+            shp3 = (2, B, P)
+            sub_ax = jax.lax.broadcasted_iota(jnp.int32, shp3, 0)
+            s_ax = jax.lax.broadcasted_iota(jnp.int32, shp3, 1)
+            i_ax = jax.lax.broadcasted_iota(jnp.int32, shp3, 2)
+            any_valid, sub, s, i, j = _argmax_host_order(rowmax, sub_ax, s_ax, i_ax, tc[..., 0])
 
             def do_update(args):
                 E, tv, tc, qmeta, lat, cur, op_rec = args
@@ -640,7 +685,26 @@ def _build_cse_fn(spec: _KernelSpec):
         E, _, _, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
         return _pack_digits(E), qmeta, lat, op_rec, cur
 
-    return jax.jit(jax.vmap(lane_fn_top4 if spec.select == 'top4' else lane_fn))
+    inner = lane_fn_top4 if spec.select == 'top4' else lane_fn
+
+    if spec.R_in and spec.R_in < P:
+        # Trimmed upload: the host ships only the R_in rows that carry state
+        # (int32-packed when possible — int8 H2D through the remote tunnel is
+        # ~5x slower per byte) and the device pads to the full P slots. Pad
+        # rows keep the benign-metadata invariant (step 1.0).
+        R_in = spec.R_in
+        packed_in = (O * B) % 4 == 0
+
+        def lane_trimmed(E0p, qmeta0, lat0, cur0, method):
+            E0 = jax.lax.bitcast_convert_type(E0p, jnp.int8).reshape(R_in, O, B) if packed_in else E0p
+            E0 = jnp.pad(E0, ((0, P - R_in), (0, 0), (0, 0)))
+            pad_q = jnp.tile(jnp.asarray([0.0, 0.0, 1.0], jnp.float32), (P - R_in, 1))
+            qmeta = jnp.concatenate([qmeta0, pad_q])
+            lat = jnp.pad(lat0, (0, P - R_in))
+            return inner(E0, qmeta, lat, cur0, method)
+
+        return jax.jit(jax.vmap(lane_trimmed))
+    return jax.jit(jax.vmap(inner))
 
 
 # --------------------------------------------------------------------------
@@ -856,7 +920,10 @@ def solve_single_lanes(
                     break
             n_pend = len(pend)
             select = _select()
-            fn = _build_cse_fn(_KernelSpec(P, O, B, adder_size, carry_size, select))
+            # rows actually carrying state this rung: n_in_max on entry, the
+            # previous rung's P on resume (st_cur hits the cap exactly)
+            rows_in = min(int(st_cur[pend].max()), P)
+            fn = _build_cse_fn(_KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0))
 
             # HBM guard: bound the lanes per device call so a wide batch of
             # large matrices cannot OOM-crash the worker; excess lanes run in
@@ -894,25 +961,32 @@ def solve_single_lanes(
                 chunk = pend[lo:hi]
                 n_chunk = hi - lo
                 bucket = _bucket_lanes(n_chunk, mesh)
-                # padded host arrays at the rung's exact device shape; pad
-                # rows keep the benign-metadata invariant (step 1.0, not 0):
-                # zero digit rows are never selectable, but scoring reads the
-                # step column unguarded. Padding lanes start at cur = P so
-                # their loop exits immediately.
-                cE = np.zeros((bucket, P, O, B), np.int8)
-                cq = np.zeros((bucket, P, 3), np.float32)
+                # host arrays trimmed to the rows that carry state (the device
+                # pads to P); pad rows keep the benign-metadata invariant
+                # (step 1.0, not 0): zero digit rows are never selectable, but
+                # scoring reads the step column unguarded. Padding lanes start
+                # at cur = P so their loop exits immediately.
+                rows_h = rows_in if rows_in < P else P
+                cE = np.zeros((bucket, rows_h, O, B), np.int8)
+                cq = np.zeros((bucket, rows_h, 3), np.float32)
                 cq[:, :, 2] = 1.0
-                cl = np.zeros((bucket, P), np.float32)
+                cl = np.zeros((bucket, rows_h), np.float32)
                 cc = np.full((bucket,), P, np.int32)
                 cm = np.zeros((bucket,), np.int32)
                 for x, a in enumerate(chunk):
-                    pa = hE[a].shape[0]
-                    cE[x, :pa] = hE[a]
-                    cq[x, :pa] = hq[a]
-                    cl[x, :pa] = hl[a]
+                    pa = min(hE[a].shape[0], rows_h)
+                    cE[x, :pa] = hE[a][:pa]
+                    cq[x, :pa] = hq[a][:pa]
+                    cl[x, :pa] = hl[a][:pa]
                     cc[x] = st_cur[a]
                     cm[x] = mcodes[a]
-                args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE, cq, cl, cc, cm))
+                if rows_h < P and (O * B) % 4 == 0:
+                    # int32-packed upload (same little-endian view the fetch
+                    # side uses); the device bitcasts back to int8
+                    cE_send = np.ascontiguousarray(cE).reshape(bucket, rows_h, O * B).view(np.int32)
+                else:
+                    cE_send = cE
+                args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm))
 
                 if debug:
                     import time as _time

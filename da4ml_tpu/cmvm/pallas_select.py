@@ -21,11 +21,12 @@ Scalar results are written to SMEM blocks — scalar stores to VMEM are
 rejected by Mosaic on real TPUs (the round-1 kernel only ever ran in
 interpret mode and hit exactly that on hardware).
 
-Decision identity: the flat index layout matches ``_decode_flat``
-(sub-major, then shift, then (i, j) row-major); ties resolve to the first
-flat index via a min-over-equal-maxima reduction in-kernel and
-first-occurrence argmax across tiles. Selection semantics reference:
-src/da4ml/_binary/cmvm/indexers.cc of calad0i/da4ml.
+Decision identity: ties among equal scores resolve to the largest host scan
+key (id1, id0, sub, shift) — the same order the host solver's ``>=`` scan
+over its sorted freq map realizes (heuristics.py / indexers.cc of
+calad0i/da4ml). The kernel reduces each tile to (max score, max id-major
+among maxima, max minor among those); a tiny XLA pass combines tiles and
+returns the winning rank parts for ``jax_search._rank_decode``.
 
 Enabled with ``DA4ML_JAX_SELECT=pallas`` (interpret mode off-TPU).
 """
@@ -62,18 +63,19 @@ def _row_tile(P: int) -> int:
 
 @lru_cache(maxsize=32)
 def make_select(P: int, B: int, cdtype: str, *, interpret: bool = False):
-    """Selection function (Cs, Cd, nov, dlat, coef) -> (flat, any_valid).
+    """Selection function (Cs, Cd, nov, dlat, coef) -> (major, minor, any_valid).
 
     Cs/Cd are the ``[S, P, P]`` same/diff pair counts (S == B shifts), nov and
     dlat the ``[P, P]`` pair metadata, coef the ``[1, 4]`` per-lane heuristic
-    coefficients. Returns the flat candidate index (layout of
-    ``jax_search._decode_flat``) and whether any candidate was valid.
+    coefficients. Returns the winning candidate's host-rank parts
+    (major = id1 * P + id0, minor = sub * (2B + 1) + shift + B; see
+    ``jax_search._rank_decode``) and whether any candidate was valid.
     """
     Pb = _row_tile(P)
     RB = pl.cdiv(P, Pb)
     S = B
 
-    def kernel(cs_ref, cd_ref, nov_ref, dlat_ref, coef_ref, vals_ref, idxs_ref):
+    def kernel(cs_ref, cd_ref, nov_ref, dlat_ref, coef_ref, vals_ref, maj_ref, min_ref):
         s = pl.program_id(0)
         rb = pl.program_id(1)
         nov = nov_ref[...]
@@ -88,34 +90,32 @@ def make_select(P: int, B: int, cdtype: str, *, interpret: bool = False):
         i_g = rb * Pb + i_loc
         # s == 0 admits only i < j; padded rows (i_g >= P) are never valid
         base_ok = ((s > 0) | (i_g < j_g)) & (i_g < P)
-        flat_loc = i_g * P + j_g
+        major = jnp.maximum(i_g, j_g) * P + jnp.minimum(i_g, j_g)
 
         for sub, ref in ((0, cs_ref), (1, cd_ref)):
             c = ref[0].astype(jnp.float32)
             score = w_mc * c + w_ov * c * nov - pen * dlat
             valid = (c >= 2.0) & base_ok & ((absolute == 0.0) | (score >= 0.0))
             score = jnp.where(valid, score, _NEG)
+            minor = sub * (2 * B + 1) + jnp.where(i_g < j_g, s, -s) + B
             best = jnp.max(score)
-            # first flat index among the maxima (ties: lowest (i, j))
-            idx = jnp.min(jnp.where(score == best, flat_loc, _BIG))
+            # host tie order: largest (id1, id0), then largest (sub, shift)
+            tie = score == best
+            m1 = jnp.max(jnp.where(tie, major, -1))
+            m2 = jnp.max(jnp.where(tie & (major == m1), minor, -1))
             vals_ref[0, 0, sub] = best
-            idxs_ref[0, 0, sub] = s * (P * P) + idx
+            maj_ref[0, 0, sub] = m1
+            min_ref[0, 0, sub] = m2
 
     grid = (S, RB)
     count_spec = pl.BlockSpec((1, Pb, P), lambda s, rb: (s, rb, 0))
     pair_spec = pl.BlockSpec((Pb, P), lambda s, rb: (rb, 0))
     if not interpret and _SMEM is not None:
         coef_spec = pl.BlockSpec(memory_space=_SMEM)
-        out_specs = [
-            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0), memory_space=_SMEM),
-            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0), memory_space=_SMEM),
-        ]
+        out_specs = [pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0), memory_space=_SMEM) for _ in range(3)]
     else:
         coef_spec = pl.BlockSpec((1, 4), lambda s, rb: (0, 0))
-        out_specs = [
-            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0)),
-            pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0)),
-        ]
+        out_specs = [pl.BlockSpec((1, 1, 2), lambda s, rb: (s, rb, 0)) for _ in range(3)]
 
     call = pl.pallas_call(
         kernel,
@@ -125,18 +125,18 @@ def make_select(P: int, B: int, cdtype: str, *, interpret: bool = False):
         out_shape=[
             jax.ShapeDtypeStruct((S, RB, 2), jnp.float32),
             jax.ShapeDtypeStruct((S, RB, 2), jnp.int32),
+            jax.ShapeDtypeStruct((S, RB, 2), jnp.int32),
         ],
         interpret=interpret,
     )
 
     def select(Cs, Cd, nov, dlat, coef):
-        vals, idxs = call(Cs, Cd, nov, dlat, coef)
-        # flatten in (sub, s, rb) order == flat candidate order
-        v = vals.transpose(2, 0, 1).reshape(-1)
-        g = jnp.argmax(v)
-        any_valid = v[g] > _NEG
-        sub = (g // (S * RB)).astype(jnp.int32)
-        flat = sub * (B * P * P) + idxs.transpose(2, 0, 1).reshape(-1)[g]
-        return flat, any_valid
+        vals, majs, mins = call(Cs, Cd, nov, dlat, coef)
+        v, mj, mn = vals.reshape(-1), majs.reshape(-1), mins.reshape(-1)
+        best = jnp.max(v)
+        tie = v == best
+        r1 = jnp.max(jnp.where(tie, mj, -1))
+        r2 = jnp.max(jnp.where(tie & (mj == r1), mn, -1))
+        return r1, r2, best > _NEG
 
     return select

@@ -5,7 +5,8 @@ refreshes only the rows touched by each substitution (jax_search
 ``update_counts``; strategy of the reference's dirty-row ``update_stats``,
 src/da4ml/_binary/cmvm/state_opr.cc:285-345 of calad0i/da4ml). Oracle test:
 a from-scratch numpy greedy loop — full pair recount before every selection,
-same mc scoring, same first-flat-index tie-break, same substitution
+same mc scoring, same host-order tie-break (largest (id1, id0, sub, shift)
+key among maxima, matching the host solver's >=-scan), same substitution
 semantics — must produce exactly the device kernel's op records across a
 multi-iteration call. Any drift in the carried counts changes a selection
 and the sequences diverge.
@@ -137,12 +138,24 @@ def test_incremental_counts_match_numpy_oracle(seed, select):
         idx = np.arange(P)
         s0 = (np.arange(nb)[None, :, None, None] > 0) | (idx[None, None, :, None] < idx[None, None, None, :])
         score = np.where((C >= 2) & s0, C, -np.inf)
-        flat = int(score.argmax())
-        if not np.isfinite(score.reshape(-1)[flat]):
+        m = score.max()
+        if not np.isfinite(m):
             break
-        sub, rem = divmod(flat, nb * P * P)
-        s, rem = divmod(rem, P * P)
-        i, j = divmod(rem, P)
+        # host scan order: among maxima take the largest (id1, id0, sub, shift)
+        sub_ax, s_ax, i_ax, j_ax = np.indices(score.shape)
+        id0_ax, id1_ax = np.minimum(i_ax, j_ax), np.maximum(i_ax, j_ax)
+        shift_ax = np.where(i_ax < j_ax, s_ax, -s_ax)
+        tie = score == m
+        major = id1_ax * P + id0_ax
+        r1 = major[tie].max()
+        tie &= major == r1
+        r2 = (sub_ax * (2 * nb + 1) + shift_ax + nb)[tie].max()
+        id1_w, id0_w = divmod(r1, P)
+        sub, sk = divmod(r2, 2 * nb + 1)
+        shift = sk - nb
+        i = id0_w if shift >= 0 else id1_w
+        j = id1_w if shift >= 0 else id0_w
+        s = abs(shift)
         _np_substitute(E_ref, ni + step, sub, s, i, j)
         rec_ref.append((min(i, j), max(i, j), sub, s if i < j else -s))
 

@@ -50,7 +50,9 @@ def test_pallas_select_hard_dc(rng, monkeypatch):
 
 
 def _np_select(Cs, Cd, nov, dlat, coef):
-    """Numpy reference of the fused select: first-flat-index argmax."""
+    """Numpy reference of the fused select: host-order tie-break (largest
+    (id1, id0, sub, shift) key among score maxima), returning the rank
+    parts (major, minor) the kernel emits."""
     S, P, _ = Cs.shape
     w_mc, w_ov, pen, absolute = coef[0]
     idx = np.arange(P)
@@ -61,9 +63,17 @@ def _np_select(Cs, Cd, nov, dlat, coef):
         score = w_mc * cf + w_ov * cf * nov[None] - pen * dlat[None]
         valid = (cf >= 2) & s0[0] & ((absolute == 0) | (score >= 0))
         out.append(np.where(valid, score, -np.inf))
-    score = np.stack(out)
-    flat = int(score.argmax())
-    return flat, bool(np.isfinite(score.reshape(-1)[flat]))
+    score = np.stack(out)  # [2, S, P, P]
+    m = score.max()
+    if not np.isfinite(m):
+        return -1, -1, False
+    sub_ax, s_ax, i_ax, j_ax = np.indices(score.shape)
+    major = np.maximum(i_ax, j_ax) * P + np.minimum(i_ax, j_ax)
+    minor = sub_ax * (2 * S + 1) + np.where(i_ax < j_ax, s_ax, -s_ax) + S
+    tie = score == m
+    r1 = major[tie].max()
+    r2 = minor[tie & (major == r1)].max()
+    return int(r1), int(r2), True
 
 
 @pytest.mark.parametrize('P', [24, 512])  # 512 exercises RB > 1 with a ragged last tile
@@ -84,8 +94,8 @@ def test_make_select_tiled_matches_numpy(rng, P, coef_row):
     coef = np.asarray([coef_row], np.float32)
 
     sel = make_select(P, B, 'int16', interpret=jax.default_backend() != 'tpu')
-    flat, any_valid = jax.jit(sel)(Cs, Cd, nov, dlat, coef)
-    ref_flat, ref_valid = _np_select(Cs, Cd, nov.astype(np.float64), dlat.astype(np.float64), coef.astype(np.float64))
+    r1, r2, any_valid = jax.jit(sel)(Cs, Cd, nov, dlat, coef)
+    ref_r1, ref_r2, ref_valid = _np_select(Cs, Cd, nov.astype(np.float64), dlat.astype(np.float64), coef.astype(np.float64))
     assert bool(any_valid) == ref_valid
     if ref_valid:
-        assert int(flat) == ref_flat
+        assert (int(r1), int(r2)) == (ref_r1, ref_r2)
