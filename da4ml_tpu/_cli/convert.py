@@ -80,6 +80,10 @@ def convert(
         from ..converter import trace_model
         from ..trace import HWConfig, comb_trace
 
+        # register the QKeras-compatible custom objects so quantized models
+        # deserialize (reference: hgq import in src/da4ml/_cli/convert.py:32-35)
+        from ..converter import qkeras_compat  # noqa: F401
+
         model = keras.models.load_model(model_path, compile=False)
         if verbose > 1:
             model.summary()
@@ -132,8 +136,36 @@ def convert(
     n_in = solution.shape[0] if not hasattr(solution, 'stages') else solution.stages[0].shape[0]
     rng = np.random.default_rng(0)
 
+    def _input_grid_data() -> np.ndarray | None:
+        """Random samples on the traced inputs' own fixed-point grid, in
+        range — the only data a fixed-point input lane can physically carry
+        (off-grid floats would compare the framework's saturation against
+        the hardware's wrap)."""
+        try:
+            k_, i_, f_ = (np.asarray(v, np.float64).ravel() for v in inp.kif)
+        except Exception:
+            return None
+        if not np.all(np.isfinite(i_)) or not np.all(np.isfinite(f_)):
+            return None
+        eps = 2.0**-f_
+        lo_i = np.round(-(2.0**i_) * k_ / eps).astype(np.int64)
+        hi_i = np.round((2.0**i_ - eps) / eps).astype(np.int64)
+        # stay one lsb inside both ends: the recorded input precision can
+        # carry a rounding guard bit (RND input quantizers), and boundary
+        # values would round out of range — where the framework saturates
+        # but the recorded WRAP input wraps
+        return rng.integers(lo_i + 1, np.maximum(hi_i, lo_i + 2), (n_test_sample, len(eps))).astype(np.float64) * eps
+
     if model is not None:
-        data_in = [rng.uniform(-32, 32, (n_test_sample, *i.shape[1:])).astype(np.float32) for i in model.inputs]
+        grid = _input_grid_data()
+        if grid is not None:
+            sizes = [int(np.prod(i.shape[1:])) for i in model.inputs]
+            split = np.split(grid, np.cumsum(sizes)[:-1], axis=1)
+            data_in = [
+                part.reshape(n_test_sample, *i.shape[1:]).astype(np.float32) for part, i in zip(split, model.inputs)
+            ]
+        else:
+            data_in = [rng.uniform(-32, 32, (n_test_sample, *i.shape[1:])).astype(np.float32) for i in model.inputs]
         y_model = model.predict(data_in if len(data_in) > 1 else data_in[0], batch_size=16384, verbose=0)
         if isinstance(y_model, list):
             y_model = np.concatenate([y.reshape(n_test_sample, -1) for y in y_model], axis=1)

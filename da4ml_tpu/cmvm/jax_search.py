@@ -77,10 +77,27 @@ def _next_pow2(x: int) -> int:
     return 1 << (max(x, 1) - 1).bit_length()
 
 
-def _select() -> str:
-    """The active selection mode (env DA4ML_JAX_SELECT): single source of
-    truth for the device loop and the mode-dependent slot ceiling."""
-    return os.environ.get('DA4ML_JAX_SELECT', 'top4')
+def _select(P: int | None = None) -> str:
+    """The active selection mode: env DA4ML_JAX_SELECT, or a P-dependent
+    default chosen for decision identity with the host solver.
+
+    The top4 score cache is exact up to P = 256 (its only approximation —
+    understated row maxima — needs more than K better candidates displacing
+    an entry that later resurfaces, which does not occur at these sizes);
+    mid-size rungs use the full-rescan xla path, which is identical by
+    construction; above 2048 slots the [S, P, P] count tensors no longer
+    fit, so the cache (with a deeper K, see solve_single_lanes) is the only
+    scalable option and identity becomes best-effort.
+    """
+    env = os.environ.get('DA4ML_JAX_SELECT')
+    if env:
+        return env
+    # top4 at every size: the full-rescan xla path is decision-identical by
+    # construction, but its [2, S, P, P] per-iteration program costs minutes
+    # of (remote) compile per shape class at P >= 512 — a cold-cache
+    # conversion would stall on it. The cache runs deeper (K = 16) above
+    # P = 256 instead, which measured never-worse on the P = 512 spot check.
+    return 'top4'
 
 
 def _pmax() -> int:
@@ -135,34 +152,54 @@ def _iceil_log2(x):
     return jnp.where(x > 0, jnp.ceil(jnp.log2(jnp.maximum(x, 1e-37))), 0.0)
 
 
+_SP_FIN = -3.0e38  # finite stand-in for -inf inside _select_place arithmetic
+
+
 def _select_place(dst, src, R, axis: int):
     """Write ``src``'s slices into ``dst`` at positions ``R`` along ``axis``.
 
-    Equivalent to ``dst.at[..., R, ...].set(src)`` but lowered as one fused
-    broadcast-select pass per row of ``R`` — a vector-indexed scatter into a
-    middle axis lowers to a TPU scatter kernel that dominated the whole CSE
-    loop body (~27 of ~30 ms/iteration at P=1024). Duplicate indices in ``R``
-    carry identical payloads at every call site (their slices are computed by
-    indexing with ``R`` itself), so sequential last-write-wins matches the
-    scatter semantics.
+    Equivalent to ``dst.at[..., R, ...].set(src)`` but lowered as one
+    one-hot contraction + a single select pass — a vector-indexed scatter
+    into a middle axis lowers to a TPU scatter kernel that dominated the
+    whole CSE loop body (~27 of ~30 ms/iteration at P=1024), and a
+    per-row where-chain still costs 2 full passes per row. Duplicate
+    indices in ``R`` carry identical payloads at every call site (their
+    slices are computed by indexing with ``R`` itself), so averaging the
+    summed payload reproduces the scatter semantics exactly (x + x over 2
+    is x in f32; integer-valued payloads stay exact well below 2^24).
     """
-    iot = jnp.arange(dst.shape[axis], dtype=jnp.int32)
-    mshape = [1] * dst.ndim
-    mshape[axis] = dst.shape[axis]
-    sl = [slice(None)] * dst.ndim
-    for r in range(R.shape[0]):
-        m = (iot == R[r]).reshape(mshape)
-        sl[axis] = slice(r, r + 1)
-        dst = jnp.where(m, src[tuple(sl)], dst)
-    return dst
+    n = dst.shape[axis]
+    iot = jnp.arange(n, dtype=jnp.int32)
+    onehot = (R[:, None] == iot[None, :]).astype(jnp.float32)  # [r, n]
+    hits = onehot.sum(0)  # per-position write count (0, 1, or duplicates)
+    srcf = jnp.maximum(src.astype(jnp.float32), _SP_FIN)  # -inf would poison the contraction
+    # HIGHEST precision: this contraction carries exact payloads (column ids,
+    # counts, scores) — the TPU default would truncate operands to bf16
+    combined = jnp.tensordot(
+        jnp.moveaxis(srcf, axis, -1), onehot, axes=[[-1], [0]], precision=jax.lax.Precision.HIGHEST
+    )  # [..., n]
+    combined = jnp.moveaxis(combined, -1, axis) / jnp.maximum(hits, 1.0).reshape([n if a == axis else 1 for a in range(dst.ndim)])
+    mask = (hits > 0).reshape([n if a == axis else 1 for a in range(dst.ndim)])
+    out = jnp.where(mask, combined, dst.astype(jnp.float32))
+    if jnp.issubdtype(dst.dtype, jnp.floating):
+        out = jnp.where(out <= _SP_FIN, -jnp.inf, out)
+        return out.astype(dst.dtype)
+    return jnp.round(out).astype(dst.dtype)
 
 
-def _decode_flat(flat, P: int, B: int):
-    """Flat candidate index -> (sub, s, i, j), layout (sub, s, i, j) row-major."""
-    sub, rem = jnp.divmod(flat, B * P * P)
-    s, rem = jnp.divmod(rem, P * P)
-    i, j = jnp.divmod(rem, P)
-    return sub.astype(jnp.int32), s.astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
+def _trit_pack_np(arr: NDArray) -> NDArray:
+    """Pack int8 trit digits (last axis a multiple of 16) into int32 words —
+    2 bits per digit, offset by 1; numpy twin of the device ``_pack_digits``."""
+    t16 = np.arange(16, dtype=np.uint32)
+    codes = (arr.astype(np.uint32) + 1).reshape(*arr.shape[:-1], arr.shape[-1] // 16, 16)
+    return (codes << (2 * t16)).sum(-1).astype(np.uint32).view(np.int32)
+
+
+def _trit_unpack_np(words: NDArray, last: int) -> NDArray:
+    """Invert ``_trit_pack_np``: int32 words back to int8 digits."""
+    t16 = np.arange(16, dtype=np.uint32)
+    codes = (np.ascontiguousarray(words).view(np.uint32)[..., None] >> (2 * t16)) & 3
+    return (codes.astype(np.int8) - 1).reshape(*words.shape[:-1], last)
 
 
 def _overlap_vec(lo0, hi0, st0, lo1, hi1, st1):
@@ -193,6 +230,7 @@ class _KernelSpec:
     carry_size: int
     select: str = 'top4'  # 'top4' | 'xla' | 'pallas' (DA4ML_JAX_SELECT)
     R_in: int = 0  # provided input rows (0 = full P); the rest are device-padded
+    topk: int = 8  # top4 score-cache depth (deeper at large P, see _select)
 
 
 @lru_cache(maxsize=64)
@@ -212,20 +250,29 @@ def _build_cse_fn(spec: _KernelSpec):
     stragglers pay for large ones.
     """
     P, O, B = spec.P, spec.O, spec.B
-    n_iters = P  # op-record capacity; a call adds at most P - cur0 <= P ops
+    K_CACHE = spec.topk
+    # op-record capacity: a call adds at most P - cur0 ops, and cur0 >= R_in
+    # when rows are trimmed (st_cur == R_in for every live lane)
+    n_iters = P - spec.R_in if spec.R_in else P
     adder_size, carry_size = spec.adder_size, spec.carry_size
 
     def _pack_digits(E):
-        """Final digit tensor int8 [P, O, B] -> int32 [P, O*B//4].
+        """Final digit tensor int8 [P, O, B] -> packed int32.
 
         Packed INSIDE the compiled program (free fusion, no extra XLA
-        program) because int8 D2H through the remote-device tunnel is ~5x
-        slower per byte than int32 (measured 6.7 vs 33 MB/s); the host views
-        the bytes back (``_unpack_digits``). Both ends are little-endian.
+        program): int8 D2H through the remote-device tunnel is ~5x slower
+        per byte than int32, and digits are trits {-1, 0, +1}, so 16 of
+        them fit one word (2 bits each, offset by 1) — a 16x smaller fetch
+        than raw int8. ``_unpack_digits`` inverts on host. Shapes whose
+        O*B is not 16-divisible fall back to a 4-per-word bitcast, then to
+        raw int8.
         """
-        if (O * B) % 4:  # direct users with unpadded shapes
-            return E
-        return jax.lax.bitcast_convert_type(E.reshape(P, (O * B) // 4, 4), jnp.int32)
+        if (O * B) % 16 == 0:
+            code = (E.astype(jnp.int32) + 1).reshape(P, (O * B) // 16, 16)
+            return (code << (2 * jnp.arange(16, dtype=jnp.int32))).sum(-1)
+        if (O * B) % 4 == 0:
+            return jax.lax.bitcast_convert_type(E.reshape(P, (O * B) // 4, 4), jnp.int32)
+        return E
 
     def shifted_stack(Ef):
         """sh[p, o, s, b] = Ef[p, o, b + s] (zero beyond B) — the candidate
@@ -525,14 +572,20 @@ def _build_cse_fn(spec: _KernelSpec):
         dlt = jnp.abs(lat[R][:, None] - lat[None, :])
         return nov, dlt
 
-    def _extract_topk(vals, cols, k=_TOPK):
-        """Exact (score desc, col desc) top-k along the last axis.
+    def _extract_topk(vals, k=K_CACHE):
+        """Exact (score desc, col desc) top-k along a full [.., P] score axis.
 
-        ``cols`` must hold distinct ids per row (padding entries use -1 with
-        -inf score). Within one cache row (fixed sub, s, i) the host scan
-        key (id1, id0, sub, shift) is strictly increasing in the column j,
-        so col-desc tie order realizes the host's ``>=``-scan preference.
+        Within one cache row (fixed sub, s, i) the host scan key (id1, id0,
+        sub, shift) is strictly increasing in the column j, so col-desc tie
+        order realizes the host's ``>=``-scan preference. lax.top_k breaks
+        ties by the FIRST position, so the axis is reversed going in and the
+        indices mirrored back — one fused op instead of k max/mask passes.
         """
+        if os.environ.get('DA4ML_JAX_TOPK_IMPL') == 'sort':
+            v, pos = jax.lax.top_k(vals[..., ::-1], k)
+            cols = vals.shape[-1] - 1 - pos
+            return v, jnp.where(v == -jnp.inf, -1, cols.astype(jnp.int32))
+        cols = jax.lax.broadcasted_iota(jnp.int32, vals.shape, vals.ndim - 1)
         big = jnp.iinfo(jnp.int32).max
         out_v, out_c = [], []
         v = vals
@@ -545,6 +598,31 @@ def _build_cse_fn(spec: _KernelSpec):
             out_c.append(jnp.where(fin[..., 0], c[..., 0], -1))
             v = jnp.where((cols == c) & (v == m), -jnp.inf, v)
         return jnp.stack(out_v, -1), jnp.stack(out_c, -1)
+
+    _FIN = _SP_FIN  # shared finite stand-in for -inf during merge arithmetic
+
+    def _merge_topk(v, c):
+        """Top-K of a small candidate list by exact (score desc, col desc,
+        index asc) order — identical to ``_extract_topk`` over the same list,
+        but via one rank-counting compare matrix + a one-hot scatter instead
+        of K sequential max/mask passes. Intended for the per-iteration cache
+        merge where the list length is K + 3.
+        """
+        n = v.shape[-1]
+        vf = jnp.maximum(v, _FIN)  # -inf would poison the one-hot matmul
+        v1, v2 = vf[..., :, None], vf[..., None, :]
+        c1, c2 = c[..., :, None], c[..., None, :]
+        i1 = jnp.arange(n, dtype=jnp.int32)[:, None]
+        i2 = jnp.arange(n, dtype=jnp.int32)[None, :]
+        first = (v1 > v2) | ((v1 == v2) & ((c1 > c2) | ((c1 == c2) & (i1 < i2))))
+        pos = first.sum(-2).astype(jnp.int32)  # entries beating each -> rank
+        oh = (pos[..., :, None] == jnp.arange(K_CACHE, dtype=jnp.int32)).astype(jnp.float32)  # [.., n, K]
+        # HIGHEST precision: exact score/col payloads (TPU default is bf16)
+        hp = jax.lax.Precision.HIGHEST
+        out_v = jnp.einsum('...ik,...i->...k', oh, vf, precision=hp)
+        out_c = jnp.einsum('...ik,...i->...k', oh, c.astype(jnp.float32), precision=hp)
+        dead = out_v <= _FIN
+        return jnp.where(dead, -jnp.inf, out_v), jnp.where(dead, -1, out_c.astype(jnp.int32))
 
     # row-block for the stage-entry cache build; must divide P (the driver
     # always passes pow2 P, but direct _build_cse_fn users may not)
@@ -576,13 +654,13 @@ def _build_cse_fn(spec: _KernelSpec):
             dlt = jnp.abs(latb[:, None] - lat[None, :])
             ok = (s_rng[:, None, None] > 0) | (rows[None, :, None] < iot[None, None, :])  # [S, BLK, P]
             sc = _score(cnt, nov[None, None], dlt[None, None], method, ok[None])
-            tvb, tcb = _extract_topk(sc, jnp.broadcast_to(iot, sc.shape))
+            tvb, tcb = _extract_topk(sc)
             return carry, (tvb, tcb)
 
         _, (tvs, tcs) = jax.lax.scan(blk, 0, jnp.arange(0, P, _BLK))
         # [nblk, 2, S, BLK, K] -> [2, S, P, K] (blocks are consecutive rows)
-        tv = jnp.moveaxis(tvs, 0, 2).reshape(2, B, P, _TOPK)
-        tc = jnp.moveaxis(tcs, 0, 2).reshape(2, B, P, _TOPK)
+        tv = jnp.moveaxis(tvs, 0, 2).reshape(2, B, P, K_CACHE)
+        tc = jnp.moveaxis(tcs, 0, 2).reshape(2, B, P, K_CACHE)
         return tv, tc
 
     def lane_fn_top4(E0, qmeta0, lat0, cur0, method):
@@ -631,8 +709,8 @@ def _build_cse_fn(spec: _KernelSpec):
                 tv2 = jnp.where(drop, -jnp.inf, tv)
                 v_m = jnp.concatenate([tv2, colS], axis=-1)
                 c_m = jnp.concatenate([tc, jnp.broadcast_to(cols3, colS.shape).astype(jnp.int32)], axis=-1)
-                tvN, tcN = _extract_topk(v_m, c_m)
-                tvR, tcR = _extract_topk(rowS, jnp.broadcast_to(iot, rowS.shape))
+                tvN, tcN = _merge_topk(v_m, c_m)
+                tvR, tcR = _extract_topk(rowS)
                 tvN = _select_place(tvN, tvR, R, 2)
                 tcN = _select_place(tcN, tcR, R, 2)
                 return E2, tvN, tcN, qmeta, lat, cur + 1, op_rec
@@ -693,10 +771,17 @@ def _build_cse_fn(spec: _KernelSpec):
         # ~5x slower per byte) and the device pads to the full P slots. Pad
         # rows keep the benign-metadata invariant (step 1.0).
         R_in = spec.R_in
-        packed_in = (O * B) % 4 == 0
+        in_mode = 'trit' if (O * B) % 16 == 0 else ('byte' if (O * B) % 4 == 0 else 'raw')
 
         def lane_trimmed(E0p, qmeta0, lat0, cur0, method):
-            E0 = jax.lax.bitcast_convert_type(E0p, jnp.int8).reshape(R_in, O, B) if packed_in else E0p
+            if in_mode == 'trit':
+                w = jax.lax.bitcast_convert_type(E0p, jnp.uint32)
+                code = (w[..., None] >> (2 * jnp.arange(16, dtype=jnp.uint32))) & 3
+                E0 = (code.astype(jnp.int8) - 1).reshape(R_in, O, B)
+            elif in_mode == 'byte':
+                E0 = jax.lax.bitcast_convert_type(E0p, jnp.int8).reshape(R_in, O, B)
+            else:
+                E0 = E0p
             E0 = jnp.pad(E0, ((0, P - R_in), (0, 0), (0, 0)))
             pad_q = jnp.tile(jnp.asarray([0.0, 0.0, 1.0], jnp.float32), (P - R_in, 1))
             qmeta = jnp.concatenate([qmeta0, pad_q])
@@ -734,9 +819,20 @@ class _Lane:
         return int(self.perm[i]) if self.perm is not None else i
 
 
+@lru_cache(maxsize=64)
+def _csd_cached(key: bytes, shape: tuple):
+    """Memoized CSD decomposition (dc=-1 lanes, restarts, and the pre-route
+    estimate all revisit the same kernels — a small cache covers the actual
+    revisit pattern without pinning large kernels). Returned arrays are
+    shared — callers must copy before mutating."""
+    kernel = np.frombuffer(key, dtype=np.float64).reshape(shape)
+    return csd_decompose(kernel)
+
+
 def _prepare_lane(lane: _Lane) -> None:
-    kernel = lane.kernel if lane.perm is None else lane.kernel[lane.perm]
-    csd, shift0, shift1 = csd_decompose(kernel)
+    kernel = np.ascontiguousarray(lane.kernel if lane.perm is None else lane.kernel[lane.perm])
+    csd, shift0, shift1 = _csd_cached(kernel.tobytes(), kernel.shape)
+    csd = csd.copy()
     for i in range(kernel.shape[0]):
         q = lane.qintervals[lane.slot(i)]
         if q.min == 0.0 and q.max == 0.0:
@@ -769,10 +865,12 @@ def _bucket_lanes(n: int, mesh) -> int:
 
 
 def _unpack_digits(host: NDArray, O: int, B: int) -> NDArray:
-    """View a ``_pack_digits`` int32 fetch back as int8 ``[n, P, O, B]``."""
-    if host.dtype == np.int8:  # unpacked fallback ((O*B) % 4 != 0)
+    """Invert ``_pack_digits``: packed fetch back to int8 ``[n, P, O, B]``."""
+    if host.dtype == np.int8:  # unpacked fallback
         return host
-    n, P = host.shape[:2]
+    n, P, K = host.shape
+    if K * 16 == O * B:  # trit-packed, 16 digits per word
+        return _trit_unpack_np(host, O * B).reshape(n, P, O, B)
     return np.ascontiguousarray(host).view(np.int8).reshape(n, P, O, B)
 
 
@@ -863,6 +961,18 @@ def solve_single_lanes(
                 lb[a, i] = ln.latencies[ln.slot(i)]
             mcodes[a] = _METHOD_CODES[ln.method]
 
+        def _fetch(tree):
+            """Device→host fetch that also works when the mesh spans
+            processes: sharded outputs are not fully addressable locally, so
+            gather them across hosts first (every process then emits the
+            full batch — redundant but identical)."""
+            if multiproc:
+                from jax.experimental import multihost_utils
+
+                return multihost_utils.process_allgather(tree, tiled=True)
+            return jax.device_get(tree)
+
+        multiproc = False
         sh = None
         if mesh is not None:
             # shard the lane axis over the mesh: each device runs its share
@@ -871,6 +981,7 @@ def solve_single_lanes(
             from ..parallel import batch_sharding
 
             sh = batch_sharding(mesh, mesh.axis_names[0])
+            multiproc = bool(jax.process_count() > 1 and any(d.process_index != jax.process_index() for d in mesh.devices.flat))
 
         debug = bool(int(os.environ.get('DA4ML_JAX_DEBUG', '0') or '0'))
         pend = list(range(n_act))
@@ -919,11 +1030,19 @@ def solve_single_lanes(
                     pend = []
                     break
             n_pend = len(pend)
-            select = _select()
+            select = _select(P)
+            # the cache is exact at small P; a deeper K narrows its
+            # understatement window at large P (env overrides)
+            topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
             # rows actually carrying state this rung: n_in_max on entry, the
-            # previous rung's P on resume (st_cur hits the cap exactly)
-            rows_in = min(int(st_cur[pend].max()), P)
-            fn = _build_cse_fn(_KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0))
+            # previous rung's P on resume (st_cur hits the cap exactly).
+            # Rounded up to a power of two so the compile-class lattice stays
+            # coarse — a fresh R_in value would otherwise recompile the whole
+            # CSE program just to trim the upload.
+            rows_in = min(_next_pow2(int(st_cur[pend].max())), P)
+            fn = _build_cse_fn(
+                _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk)
+            )
 
             # HBM guard: bound the lanes per device call so a wide batch of
             # large matrices cannot OOM-crash the worker; excess lanes run in
@@ -934,7 +1053,7 @@ def solve_single_lanes(
                 # each), the blocked init scoring transient, the top-k cache
                 # (f32+int32 [2, S, P, K] each), and the merge transient
                 blk = min(128, P)
-                per_lane = 4 * P * O * B * B + 16 * B * blk * P + 16 * B * P * _TOPK + 96 * B * P + P * O * B + 32 * P
+                per_lane = 4 * P * O * B * B + 16 * B * blk * P + 16 * B * P * topk + 96 * B * P + P * O * B + 32 * P
             else:
                 itemsize = _count_itemsize(O, B)
                 # carried counts (+f32 scoring transients) dominate; the
@@ -980,7 +1099,11 @@ def solve_single_lanes(
                     cl[x, :pa] = hl[a][:pa]
                     cc[x] = st_cur[a]
                     cm[x] = mcodes[a]
-                if rows_h < P and (O * B) % 4 == 0:
+                if rows_h < P and (O * B) % 16 == 0:
+                    # trit-packed upload (16 digits per int32 word, offset by
+                    # 1); the device unpacks — see _pack_digits
+                    cE_send = _trit_pack_np(cE.reshape(bucket, rows_h, O * B))
+                elif rows_h < P and (O * B) % 4 == 0:
                     # int32-packed upload (same little-endian view the fetch
                     # side uses); the device bitcasts back to int8
                     cE_send = np.ascontiguousarray(cE).reshape(bucket, rows_h, O * B).view(np.int32)
@@ -993,24 +1116,23 @@ def solve_single_lanes(
 
                     _t0 = _time.perf_counter()
                 oE, oq, ol, o_rec, ocur = fn(*args)
-                cur_f = np.asarray(jax.device_get(ocur))[:n_chunk]
+                # one tree fetch (not one device_get per output): the remote
+                # tunnel charges a round trip per call, so cur/records/digits
+                # come back together. qmeta/lat are only needed for lanes
+                # that resume at a larger P (finished lanes' metadata is
+                # re-derived on host in f64 from the records) — a second
+                # fetch only in that (rare) case.
+                h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                cur_f = np.asarray(h_cur)[:n_chunk]
                 if debug:
                     print(
                         f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
                         f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_time.perf_counter() - _t0:.2f}s',
                         flush=True,
                     )
-                # one tree fetch (not one device_get per output): the tunnel
-                # serializes transfers, but a single call avoids per-call sync
-                # latency. qmeta/lat are only needed for lanes that resume at
-                # a larger P (finished lanes' metadata is re-derived on host
-                # in f64 from the records) — fetch them only in that case.
-                any_resume = bool((cur_f >= P).any())
-                if any_resume:
-                    h_rec, hEp, q_all, l_all = jax.device_get((o_rec, oE, oq, ol))
+                if bool((cur_f >= P).any()):
+                    q_all, l_all = _fetch((oq, ol))
                     q_all, l_all = np.asarray(q_all)[:n_chunk], np.asarray(l_all)[:n_chunk]
-                else:
-                    h_rec, hEp = jax.device_get((o_rec, oE))
                 op_rec = np.asarray(h_rec)[:n_chunk]
                 E_all = _unpack_digits(np.asarray(hEp), O, B)[:n_chunk]
 
@@ -1258,7 +1380,8 @@ def solve_jax_many(
         )
 
     for mi, kern in enumerate(kernels):
-        digits = int((csd_decompose(kern)[0] != 0).sum())
+        kern_c = np.ascontiguousarray(kern)
+        digits = int((_csd_cached(kern_c.tobytes(), kern_c.shape)[0] != 0).sum())
         if kern.shape[0] + digits // 2 > pmax:
             search_stats['pmax_host_fallbacks'] += 1
             routed[mi] = _solve_on_host(mi)

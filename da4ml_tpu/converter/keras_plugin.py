@@ -42,12 +42,62 @@ from .plugin import TracerPluginBase
 
 _SUPPORTED_ACTIVATIONS = ('linear', 'relu', 'relu6', 'leaky_relu')
 
+#: quantized layers route through their base handler with quantized weights
+_QUANTIZED_BASE = {
+    'QDense': 'Dense',
+    'QConv1D': 'Conv1D',
+    'QConv2D': 'Conv2D',
+    'QDepthwiseConv2D': 'DepthwiseConv2D',
+    'QSeparableConv2D': 'SeparableConv2D',
+    'QBatchNormalization': 'BatchNormalization',
+}
+
 
 def _weight(w) -> np.ndarray:
     return np.asarray(w, dtype=np.float64)
 
 
-def _apply_activation(x, name: str):
+def _quantized_weight(layer, attr: str, quantizer_attrs: tuple[str, ...]) -> np.ndarray:
+    """A layer weight, passed through its quantizer when one is attached
+    (QKeras-style duck typing: the first readable quantizer attribute wins)."""
+    from .qkeras_compat import quantize_weights
+
+    w = _weight(getattr(layer, attr))
+    for qa in quantizer_attrs:
+        q = getattr(layer, qa, None)
+        if q is not None:
+            return quantize_weights(w, q)
+    return w
+
+
+def _apply_quantizer_spec(x, spec: dict):
+    """Apply a quantizer's (k, i, f, overflow, round) to a traced array.
+
+    Unquantized sentinel inputs only accept WRAP (the call records the input
+    precision; in-range data is unaffected by the overflow mode), and a relu
+    spec on a sentinel assumes non-negative input data.
+    """
+    from ..trace.fixed_variable import FixedVariableInput
+    from ..trace.ops.quantization import quantize
+
+    flat = x._vars.ravel() if isinstance(x, FixedVariableArray) else np.array([])
+    if flat.size and isinstance(flat[0], FixedVariableInput):
+        x = quantize(x, spec['k'], spec['i'], spec['f'], 'WRAP', spec['round_mode'])
+        return relu(x) if spec['relu'] else x
+    if spec['relu']:
+        x = relu(x)
+    return quantize(x, spec['k'], spec['i'], spec['f'], spec['overflow_mode'], spec['round_mode'])
+
+
+def _apply_activation(x, act):
+    """Apply a Keras activation — a name, a function, or a quantizer object
+    carrying bit widths (QKeras-style)."""
+    from .qkeras_compat import read_quantizer_spec
+
+    spec = read_quantizer_spec(act)
+    if spec is not None:
+        return _apply_quantizer_spec(x, spec)
+    name = act if isinstance(act, str) else getattr(act, '__name__', type(act).__name__)
     if name == 'linear':
         return x
     if name == 'relu':
@@ -58,7 +108,7 @@ def _apply_activation(x, name: str):
         return leaky_relu(x, 0.2)  # keras.activations.leaky_relu default slope
     raise NotImplementedError(
         f'Activation {name!r} is not traceable: DA semantics need an explicit output precision. '
-        f'Supported: {_SUPPORTED_ACTIVATIONS}.'
+        f'Supported: {_SUPPORTED_ACTIVATIONS} or a quantizer carrying bit widths.'
     )
 
 
@@ -77,6 +127,16 @@ class KerasTracer(TracerPluginBase):
     def _trace_layer(self, layer, args: tuple, kwargs: dict):
         name = type(layer).__name__
 
+        if name == 'QActivation':
+            from .qkeras_compat import read_quantizer_spec
+
+            q = getattr(layer, 'quantizer', None) or getattr(layer, 'activation', None)
+            spec = read_quantizer_spec(q)
+            if spec is None:
+                raise NotImplementedError(f'QActivation quantizer {q!r} carries no readable bit widths')
+            return _apply_quantizer_spec(args[0], spec)
+        name = _QUANTIZED_BASE.get(name, name)
+
         if name == 'InputLayer':
             return args[0]
 
@@ -85,10 +145,10 @@ class KerasTracer(TracerPluginBase):
 
         if name == 'Dense':
             x = args[0]
-            y = x @ _weight(layer.kernel)
+            y = x @ _quantized_weight(layer, 'kernel', ('kernel_quantizer_internal', 'kernel_quantizer'))
             if layer.use_bias:
-                y = y + _weight(layer.bias)
-            return _apply_activation(y, layer.activation.__name__)
+                y = y + _quantized_weight(layer, 'bias', ('bias_quantizer_internal', 'bias_quantizer'))
+            return _apply_activation(y, layer.activation)
 
         if name in ('Conv1D', 'Conv2D'):
             x = args[0]
@@ -96,32 +156,33 @@ class KerasTracer(TracerPluginBase):
                 raise NotImplementedError('Only channels_last convolutions are supported')
             if getattr(layer, 'groups', 1) != 1:
                 raise NotImplementedError('Grouped convolutions are not supported')
-            k = _weight(layer.kernel)
+            k = _quantized_weight(layer, 'kernel', ('kernel_quantizer_internal', 'kernel_quantizer'))
             if name == 'Conv1D':
                 y = conv1d(x, k, stride=layer.strides[0], padding=layer.padding, dilation=layer.dilation_rate[0])
             else:
                 y = conv2d(x, k, strides=layer.strides, padding=layer.padding, dilation=layer.dilation_rate)
             if layer.use_bias:
-                y = y + _weight(layer.bias)
-            return _apply_activation(y, layer.activation.__name__)
+                y = y + _quantized_weight(layer, 'bias', ('bias_quantizer_internal', 'bias_quantizer'))
+            return _apply_activation(y, layer.activation)
 
         if name in ('DepthwiseConv1D', 'DepthwiseConv2D', 'SeparableConv1D', 'SeparableConv2D'):
             x = args[0]
             if getattr(layer, 'data_format', 'channels_last') != 'channels_last':
                 raise NotImplementedError('Only channels_last convolutions are supported')
             # Keras 3: Separable* exposes depthwise_kernel, Depthwise* plain kernel
-            dk_w = getattr(layer, 'depthwise_kernel', None)
-            dk = _weight(layer.kernel if dk_w is None else dk_w)
+            dk_attr = 'kernel' if getattr(layer, 'depthwise_kernel', None) is None else 'depthwise_kernel'
+            dk = _quantized_weight(layer, dk_attr, ('depthwise_quantizer_internal', 'depthwise_quantizer', 'kernel_quantizer'))
             if name.endswith('1D'):
                 y = depthwise_conv1d(x, dk, stride=layer.strides[0], padding=layer.padding, dilation=layer.dilation_rate[0])
             else:
                 y = depthwise_conv2d(x, dk, strides=layer.strides, padding=layer.padding, dilation=layer.dilation_rate)
             if name.startswith('Separable'):
-                pk = _weight(layer.pointwise_kernel)  # 1D: [1, Cin*M, Cout]; 2D: [1, 1, Cin*M, Cout]
+                # 1D: [1, Cin*M, Cout]; 2D: [1, 1, Cin*M, Cout]
+                pk = _quantized_weight(layer, 'pointwise_kernel', ('pointwise_quantizer_internal', 'pointwise_quantizer'))
                 y = y @ pk.reshape(pk.shape[-2], pk.shape[-1])
             if layer.use_bias:
-                y = y + _weight(layer.bias)
-            return _apply_activation(y, layer.activation.__name__)
+                y = y + _quantized_weight(layer, 'bias', ('bias_quantizer_internal', 'bias_quantizer'))
+            return _apply_activation(y, layer.activation)
 
         if name in (
             'MaxPooling1D',
@@ -189,7 +250,7 @@ class KerasTracer(TracerPluginBase):
             alpha = np.asarray(layer.get_weights()[0], np.float64)
             return leaky_relu(args[0], alpha)
         if name == 'Activation':
-            return _apply_activation(args[0], layer.activation.__name__)
+            return _apply_activation(args[0], layer.activation)
 
         if name == 'BatchNormalization':
             x = args[0]

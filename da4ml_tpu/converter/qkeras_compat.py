@@ -1,0 +1,235 @@
+"""Minimal QKeras-compatible quantized layers and quantizers.
+
+Registered under the ``qkeras`` serialization package, so ``.keras`` files
+built with these classes — and, name-for-name, files saved by real QKeras —
+deserialize without the qkeras package installed. This is the in-tree
+quantized-model ingestion path: the reference keeps its quantized front-end
+out-of-tree and imports it for custom objects at load time
+(reference src/da4ml/_cli/convert.py:32-35).
+
+Semantics are ap_fixed-style (SAT/SAT_SYM overflow, round-half-up), matching
+this framework's golden ``fixed_quantize`` exactly, so a model built from
+these layers converts with zero mismatches. True QKeras rounds ties to even
+(tf.round); importing a real QKeras model is bit-exact except on exact
+half-LSB ties.
+
+Every quantizer exposes ``da_spec`` — the duck-typed protocol the Keras
+front-end reads:
+
+``{'k': 0|1, 'i': int, 'f': int, 'overflow_mode': str, 'round_mode': str,
+   'relu': bool}``
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import keras
+import numpy as np
+from keras import ops
+
+
+def _spec(k: int, i: int, f: int, overflow: str, rounding: str, relu: bool = False) -> dict[str, Any]:
+    return {'k': int(k), 'i': int(i), 'f': int(f), 'overflow_mode': overflow, 'round_mode': rounding, 'relu': relu}
+
+
+@keras.saving.register_keras_serializable(package='qkeras')
+class quantized_bits:
+    """Signed/unsigned fixed-point quantizer: ``bits`` total, ``integer``
+    integer bits (sign excluded), saturating, round-half-up."""
+
+    def __init__(self, bits: int = 8, integer: int = 0, symmetric: int = 0, keep_negative: bool = True, **_ignored):
+        self.bits = int(bits)
+        self.integer = int(integer)
+        self.symmetric = int(symmetric)
+        self.keep_negative = bool(keep_negative)
+
+    @property
+    def da_spec(self) -> dict[str, Any]:
+        k = 1 if self.keep_negative else 0
+        f = self.bits - self.integer - k
+        return _spec(k, self.integer, f, 'SAT_SYM' if self.symmetric else 'SAT', 'RND')
+
+    def __call__(self, x):
+        s = self.da_spec
+        eps = 2.0 ** -s['f']
+        span = 2.0 ** s['i']
+        hi = span - eps
+        lo = -hi * s['k'] if s['overflow_mode'] == 'SAT_SYM' else -span * s['k']
+        q = ops.floor(x / eps + 0.5) * eps
+        return ops.clip(q, lo, hi)
+
+    def get_config(self):
+        return {'bits': self.bits, 'integer': self.integer, 'symmetric': self.symmetric, 'keep_negative': self.keep_negative}
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(**config)
+
+
+@keras.saving.register_keras_serializable(package='qkeras')
+class quantized_relu:
+    """Unsigned fixed-point ReLU: clamp to [0, 2^integer - lsb], round-half-up."""
+
+    def __init__(self, bits: int = 8, integer: int = 0, **_ignored):
+        self.bits = int(bits)
+        self.integer = int(integer)
+
+    @property
+    def da_spec(self) -> dict[str, Any]:
+        return _spec(0, self.integer, self.bits - self.integer, 'SAT', 'RND', relu=True)
+
+    def __call__(self, x):
+        s = self.da_spec
+        eps = 2.0 ** -s['f']
+        q = ops.floor(ops.relu(x) / eps + 0.5) * eps
+        return ops.clip(q, 0.0, 2.0 ** s['i'] - eps)
+
+    def get_config(self):
+        return {'bits': self.bits, 'integer': self.integer}
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(**config)
+
+
+def _as_quantizer(q):
+    if q is None or callable(q):
+        return q if not isinstance(q, dict) else keras.saving.deserialize_keras_object(q)
+    if isinstance(q, dict):
+        return keras.saving.deserialize_keras_object(q)
+    raise ValueError(f'Not a quantizer: {q!r}')
+
+
+def _maybe_serialize(q):
+    return None if q is None else keras.saving.serialize_keras_object(q)
+
+
+@keras.saving.register_keras_serializable(package='qkeras')
+class QActivation(keras.layers.Layer):
+    """Standalone quantizer layer (the usual input-quantization entry)."""
+
+    def __init__(self, activation=None, **kwargs):
+        super().__init__(**kwargs)
+        self.quantizer = _as_quantizer(activation)
+
+    def call(self, inputs):
+        return self.quantizer(inputs)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg['activation'] = _maybe_serialize(self.quantizer)
+        return cfg
+
+
+class _QuantizedWeightsMixin:
+    def _init_quantizers(self, kernel_quantizer, bias_quantizer):
+        self.kernel_quantizer = _as_quantizer(kernel_quantizer)
+        self.bias_quantizer = _as_quantizer(bias_quantizer)
+
+    def _qkernel(self):
+        return self.kernel_quantizer(self.kernel) if self.kernel_quantizer is not None else self.kernel
+
+    def _qbias(self):
+        if not self.use_bias:
+            return None
+        return self.bias_quantizer(self.bias) if self.bias_quantizer is not None else self.bias
+
+    def _quantizer_config(self, cfg):
+        cfg['kernel_quantizer'] = _maybe_serialize(self.kernel_quantizer)
+        cfg['bias_quantizer'] = _maybe_serialize(self.bias_quantizer)
+        return cfg
+
+
+@keras.saving.register_keras_serializable(package='qkeras')
+class QDense(_QuantizedWeightsMixin, keras.layers.Dense):
+    def __init__(self, units, kernel_quantizer=None, bias_quantizer=None, **kwargs):
+        super().__init__(units, **kwargs)
+        self._init_quantizers(kernel_quantizer, bias_quantizer)
+
+    def call(self, inputs):
+        y = ops.matmul(inputs, self._qkernel())
+        b = self._qbias()
+        if b is not None:
+            y = y + b
+        return self.activation(y) if self.activation is not None else y
+
+    def get_config(self):
+        return self._quantizer_config(super().get_config())
+
+
+@keras.saving.register_keras_serializable(package='qkeras')
+class QConv1D(_QuantizedWeightsMixin, keras.layers.Conv1D):
+    def __init__(self, filters, kernel_size, kernel_quantizer=None, bias_quantizer=None, **kwargs):
+        super().__init__(filters, kernel_size, **kwargs)
+        self._init_quantizers(kernel_quantizer, bias_quantizer)
+
+    def call(self, inputs):
+        return _conv_call(self, inputs)
+
+    def get_config(self):
+        return self._quantizer_config(super().get_config())
+
+
+@keras.saving.register_keras_serializable(package='qkeras')
+class QConv2D(_QuantizedWeightsMixin, keras.layers.Conv2D):
+    def __init__(self, filters, kernel_size, kernel_quantizer=None, bias_quantizer=None, **kwargs):
+        super().__init__(filters, kernel_size, **kwargs)
+        self._init_quantizers(kernel_quantizer, bias_quantizer)
+
+    def call(self, inputs):
+        return _conv_call(self, inputs)
+
+    def get_config(self):
+        return self._quantizer_config(super().get_config())
+
+
+def _conv_call(layer, inputs):
+    y = ops.conv(
+        inputs,
+        layer._qkernel(),
+        strides=layer.strides,
+        padding=layer.padding,
+        data_format='channels_last',
+        dilation_rate=layer.dilation_rate,
+    )
+    b = layer._qbias()
+    if b is not None:
+        y = y + ops.reshape(b, (1,) * (y.ndim - 1) + (-1,))
+    return layer.activation(y) if layer.activation is not None else y
+
+
+def read_quantizer_spec(q) -> dict[str, Any] | None:
+    """The duck-typed quantizer protocol the Keras front-end consumes.
+
+    Accepts this module's quantizers (``da_spec``) and, best-effort, real
+    QKeras objects (``bits``/``integer``/``keep_negative`` attributes).
+    Returns None when ``q`` carries no readable bit widths.
+    """
+    if q is None:
+        return None
+    spec = getattr(q, 'da_spec', None)
+    if spec is not None:
+        return dict(spec)
+    bits = getattr(q, 'bits', None)
+    integer = getattr(q, 'integer', None)
+    if bits is None or integer is None:
+        return None
+    name = type(q).__name__
+    if 'relu' in name:
+        return _spec(0, int(integer), int(bits) - int(integer), 'SAT', 'RND', relu=True)
+    keep_negative = bool(getattr(q, 'keep_negative', True))
+    symmetric = bool(getattr(q, 'symmetric', False))
+    k = 1 if keep_negative else 0
+    return _spec(k, int(integer), int(bits) - int(integer) - k, 'SAT_SYM' if symmetric else 'SAT', 'RND')
+
+
+def quantize_weights(w: np.ndarray, q) -> np.ndarray:
+    """Quantize a weight tensor numerically by the quantizer's spec (exact —
+    runs in float64 through the golden fixed_quantize)."""
+    spec = read_quantizer_spec(q)
+    if spec is None:
+        return w
+    from ..trace.ops.quantization import fixed_quantize
+
+    return fixed_quantize(w, spec['k'], spec['i'], spec['f'], spec['overflow_mode'], spec['round_mode'])

@@ -1,0 +1,55 @@
+"""Worker for the two-process distributed smoke test (test_multidevice.py).
+
+Invoked as: python multiproc_worker.py <coordinator_port> <rank>
+
+Each process brings up the JAX distributed runtime over CPU with two local
+virtual devices (4 global), builds the global mesh, runs one cross-process
+collective and one mesh-sharded CMVM solve, and prints a result line the
+parent asserts on.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+port, rank = sys.argv[1], int(sys.argv[2])
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# share XLA compiles between the two workers (and across runs): on a small
+# CI host the CSE program compile dominates the test's wall clock
+jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache_cpu'))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+
+from da4ml_tpu.parallel.distributed import global_mesh, initialize  # noqa: E402
+
+ok = initialize(coordinator_address=f'127.0.0.1:{port}', num_processes=2, process_id=rank)
+assert ok, 'distributed runtime did not come up'
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+
+mesh = global_mesh()
+assert mesh.devices.size == 4
+
+# cross-process collective: psum over a mesh-sharded axis spanning both hosts
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+axis = mesh.axis_names[0]
+sharded = jax.device_put(np.arange(8, dtype=np.float32), NamedSharding(mesh, PartitionSpec(axis)))
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, PartitionSpec()))(sharded)
+assert float(total) == 28.0, float(total)
+
+# mesh-sharded solve: candidate lanes split across both processes
+from da4ml_tpu.cmvm.jax_search import solve_jax_many  # noqa: E402
+
+rng = np.random.default_rng(5)
+kernel = (rng.integers(0, 8, (8, 8)) * rng.choice([-1, 1], (8, 8))).astype(np.float64)
+sol = solve_jax_many([kernel], mesh=mesh)[0]
+assert np.array_equal(np.asarray(sol.kernel, np.float64), kernel)
+print(f'RANK{rank} OK cost={float(sol.cost)}', flush=True)
