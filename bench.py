@@ -278,6 +278,16 @@ def _section_kernels(name: str, n1: int, limited: bool):
         if limited:
             shapes = tuple((d, b) for d, b in shapes if d <= 16)
         return [_rand_kernel(rng, d, d, b) for d, b in shapes]
+    if name == '3b_large_dim':
+        # the BASELINE sweep's large end (its span is 8-256 dim): a 128-dim
+        # instance searches fully on device; a 256-dim instance (opt-in,
+        # DA4ML_BENCH_LARGE=1) keeps its decomposed dc lanes on device while
+        # the undecomposed lane exceeds single-chip memory and runs host-side
+        # via lane-level routing
+        shapes = [(96, 4)] if limited else [(128, 6)]
+        if os.environ.get('DA4ML_BENCH_LARGE') == '1' and not limited:
+            shapes.append((256, 4))
+        return [_rand_kernel(rng, d, d, b) for d, b in shapes]
     if name == '4_qconv3x3_im2col':
         shapes = ((1, 8), (4, 8), (8, 16), (16, 16))
         if limited:
@@ -351,6 +361,39 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
             'win_or_tie_portfolio': f'{int((portfolio_costs <= host_costs).sum())}/{len(k1)}',
             'wall_s': round(wall, 2),
         }
+    if name == 'quality_1000':
+        # on-demand (not in the default budget): the reference-scale quality
+        # sweep — 1000 random kernels, dims 2-32, 1-8 bit, device vs host
+        # cost distribution (reference bench.py / wtf.py scale)
+        from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+        rng = np.random.default_rng(1000)
+        n = 96 if limited else 1000
+        kernels = []
+        for _ in range(n):
+            d1, d2 = int(rng.integers(2, 33)), int(rng.integers(2, 33))
+            kernels.append(_rand_kernel(rng, d1, d2, int(rng.integers(1, 9))))
+        host_sols, host_t = _host_solve(kernels, host_backend)
+        solve_jax_many(kernels[:8])  # warm the dominant shape classes
+        t0 = time.perf_counter()
+        jax_sols = solve_jax_many(kernels)
+        jt = time.perf_counter() - t0
+        hc = np.asarray([s.cost for s in host_sols])
+        dc = np.asarray([s.cost for s in jax_sols])
+        d = dc - hc
+        return {
+            'n_kernels': n,
+            'identical': int((d == 0).sum()),
+            'win': int((d < 0).sum()),
+            'loss': int((d > 0).sum()),
+            'mean_cost_host': round(float(hc.mean()), 3),
+            'mean_cost_jax': round(float(dc.mean()), 3),
+            'mean_delta': round(float(d.mean()), 4),
+            'max_loss': float(d.max()),
+            'max_win': float(-d.min()),
+            'host_rate': round(n / host_t, 2),
+            'jax_rate': round(n / jt, 2),
+        }
     if name == 'select_modes':
         # selection-mode microbench: top4 (default, O(S*P) score cache) vs
         # the full-rescan xla path vs its fused-pallas variant
@@ -374,7 +417,14 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
     return _with_shape_classes(_run_config(name, _section_kernels(name, n1, limited), host_backend))
 
 
-_CONFIG_SECTIONS = ('1_16x16_int4', '2_jedi_mlp_layers', '3_dim_bits_sweep', '4_qconv3x3_im2col', '5_full_model_trace')
+_CONFIG_SECTIONS = (
+    '1_16x16_int4',
+    '2_jedi_mlp_layers',
+    '3_dim_bits_sweep',
+    '3b_large_dim',
+    '4_qconv3x3_im2col',
+    '5_full_model_trace',
+)
 _MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference')
 
 

@@ -77,27 +77,20 @@ def _next_pow2(x: int) -> int:
     return 1 << (max(x, 1) - 1).bit_length()
 
 
-def _select(P: int | None = None) -> str:
-    """The active selection mode: env DA4ML_JAX_SELECT, or a P-dependent
-    default chosen for decision identity with the host solver.
+def _select() -> str:
+    """The active selection mode (env DA4ML_JAX_SELECT, default top4).
 
-    The top4 score cache is exact up to P = 256 (its only approximation —
-    understated row maxima — needs more than K better candidates displacing
-    an entry that later resurfaces, which does not occur at these sizes);
-    mid-size rungs use the full-rescan xla path, which is identical by
-    construction; above 2048 slots the [S, P, P] count tensors no longer
-    fit, so the cache (with a deeper K, see solve_single_lanes) is the only
-    scalable option and identity becomes best-effort.
+    top4 at every size: its score cache is exact up to P = 256 (the only
+    approximation — understated row maxima — needs more than K better
+    candidates displacing an entry that later resurfaces, which does not
+    occur at these sizes) and runs deeper (K = 16, see solve_single_lanes)
+    above that, which measured never-worse on the P = 512 spot check. The
+    full-rescan xla path is decision-identical by construction but its
+    [2, S, P, P] per-iteration program costs minutes of (remote) compile
+    per shape class at P >= 512 — a cold-cache conversion would stall on
+    it — so it stays opt-in.
     """
-    env = os.environ.get('DA4ML_JAX_SELECT')
-    if env:
-        return env
-    # top4 at every size: the full-rescan xla path is decision-identical by
-    # construction, but its [2, S, P, P] per-iteration program costs minutes
-    # of (remote) compile per shape class at P >= 512 — a cold-cache
-    # conversion would stall on it. The cache runs deeper (K = 16) above
-    # P = 256 instead, which measured never-worse on the P = 512 spot check.
-    return 'top4'
+    return os.environ.get('DA4ML_JAX_SELECT', 'top4')
 
 
 def _pmax() -> int:
@@ -269,7 +262,11 @@ def _build_cse_fn(spec: _KernelSpec):
         """
         if (O * B) % 16 == 0:
             code = (E.astype(jnp.int32) + 1).reshape(P, (O * B) // 16, 16)
-            return (code << (2 * jnp.arange(16, dtype=jnp.int32))).sum(-1)
+            # pin int32: under jax_enable_x64 (leaked by a wide-program DAIS
+            # executor in the same process) the sum would promote to int64
+            # and double the fetch; the mod-2^32 wrap is exactly the bit
+            # pattern the host view expects
+            return (code << (2 * jnp.arange(16, dtype=jnp.int32))).sum(-1).astype(jnp.int32)
         if (O * B) % 4 == 0:
             return jax.lax.bitcast_convert_type(E.reshape(P, (O * B) // 4, 4), jnp.int32)
         return E
@@ -902,6 +899,29 @@ def solve_single_lanes(
 
     dummy_idx = [k for k, ln in enumerate(lanes) if ln.method == 'dummy']
     results: dict[int, CombLogic] = {}
+
+    # Lane-level slot-demand routing: each CSE merge eliminates >= 2 digit
+    # pairs, so a lane needs at most n_in + digits/2 slots. Lanes beyond the
+    # device ceiling run on the host solver — per LANE, so e.g. a 256-dim
+    # matrix keeps its decomposed (dc >= 0) candidates on device and only
+    # the undecomposed monster goes host-side.
+    pmax_route = _pmax()
+    over = [
+        k
+        for k, ln in enumerate(lanes)
+        if ln.method != 'dummy' and ln.csd.shape[0] + _lane_initial_digits(ln) // 2 > pmax_route
+    ]
+    if over:
+        from .core import solve_single as _host_solve_single
+
+        memo: dict[tuple, CombLogic] = {}
+        for k in over:
+            ln = lanes[k]
+            search_stats['pmax_host_fallbacks'] += 1
+            key = (ln.kernel.tobytes(), ln.kernel.shape, ln.method)
+            if key not in memo:
+                memo[key] = _host_solve_single(ln.kernel, ln.method, ln.qintervals, ln.latencies, adder_size, carry_size)
+            results[k] = memo[key]
     for k in dummy_idx:
         ln = lanes[k]
         csd, shift0 = ln.csd, ln.shift0
@@ -921,7 +941,9 @@ def solve_single_lanes(
         def _ceil_to(x: int, q: int) -> int:
             return -(-x // q) * q
 
-        n_in_max = _ceil_to(max(lanes[k].csd.shape[0] for k in active), 8)
+        # pow2 so the first rung's cur0 equals the trimmed-row class R_in
+        # exactly (the op-record capacity P - R_in relies on cur0 >= R_in)
+        n_in_max = _next_pow2(max(lanes[k].csd.shape[0] for k in active))
         # O and the P ladder (below) round to powers of two: TPU compiles are
         # expensive (remote, minutes at large shapes), so the class lattice is
         # kept coarse — one compile per (pow2 P, pow2 O, even B) serves every
@@ -1030,7 +1052,7 @@ def solve_single_lanes(
                     pend = []
                     break
             n_pend = len(pend)
-            select = _select(P)
+            select = _select()
             # the cache is exact at small P; a deeper K narrows its
             # understatement window at large P (env overrides)
             topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
@@ -1351,13 +1373,11 @@ def solve_jax_many(
     qintervals_list = qintervals_list or [None] * n_mat
     latencies_list = latencies_list or [None] * n_mat
 
-    # Pre-route matrices whose undecomposed (dc=-1) lane would outgrow the
-    # device slot ceiling: each CSE merge eliminates >= 2 digit pairs, so the
-    # slot demand is bounded by n_in + digits/2. Such matrices go to the host
-    # solver whole (its sorted-map state is size-proportional), keeping the
-    # device path for the shapes it is actually good at.
+    # Matrices route to the host solver only through LANE-level slot-demand
+    # routing inside solve_single_lanes (a 256-dim matrix keeps its
+    # decomposed dc candidates on device; only infeasible lanes go host).
+    # ``routed`` remains for the include_host short-circuit.
     routed: dict[int, Pipeline] = {}
-    pmax = _pmax()
 
     def _solve_on_host(mi: int) -> Pipeline:
         """One equivalently-parameterized reference solve (shared by the
@@ -1378,13 +1398,6 @@ def solve_jax_many(
             backend='auto',
             method0_candidates=method0_candidates,
         )
-
-    for mi, kern in enumerate(kernels):
-        kern_c = np.ascontiguousarray(kern)
-        digits = int((_csd_cached(kern_c.tobytes(), kern_c.shape)[0] != 0).sum())
-        if kern.shape[0] + digits // 2 > pmax:
-            search_stats['pmax_host_fallbacks'] += 1
-            routed[mi] = _solve_on_host(mi)
 
     # In sweep mode the host driver resolves methods against the effective
     # budget 10^9 when hard_dc < 0 (api.py solve -> _solve), which turns

@@ -255,10 +255,12 @@ class KerasTracer(TracerPluginBase):
         if name == 'BatchNormalization':
             x = args[0]
             eps = float(layer.epsilon)
-            gamma = _weight(layer.gamma) if layer.scale else 1.0
-            beta = _weight(layer.beta) if layer.center else 0.0
-            mean = _weight(layer.moving_mean)
-            var = _weight(layer.moving_variance)
+            # QKeras-style QBatchNormalization quantizes each folded
+            # component; plain BN layers carry no quantizer attrs
+            gamma = _quantized_weight(layer, 'gamma', ('gamma_quantizer',)) if layer.scale else 1.0
+            beta = _quantized_weight(layer, 'beta', ('beta_quantizer',)) if layer.center else 0.0
+            mean = _quantized_weight(layer, 'moving_mean', ('mean_quantizer',))
+            var = _quantized_weight(layer, 'moving_variance', ('variance_quantizer',))
             a = np.atleast_1d(gamma / np.sqrt(var + eps))
             b = np.atleast_1d(beta - mean * a)
             ax = layer.axis if isinstance(layer.axis, int) else layer.axis[0]

@@ -44,6 +44,15 @@ def initialize(
     except Exception:
         pass  # private-module layout changed; fall through to initialize
 
+    # cross-process collectives on the CPU backend need an explicit
+    # transport — without one every cross-host program deadlocks silently.
+    # Must be set before the backend initializes; harmless for TPU.
+    try:
+        if not jax.config.read('jax_cpu_collectives_implementation'):
+            jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    except Exception:
+        pass  # knob absent in this jax version
+
     coordinator_address = coordinator_address or os.environ.get('JAX_COORDINATOR_ADDRESS')
     if num_processes is None and os.environ.get('JAX_NUM_PROCESSES'):
         num_processes = int(os.environ['JAX_NUM_PROCESSES'])

@@ -22,10 +22,17 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+# the axon TPU plugin force-registers at interpreter start and ignores the
+# JAX_PLATFORMS env override — without this config update the workers would
+# rendezvous against the real (possibly wedged) chip instead of the CPU mesh
+jax.config.update('jax_platforms', 'cpu')
 # share XLA compiles between the two workers (and across runs): on a small
 # CI host the CSE program compile dominates the test's wall clock
 jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache_cpu'))
 jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+# cross-process collectives on the CPU backend need an explicit transport;
+# without it every cross-host program silently deadlocks
+jax.config.update('jax_cpu_collectives_implementation', 'gloo')
 
 from da4ml_tpu.parallel.distributed import global_mesh, initialize  # noqa: E402
 
