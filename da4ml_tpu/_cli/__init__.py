@@ -31,6 +31,12 @@ def main(argv: list[str] | None = None) -> int:
     add_report_args(p_report)
     p_report.set_defaults(func=report_main)
 
+    from .warmup import add_warmup_args, warmup_main
+
+    p_warm = sub.add_parser('warmup', help='Pre-compile the device-search shape classes into the XLA cache')
+    add_warmup_args(p_warm)
+    p_warm.set_defaults(func=warmup_main)
+
     args = parser.parse_args(argv)
     return args.func(args) or 0
 
