@@ -360,9 +360,11 @@ class RawComb:
         from ..ir.comb import CombLogic
         from ..ir.types import Op, QInterval
 
+        # tolist() converts the whole array to python scalars in C — much
+        # faster than per-element numpy indexing for the big op arrays
         ops = [
-            Op(int(r[0]), int(r[1]), int(r[2]), int(r[3]), QInterval(r[4], r[5], r[6]), float(r[7]), float(r[8]))
-            for r in self.ops9
+            Op(int(a), int(b), int(c), int(d), QInterval(e, f, g), h, i)
+            for a, b, c, d, e, f, g, h, i in self.ops9.tolist()
         ]
         return CombLogic(
             shape=self.shape,

@@ -77,7 +77,24 @@ if not FAST:
 
 
 def main() -> int:
+    import json
     import os
+    from datetime import datetime, timezone
+    from pathlib import Path
+
+    record: dict = {
+        'captured_utc': datetime.now(timezone.utc).isoformat(timespec='seconds'),
+        'git_head': subprocess.run(['git', 'rev-parse', '--short', 'HEAD'], capture_output=True, text=True).stdout.strip(),
+        'rungs': [],
+    }
+
+    def _save(status: str) -> None:
+        # recorded hardware evidence: committed so a green tests_tpu run is
+        # auditable, not just narrated
+        record['status'] = status
+        out = Path(__file__).resolve().parents[1] / 'docs' / 'tpu_validation.json'
+        out.write_text(json.dumps(record, indent=1) + '\n')
+        print(f'record written to {out}')
 
     for name, tmo, src in RUNGS:
         env = dict(os.environ)
@@ -95,6 +112,8 @@ def main() -> int:
             r = subprocess.run(cmd, capture_output=True, text=True, timeout=tmo, env=env)
         except subprocess.TimeoutExpired:
             print(f'[{name}] TIMEOUT after {tmo}s — stopping ladder (chip may be wedged)')
+            record['rungs'].append({'rung': name, 'result': f'timeout after {tmo}s'})
+            _save('failed')
             return 1
         dt = time.time() - t0
         tail = (r.stdout or '').strip().splitlines()[-3:]
@@ -102,9 +121,13 @@ def main() -> int:
             err = (r.stderr or '').strip().splitlines()[-5:]
             print(f'[{name}] FAIL rc={r.returncode} in {dt:.0f}s')
             print('\n'.join('  ' + ln for ln in tail + err))
+            record['rungs'].append({'rung': name, 'result': f'fail rc={r.returncode}', 'tail': tail + err})
+            _save('failed')
             return 1
         print(f'[{name}] ok in {dt:.0f}s: ' + (tail[-1] if tail else ''))
+        record['rungs'].append({'rung': name, 'result': f'ok in {dt:.0f}s', 'last_line': tail[-1] if tail else ''})
     print('ladder complete')
+    _save('passed')
     return 0
 
 
