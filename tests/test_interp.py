@@ -130,3 +130,23 @@ def test_scan_executor_i64(rng):
     assert ex_scan.use_i64, 'test requires the int64 path'
     ref = DaisExecutor(prog, mode='unroll')(data)
     np.testing.assert_array_equal(ex_scan(data), ref)
+
+
+def test_packed_io_plan_and_roundtrip():
+    """The packed host<->device inference boundary is bit-exact and the width
+    analysis picks narrow lanes for narrow programs."""
+    import numpy as np
+
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    rng = np.random.default_rng(12)
+    inp = FixedVariableArrayInput(6, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 2), np.full(6, 1))
+    w = rng.integers(-4, 4, (6, 3)).astype(np.float64)
+    comb = comb_trace(inp, (x @ w).relu(i=np.full(3, 5), f=np.full(3, 1)))
+    ex = DaisExecutor(decode(comb.to_binary()))
+    assert ex._in_group in (2, 4) and ex._out_group in (2, 4)  # narrow lanes packed
+    data = rng.uniform(-4, 4, (64, 6))
+    np.testing.assert_array_equal(ex(data), comb.predict(data, backend='numpy'))

@@ -295,3 +295,42 @@ def test_decompose_batch_matches_python(rng):
         np.testing.assert_array_equal(m0, r0)
         np.testing.assert_array_equal(m1, r1)
         np.testing.assert_array_equal(m0 @ m1, k)
+
+
+@pytest.mark.parametrize('method0', ['wmc', 'mc'])
+def test_decision_identity_op_for_op(rng, method0):
+    """The device search is decision-identical with the host solver: not just
+    equal cost — the exact same op sequence, because greedy ties resolve in
+    the host's scan order (largest (id1, id0, sub, shift) among maxima, the
+    >=-scan over its sorted freq map)."""
+    from da4ml_tpu.cmvm.api import solve as host_solve
+
+    for trial in range(3):
+        kernel = random_kernel(rng, int(rng.integers(5, 13)), int(rng.integers(3, 11)))
+        ref = host_solve(kernel, method0=method0, backend='auto')
+        got = solve_jax_many([kernel], method0=method0)[0]
+        assert float(got.cost) == float(ref.cost), (trial, got.cost, ref.cost)
+        for sr, sg in zip(ref.stages, got.stages):
+            assert len(sr.ops) == len(sg.ops), (trial, len(sr.ops), len(sg.ops))
+            for a, b in zip(sr.ops, sg.ops):
+                assert a == b, (trial, a, b)
+
+
+def test_trit_codec_roundtrip(rng):
+    """Host and device trit codecs invert each other bit-for-bit."""
+    import jax.numpy as jnp
+
+    from da4ml_tpu.cmvm.jax_search import _trit_pack_np, _trit_unpack_np
+
+    digits = rng.integers(-1, 2, (5, 7, 48)).astype(np.int8)
+    words = _trit_pack_np(digits.reshape(5, 7, 48))
+    assert words.dtype == np.int32 and words.shape == (5, 7, 3)
+    np.testing.assert_array_equal(_trit_unpack_np(words, 48), digits)
+    # device-side unpack (the lane_trimmed path) agrees with the host codec
+    import jax
+
+    w = jnp.asarray(words.reshape(-1, 3))
+    v = jax.lax.bitcast_convert_type(w, jnp.uint32)
+    code = (v[..., None] >> (2 * jnp.arange(16, dtype=jnp.uint32))) & 3
+    dev = (np.asarray(code, np.int8) - 1).reshape(5, 7, 48)
+    np.testing.assert_array_equal(dev, digits)
