@@ -1,0 +1,109 @@
+"""One-shot TPU measurement campaign (run when the chip/tunnel is healthy).
+
+Runs, in order and each in a bounded subprocess:
+
+1. the validation ladder (writes docs/tpu_validation.json),
+2. the full bench (refreshes docs/bench_snapshot.json from its live JSON
+   when the run was on a real TPU),
+3. the on-demand sections: quality_1000, 3b_large_dim with
+   DA4ML_BENCH_LARGE=1, select_modes,
+4. an inference-packing A/B (packed __call__ vs raw fn_int + transfers).
+
+Usage: python tests_tpu/measure_campaign.py [--skip-ladder]
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+_AB_SRC = """
+import numpy as np, time, jax
+jax.config.update('jax_compilation_cache_dir', '/tmp/da4ml_jax_cache')
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+from da4ml_tpu.ir.dais_binary import decode
+from da4ml_tpu.runtime.jax_backend import DaisExecutor
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+rng = np.random.default_rng(11)
+n_in, hidden = 16, 64
+inp = FixedVariableArrayInput(n_in, hwconf=HWConfig(1, -1, -1))
+x = inp.quantize(np.ones(n_in), np.full(n_in, 3), np.full(n_in, 2))
+w1 = rng.integers(-8, 8, (n_in, hidden)).astype(np.float64)
+x = (x @ w1).relu(i=np.full(hidden, 6), f=np.full(hidden, 2))
+w2 = rng.integers(-8, 8, (hidden, 8)).astype(np.float64)
+comb = comb_trace(inp, x @ w2)
+ex = DaisExecutor(decode(comb.to_binary()))
+data = rng.uniform(-8, 8, (262144, n_in))
+ex(data)  # compile packed
+t0 = time.perf_counter(); out_p = ex(data); tp = time.perf_counter() - t0
+xi = ex._int_inputs(data)
+np.testing.assert_array_equal(out_p, comb.predict(data, backend='numpy'))
+jax.block_until_ready(ex.fn_int(xi))  # compile raw
+t0 = time.perf_counter()
+out_r = np.asarray(jax.device_get(ex.fn_int(xi)), np.float64) * ex._out_scale()
+tr = time.perf_counter() - t0
+t0 = time.perf_counter(); y = comb.predict(data, n_threads=16); th = time.perf_counter() - t0
+print(f'PACKED_AB packed={262144/tp:.0f}/s raw={262144/tr:.0f}/s host={262144/th:.0f}/s packed_vs_raw={tr/tp:.2f} packed_vs_host={th/tp:.2f}')
+"""
+
+
+def run(name: str, cmd: list[str], timeout: float, env_extra: dict | None = None) -> dict:
+    import os
+
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout, cwd=ROOT, env=env)
+        tail = (r.stdout or '').strip().splitlines()[-5:]
+        ok = r.returncode == 0
+        print(f'[{name}] {"ok" if ok else f"rc={r.returncode}"} in {time.time() - t0:.0f}s')
+        for ln in tail:
+            print('   ' + ln)
+        return {'name': name, 'ok': ok, 'tail': tail, 'wall_s': round(time.time() - t0, 1)}
+    except subprocess.TimeoutExpired:
+        print(f'[{name}] TIMEOUT after {timeout:.0f}s')
+        return {'name': name, 'ok': False, 'tail': [f'timeout {timeout:.0f}s'], 'wall_s': timeout}
+
+
+def main() -> int:
+    results = []
+    if '--skip-ladder' not in sys.argv:
+        results.append(run('ladder', [sys.executable, 'tests_tpu/validate_ladder.py', '--fast'], 1500))
+        if not results[-1]['ok']:
+            print('ladder failed — stopping (chip unhealthy)')
+            return 1
+
+    results.append(run('bench_full', [sys.executable, 'bench.py', '64'], 900, {'DA4ML_BENCH_BUDGET_S': '560'}))
+    # refresh the committed snapshot when the live run was on a real TPU
+    for ln in reversed(results[-1]['tail']):
+        if ln.startswith('{'):
+            try:
+                data = json.loads(ln)
+                if not data['detail'].get('limited_cpu_fallback', True):
+                    snap = {k: v for k, v in data.items()}
+                    (ROOT / 'docs' / 'bench_snapshot.json').write_text(json.dumps(snap, indent=1) + '\n')
+                    print('   bench_snapshot.json refreshed')
+            except Exception as e:
+                print(f'   snapshot refresh skipped: {e}')
+            break
+
+    results.append(run('quality_1000', [sys.executable, 'bench.py', '--section', 'quality_1000'], 1800))
+    results.append(
+        run('large_dim', [sys.executable, 'bench.py', '--section', '3b_large_dim'], 1800, {'DA4ML_BENCH_LARGE': '1'})
+    )
+    results.append(run('select_modes', [sys.executable, 'bench.py', '--section', 'select_modes', '16'], 1200))
+    results.append(run('packed_ab', [sys.executable, '-u', '-c', _AB_SRC], 900))
+
+    (ROOT / 'docs' / 'tpu_campaign.json').write_text(json.dumps(results, indent=1) + '\n')
+    print('campaign record written to docs/tpu_campaign.json')
+    return 0 if all(r['ok'] for r in results) else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())
