@@ -284,7 +284,7 @@ def _section_kernels(name: str, n1: int, limited: bool):
         # DA4ML_BENCH_LARGE=1) keeps its decomposed dc lanes on device while
         # the undecomposed lane exceeds single-chip memory and runs host-side
         # via lane-level routing
-        shapes = [(96, 4)] if limited else [(128, 6)]
+        shapes = [(24, 4)] if limited else [(128, 6)]
         if os.environ.get('DA4ML_BENCH_LARGE') == '1' and not limited:
             shapes.append((256, 4))
         return [_rand_kernel(rng, d, d, b) for d, b in shapes]
