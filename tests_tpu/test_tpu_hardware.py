@@ -148,3 +148,52 @@ def test_fused_pipeline_on_tpu(rng):
     data = rng.uniform(-8, 8, (512, 8))
     golden = pipe.predict(data, backend='numpy')
     np.testing.assert_array_equal(pipe.predict(data, backend='jax'), golden)
+
+
+def test_decision_identity_vs_host_on_tpu(rng):
+    """Host-order tie-breaking holds on real hardware: device op sequences
+    equal the host solver's (r3 feature; CPU XLA proves semantics, this
+    proves the TPU lowering — incl. HIGHEST-precision payload contractions
+    — does not perturb them)."""
+    from da4ml_tpu.cmvm.api import solve as host_solve
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    for _ in range(2):
+        kernel = (rng.integers(0, 16, (12, 10)) * rng.choice([-1, 1], (12, 10))).astype(np.float64)
+        ref = host_solve(kernel, backend='auto')
+        got = solve_jax_many([kernel])[0]
+        assert float(got.cost) == float(ref.cost)
+        for sr, sg in zip(ref.stages, got.stages):
+            assert len(sr.ops) == len(sg.ops)
+            for a, b in zip(sr.ops, sg.ops):
+                assert a == b
+
+
+def test_packed_inference_on_tpu(rng):
+    """The int8/int16-packed transfer boundary is bit-exact on hardware and
+    engages for narrow programs (r3 feature)."""
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput(8, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(8), np.full(8, 2), np.full(8, 2))
+    w = rng.integers(-4, 4, (8, 5)).astype(np.float64)
+    comb = comb_trace(inp, (x @ w).relu(i=np.full(5, 5), f=np.full(5, 2)))
+    ex = DaisExecutor(decode(comb.to_binary()))
+    assert ex._in_group or ex._out_group, 'narrow program should pack at least one direction'
+    data = rng.uniform(-4, 4, (4096, 8))
+    np.testing.assert_array_equal(ex(data), comb.predict(data, backend='numpy'))
+
+
+def test_large_class_top4_k16_on_tpu(rng):
+    """A P=512-class matrix (deeper K=16 cache) solves exactly and no worse
+    than the host on hardware (r3 policy)."""
+    from da4ml_tpu.cmvm.api import solve as host_solve
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    kernel = (rng.integers(0, 16, (64, 64)) * rng.choice([-1, 1], (64, 64))).astype(np.float64)
+    got = solve_jax_many([kernel], include_host=False)[0]
+    np.testing.assert_array_equal(np.asarray(got.kernel, np.float64), kernel)
+    ref = host_solve(kernel, backend='auto')
+    assert float(got.cost) <= float(ref.cost) * 1.01, (got.cost, ref.cost)
