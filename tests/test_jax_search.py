@@ -334,3 +334,39 @@ def test_trit_codec_roundtrip(rng):
     code = (v[..., None] >> (2 * jnp.arange(16, dtype=jnp.uint32))) & 3
     dev = (np.asarray(code, np.int8) - 1).reshape(5, 7, 48)
     np.testing.assert_array_equal(dev, digits)
+
+
+def test_lane_level_routing_partial_device(rng, monkeypatch):
+    """Slot-demand routing is per LANE: with a ceiling that only the
+    undecomposed (dc=-1) lane exceeds, exactly that lane runs host-side
+    while the decomposed candidates stay on device — and the solve is
+    still exact."""
+    from da4ml_tpu.cmvm import jax_search
+    from da4ml_tpu.cmvm.csd import csd_decompose
+    from da4ml_tpu.cmvm.decompose import kernel_decompose
+
+    # correlated columns: every column = a dense base +- a sparse delta, so
+    # the MST difference matrix has far fewer digits than the raw kernel
+    srng = np.random.default_rng(99)
+    base = (srng.integers(32, 128, 8) * srng.choice([-1, 1], 8)).astype(np.float64)
+    deltas = srng.integers(-1, 2, (8, 8)).astype(np.float64)
+    kernel = base[:, None] + deltas
+    n_in = kernel.shape[0]
+    full_demand = n_in + int((csd_decompose(kernel)[0] != 0).sum()) // 2
+    dec_demands = []
+    for dc in range(0, 4):
+        m0, _ = kernel_decompose(kernel, dc)
+        dec_demands.append(m0.shape[0] + int((csd_decompose(m0)[0] != 0).sum()) // 2)
+    lo, hi = min(dec_demands), full_demand
+    assert 2 * lo <= hi, 'deep decomposition must shrink the demand enough for a pow2 window'
+    ceiling = 1 << (hi - 1).bit_length() - 1  # pow2 in (lo, hi)
+    assert lo < ceiling < hi
+    monkeypatch.setenv('DA4ML_JAX_PMAX', str(ceiling))
+    before = jax_search.search_stats['pmax_host_fallbacks']
+    (sol,) = solve_jax_many([kernel])
+    routed = jax_search.search_stats['pmax_host_fallbacks'] - before
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+    assert routed >= 1, 'the undecomposed lane should have routed host-side'
+    # at least one decomposed candidate must have stayed on device
+    n_lanes_total = 2 * (2 + min(10**9, int(np.ceil(np.log2(n_in)))) + 1)
+    assert routed < n_lanes_total, 'not every lane may route to the host'
