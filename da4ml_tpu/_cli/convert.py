@@ -72,21 +72,36 @@ def convert(
     outdir.mkdir(parents=True, exist_ok=True)
 
     model = None
-    if model_path.suffix in {'.h5', '.keras'}:
-        try:
-            import keras  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError('Converting .keras/.h5 models requires keras to be installed.') from e
+    if model_path.suffix in {'.h5', '.keras', '.pt', '.pth'}:
+        if model_path.suffix in {'.h5', '.keras'}:
+            try:
+                import keras  # noqa: F401
+            except ImportError as e:
+                raise RuntimeError('Converting .keras/.h5 models requires keras to be installed.') from e
+            # register the QKeras-compatible custom objects so quantized models
+            # deserialize (reference: hgq import in src/da4ml/_cli/convert.py:32-35)
+            from ..converter import qkeras_compat  # noqa: F401
+
+            model = keras.models.load_model(model_path, compile=False)
+            if verbose > 1:
+                model.summary()
+        else:
+            try:
+                import torch
+            except ImportError as e:
+                raise RuntimeError('Converting .pt/.pth models requires torch to be installed.') from e
+            # a pickled nn.Module (torch.save(model, path)); a bare state_dict
+            # carries no architecture and is rejected with a clear message
+            model = torch.load(model_path, map_location='cpu', weights_only=False)
+            if not isinstance(model, torch.nn.Module):
+                raise ValueError(
+                    f'{model_path} does not contain an nn.Module (got {type(model).__name__}); '
+                    'save the full module with torch.save(model, path), not just its state_dict'
+                )
+            model.eval()
         from ..converter import trace_model
         from ..trace import HWConfig, comb_trace
 
-        # register the QKeras-compatible custom objects so quantized models
-        # deserialize (reference: hgq import in src/da4ml/_cli/convert.py:32-35)
-        from ..converter import qkeras_compat  # noqa: F401
-
-        model = keras.models.load_model(model_path, compile=False)
-        if verbose > 1:
-            model.summary()
         inp, out = trace_model(
             model,
             HWConfig(*hwconf),
@@ -157,20 +172,35 @@ def convert(
         return rng.integers(lo_i + 1, np.maximum(hi_i, lo_i + 2), (n_test_sample, len(eps))).astype(np.float64) * eps
 
     if model is not None:
+        if hasattr(model, 'predict') and hasattr(model, 'inputs'):  # keras
+            in_shapes = [tuple(int(v) for v in i.shape[1:]) for i in model.inputs]
+
+            def _forward(parts):
+                y = model.predict(parts if len(parts) > 1 else parts[0], batch_size=16384, verbose=0)
+                ys = y if isinstance(y, list) else [y]
+                return np.concatenate([np.asarray(v).reshape(n_test_sample, -1) for v in ys], axis=1)
+        else:  # torch module: input_shape is in torch-native layout
+            import torch
+
+            shape = getattr(model, 'input_shape', None)
+            if shape is None:
+                raise ValueError('torch models need an `input_shape` attribute (torch-native layout) for validation')
+            in_shapes = [tuple(int(d) for d in shape)]
+
+            def _forward(parts):
+                with torch.no_grad():
+                    y = model(torch.as_tensor(parts[0], dtype=torch.float32))
+                ys = y if isinstance(y, (list, tuple)) else [y]
+                return np.concatenate([np.asarray(v, np.float64).reshape(n_test_sample, -1) for v in ys], axis=1)
+
         grid = _input_grid_data()
         if grid is not None:
-            sizes = [int(np.prod(i.shape[1:])) for i in model.inputs]
+            sizes = [int(np.prod(s)) for s in in_shapes]
             split = np.split(grid, np.cumsum(sizes)[:-1], axis=1)
-            data_in = [
-                part.reshape(n_test_sample, *i.shape[1:]).astype(np.float32) for part, i in zip(split, model.inputs)
-            ]
+            data_in = [part.reshape(n_test_sample, *s).astype(np.float32) for part, s in zip(split, in_shapes)]
         else:
-            data_in = [rng.uniform(-32, 32, (n_test_sample, *i.shape[1:])).astype(np.float32) for i in model.inputs]
-        y_model = model.predict(data_in if len(data_in) > 1 else data_in[0], batch_size=16384, verbose=0)
-        if isinstance(y_model, list):
-            y_model = np.concatenate([y.reshape(n_test_sample, -1) for y in y_model], axis=1)
-        else:
-            y_model = np.asarray(y_model).reshape(n_test_sample, -1)
+            data_in = [rng.uniform(-32, 32, (n_test_sample, *s)).astype(np.float32) for s in in_shapes]
+        y_model = _forward(data_in)
         flat_in = np.concatenate([d.reshape(n_test_sample, -1) for d in data_in], axis=1)
         y_comb = solution.predict(flat_in, n_threads=n_threads)
 

@@ -1,6 +1,8 @@
 """CLI tests: convert on saved IR, report on canned vendor report fixtures."""
 
 import json
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -264,3 +266,56 @@ def test_convert_keras_quality_flags(tmp_path):
     )  # fmt: skip
     assert rc == 0
     assert (outdir / 'metadata.json').exists()
+
+
+def test_cli_convert_torch_model(tmp_path):
+    """A pickled torch nn.Module converts end to end with zero mismatches.
+
+    The model class lives in a real module (written to tmp_path and put on
+    the subprocess's PYTHONPATH) because torch full-module pickles resolve
+    the class by import path in the loading process — exactly a user's
+    situation."""
+    torch = pytest.importorskip('torch')
+    import importlib.util
+    import json as _json
+    import os
+
+    (tmp_path / 'torch_mlp_def.py').write_text(
+        'import torch\n'
+        'class SmallMLP(torch.nn.Module):\n'
+        '    input_shape = (6,)\n'
+        '    def __init__(self):\n'
+        '        super().__init__()\n'
+        '        self.fc1 = torch.nn.Linear(6, 8)\n'
+        '        self.act = torch.nn.ReLU()\n'
+        '        self.fc2 = torch.nn.Linear(8, 3)\n'
+        '    def forward(self, x):\n'
+        '        return self.fc2(self.act(self.fc1(x)))\n'
+    )
+    spec = importlib.util.spec_from_file_location('torch_mlp_def', tmp_path / 'torch_mlp_def.py')
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules['torch_mlp_def'] = mod
+    spec.loader.exec_module(mod)
+
+    rng = np.random.default_rng(4)
+    model = mod.SmallMLP()
+    with torch.no_grad():
+        for p in model.parameters():
+            p.copy_(torch.tensor(rng.integers(-4, 4, p.shape).astype(np.float32)))
+    path = tmp_path / 'mlp.pt'
+    torch.save(model, path)
+
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f'{tmp_path}{os.pathsep}' + env.get('PYTHONPATH', '')
+    out = tmp_path / 'prj'
+    r = subprocess.run(
+        [sys.executable, '-m', 'da4ml_tpu', 'convert', str(path), str(out), '--flavor', 'verilog',
+         '--inputs-kif', '1', '4', '0', '-n', '128'],  # fmt: skip
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = _json.loads((out / 'mismatches.json').read_text())
+    assert report['n_mismatch'] == 0, report
