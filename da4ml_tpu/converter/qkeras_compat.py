@@ -184,6 +184,33 @@ class QConv2D(_QuantizedWeightsMixin, keras.layers.Conv2D):
         return self._quantizer_config(super().get_config())
 
 
+@keras.saving.register_keras_serializable(package='qkeras')
+class QDepthwiseConv2D(keras.layers.DepthwiseConv2D):
+    def __init__(self, kernel_size, depthwise_quantizer=None, bias_quantizer=None, **kwargs):
+        super().__init__(kernel_size, **kwargs)
+        self.depthwise_quantizer = _as_quantizer(depthwise_quantizer)
+        self.bias_quantizer = _as_quantizer(bias_quantizer)
+
+    def call(self, inputs):
+        k = self.kernel
+        if self.depthwise_quantizer is not None:
+            k = self.depthwise_quantizer(k)
+        y = ops.depthwise_conv(
+            inputs, k, strides=self.strides, padding=self.padding, data_format='channels_last',
+            dilation_rate=self.dilation_rate,
+        )  # fmt: skip
+        if self.use_bias:
+            b = self.bias_quantizer(self.bias) if self.bias_quantizer is not None else self.bias
+            y = y + ops.reshape(b, (1,) * (y.ndim - 1) + (-1,))
+        return self.activation(y) if self.activation is not None else y
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg['depthwise_quantizer'] = _maybe_serialize(self.depthwise_quantizer)
+        cfg['bias_quantizer'] = _maybe_serialize(self.bias_quantizer)
+        return cfg
+
+
 def _conv_call(layer, inputs):
     y = ops.conv(
         inputs,

@@ -24,6 +24,7 @@ from da4ml_tpu.converter.qkeras_compat import (  # noqa: E402
     QActivation,
     QConv2D,
     QDense,
+    QDepthwiseConv2D,
     quantized_bits,
     quantized_relu,
 )
@@ -54,6 +55,8 @@ def _quantized_cnn():
             QActivation(quantized_bits(5, 2)),
             QConv2D(3, (3, 3), kernel_quantizer=quantized_bits(5, 1), bias_quantizer=quantized_bits(5, 1),
                     activation=quantized_relu(5, 2)),  # fmt: skip
+            QDepthwiseConv2D((2, 2), depthwise_quantizer=quantized_bits(5, 1), bias_quantizer=quantized_bits(5, 1),
+                             activation=quantized_relu(5, 2)),  # fmt: skip
             keras.layers.Flatten(),
             QDense(5, kernel_quantizer=quantized_bits(5, 1), bias_quantizer=quantized_bits(5, 1)),
         ]
