@@ -1,0 +1,27 @@
+"""The examples gallery stays runnable (each script in a bounded subprocess)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_EXAMPLES = sorted((Path(__file__).resolve().parents[1] / 'examples').glob('0*.py'))
+
+
+@pytest.mark.parametrize('script', _EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(script, tmp_path):
+    env = {k: v for k, v in os.environ.items() if k != 'PALLAS_AXON_POOL_IPS'}
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
+    r = subprocess.run(
+        [sys.executable, str(script), str(tmp_path / 'out')],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+    )
+    assert r.returncode == 0, f'{script.name} failed:\n{r.stdout[-1500:]}\n{r.stderr[-1500:]}'
