@@ -318,6 +318,80 @@ class KerasTracer(TracerPluginBase):
                 axis -= 1  # batch dim dropped in tracing
             return np.concatenate(vals, axis=axis)
 
+        # ------------------------------------------------- keras.ops nodes
+        # functional graphs built with keras.ops (the HGQ2 style) walk the
+        # same graph executor; these are Operation nodes, not layers. The
+        # traced arrays carry no batch axis, so every axis/subscript that
+        # references it is stripped here.
+        if name == 'Relu':
+            return relu(args[0])
+        if name == 'Relu6':
+            return relu6(args[0])
+        if name == 'LeakyRelu':
+            return leaky_relu(args[0], float(layer.negative_slope))
+        if name == 'GetItem':
+            key = args[1] if len(args) > 1 else kwargs.get('key')
+            if not isinstance(key, tuple):
+                key = (key,)
+            if not key or key[0] != slice(None):
+                raise NotImplementedError('cannot index the batch axis in a traced graph')
+            rest = key[1:]
+            return args[0][rest] if rest else args[0]
+        if name == 'Einsum':
+            eq = layer.subscripts
+            if '...' in eq:
+                raise NotImplementedError('ellipsis einsum is not supported through keras.ops tracing')
+            lhs, rhs = eq.replace(' ', '').split('->')
+            terms = lhs.split(',')
+            operands = list(args)
+            sym = [isinstance(o, FixedVariableArray) for o in operands]
+            lead = {t[0] for t, s in zip(terms, sym) if s and t}
+            if len(lead) == 1 and rhs and rhs[0] in lead:
+                b = lead.pop()
+                if all(b not in t for t, s in zip(terms, sym) if not s):
+                    terms = [t[1:] if s else t for t, s in zip(terms, sym)]
+                    eq = ','.join(terms) + '->' + rhs[1:]
+            from ..trace.ops import einsum as _einsum
+
+            return _einsum(eq, *operands)
+        if name in ('Mean', 'Sum', 'Max', 'Min'):
+            ax = getattr(layer, 'axis', None)
+            if ax is not None:
+                axes = (ax,) if isinstance(ax, int) else tuple(ax)
+                if 0 in axes:
+                    raise NotImplementedError('cannot reduce over the batch axis in a traced graph')
+                ax = tuple(a - 1 if a > 0 else a for a in axes)
+                ax = ax[0] if len(ax) == 1 else ax
+            fn = {'Mean': np.mean, 'Sum': np.sum, 'Max': np.amax, 'Min': np.amin}[name]
+            return fn(args[0], axis=ax, keepdims=bool(getattr(layer, 'keepdims', False)))
+        if name == 'Transpose':
+            axes = layer.axes
+            if axes is None or tuple(axes)[0] != 0:
+                raise NotImplementedError('transpose must keep the batch axis first in a traced graph')
+            return args[0].transpose([a - 1 for a in tuple(axes)[1:]])
+        if name in ('ExpandDims', 'Squeeze'):
+            ax = layer.axis
+            if ax == 0:
+                raise NotImplementedError('cannot reshape the batch axis in a traced graph')
+            if ax is not None and not isinstance(ax, int):
+                raise NotImplementedError('only a single axis is supported')
+            ax = (ax - 1 if ax > 0 else ax) if ax is not None else None
+            if name == 'ExpandDims':
+                return np.expand_dims(args[0], ax)
+            return np.squeeze(args[0], ax) if ax is not None else np.squeeze(args[0])
+        if name == 'Stack':
+            vals = args[0] if isinstance(args[0], (list, tuple)) else args
+            ax = layer.axis
+            if ax == 0:
+                raise NotImplementedError('cannot stack along the batch axis in a traced graph')
+            return np.stack(list(vals), axis=ax - 1 if ax > 0 else ax)
+        if name == 'Clip':
+            return np.clip(args[0], float(layer.x_min), float(layer.x_max))
+        if name == 'Absolute':
+            return abs(args[0])
+        if name == 'Negative':
+            return -args[0]
+
         raise NotImplementedError(f'Layer type {name!r} is not supported by the Keras tracer')
 
     # ------------------------------------------------------------ model walk

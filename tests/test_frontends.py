@@ -494,3 +494,37 @@ def test_torch_1d_depthwise_pooling(rng):
     with torch.no_grad():
         ref = np.stack([model(torch.tensor(d.astype(np.float32))).numpy() for d in data]).astype(np.float64)
     np.testing.assert_array_equal(out, ref.reshape(6, -1))
+
+
+def test_keras_ops_functional_graph(rng):
+    """Functional graphs built with keras.ops (the HGQ2 style) trace through
+    the same walker: relu / slicing / einsum / reductions / concat / abs,
+    with every batch-axis reference stripped."""
+    inp = keras.Input((6,))
+    a = keras.layers.Dense(4)(inp)
+    b = keras.ops.relu(a)
+    c = keras.ops.concatenate([a, b], axis=-1)
+    d = c[:, :5]
+    e = keras.ops.einsum('bi,ij->bj', d, np.ones((5, 3)))
+    f = keras.ops.max(e, axis=1, keepdims=True)
+    g = keras.ops.concatenate([e, keras.ops.absolute(f)], axis=-1)
+    model = keras.Model(inp, g)
+    _int_weights_keras(model, rng)
+
+    data = (rng.integers(-16, 16, (32, 6)) * 0.5).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 4, 1))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64).reshape(32, -1)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_keras_ops_einsum_two_symbolic(rng):
+    """ops.einsum with BOTH operands symbolic (batch letter in every term)."""
+    inp = keras.Input((4, 3))
+    a = keras.layers.Dense(3)(inp)
+    e = keras.ops.einsum('bik,bjk->bij', a, a)
+    model = keras.Model(inp, e)
+    _int_weights_keras(model, rng)
+    data = rng.integers(-3, 3, (8, 4, 3)).astype(np.float64)
+    out = _trace_predict(model, data, inputs_kif=(1, 3, 0))
+    ref = np.asarray(model(data.astype(np.float32))).astype(np.float64).reshape(8, -1)
+    np.testing.assert_array_equal(out, ref)
