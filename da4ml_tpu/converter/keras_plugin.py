@@ -387,6 +387,10 @@ class KerasTracer(TracerPluginBase):
             return np.stack(list(vals), axis=ax - 1 if ax > 0 else ax)
         if name == 'Clip':
             return np.clip(args[0], float(layer.x_min), float(layer.x_max))
+        if name == 'Matmul':
+            return args[0] @ args[1]
+        if name in ('Divide', 'TrueDivide'):
+            return args[0] / args[1]
         if name == 'Absolute':
             return abs(args[0])
         if name == 'Negative':
