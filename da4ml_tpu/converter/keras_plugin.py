@@ -390,6 +390,8 @@ class KerasTracer(TracerPluginBase):
         if name == 'Matmul':
             return args[0] @ args[1]
         if name in ('Divide', 'TrueDivide'):
+            if isinstance(args[1], FixedVariableArray):
+                raise NotImplementedError('division by a traced tensor is not supported (divide by constants only)')
             return args[0] / args[1]
         if name == 'Absolute':
             return abs(args[0])
