@@ -1,0 +1,557 @@
+"""Fused Pallas loop body for the greedy CSE search.
+
+The XLA ``top4`` path (jax_search.py lane_fn_top4) lowers one greedy
+iteration to ~30-40 fused XLA kernels whose launch + memory passes dominate
+the wall clock — the per-iteration tensors are tiny (the whole lane state is
+a few hundred KB), so the search is overhead-bound, not FLOP-bound. This
+module replaces the *entire* ``lax.while_loop`` with one ``pallas_call``:
+each grid step pins a block of ``L`` lanes' state in VMEM (digits, score
+cache, metadata, op records) and runs the full greedy loop to completion —
+zero HBM round trips and zero kernel launches per iteration.
+
+Decision identity with the XLA top4 path is a hard requirement (the test
+suite pins single-lane device solves to the host solver's exact op
+sequence). Everything here computes the same integer-valued counts and the
+same f32 score formulas via the shared module-level helpers in
+``jax_search`` (``_score_cand``, ``_overlap_vec``, ``_cost_add_vec``), and
+re-expresses the host-order argmax / top-k / rank-merge tie-breaking rules
+with the same total orders.
+
+Kernel-layout choices (Mosaic-friendly):
+
+- Slots live on the minor (lane) axis everywhere: digits ``E[L, OBp, P]``
+  f32, score cache ``tv/tc[L, K*2B, P]`` (k-major rows so the rank-0 slice
+  and per-k blocks are contiguous), metadata ``qm[L, 8, P]`` (rows lo, hi,
+  step, latency), records ``rec[L, 8, NIp]``.
+- Per-lane scalars (cur, method, go, cur0) are columns of an ``[L, 128]``
+  int32 plane; reads are masked reductions, writes masked selects.
+- No gathers/scatters: dynamic row access is one-hot contraction on the
+  MXU; bit-plane shifts are static pad/slice (enumerated s) or a masked
+  [OBp, OBp] shift-matrix batched matmul (per-lane dynamic s).
+
+Reference parity: the algorithm is the reference greedy CSE
+(src/da4ml/_binary/cmvm/{state_opr,indexers,cmvm_core}.cc of calad0i/da4ml);
+the single-kernel TPU mapping is original.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .jax_search import _SP_FIN as _FIN  # shared -inf stand-in (merge identity)
+from .jax_search import _cost_add_vec, _overlap_vec, _score_cand
+
+_F32 = jnp.float32
+_I32 = jnp.int32
+_BIG = np.iinfo(np.int32).max
+
+#: VMEM working-set budget per grid step; Mosaic gets ~16 MB/core and needs
+#: headroom for double-buffered input/output blocks
+_VMEM_BUDGET = 10 << 20
+
+
+def _ceil_to(x: int, q: int) -> int:
+    return -(-x // q) * q
+
+
+def _per_lane_vmem(P: int, O: int, B: int, K: int) -> int:
+    """Rough per-lane VMEM bytes for the fused loop (state + transients)."""
+    OBp = _ceil_to(O * B, 8)
+    TB = 2 * B
+    n_cand = K + 3
+    state = 4 * (OBp * P) + 2 * 4 * (K * TB * P) + 4 * 8 * P
+    trans = (
+        4 * (OBp * P)  # absE
+        + 2 * 4 * (K * TB * P)  # merged cache under construction
+        + (n_cand + 3) * 4 * (TB * P)  # pos ranks + candidate slices
+        + 2 * 4 * (3 * TB * P)  # dirty-row scores + topk scratch
+        + 2 * 4 * (OBp * OBp)  # dynamic shift matrices
+        + 8 * 4 * (3 * B * OBp)  # shifted dirty stacks
+    )
+    return state + trans
+
+
+def fused_feasible(P: int, O: int, B: int, K: int) -> bool:
+    """Whether the fused kernel's single-lane state fits the VMEM budget."""
+    return _per_lane_vmem(P, O, B, K) <= _VMEM_BUDGET
+
+
+def _pick_L(P: int, O: int, B: int, K: int) -> int:
+    per = _per_lane_vmem(P, O, B, K)
+    L = 1
+    while L < 8 and (2 * L) * per <= _VMEM_BUDGET:
+        L *= 2
+    return L
+
+
+@lru_cache(maxsize=64)
+def _build_pallas_loop(
+    L: int, P: int, O: int, B: int, K: int, NIp: int, adder_size: int, carry_size: int, interpret: bool
+):
+    """The single-kernel greedy loop for one (L, P, O, B, K) shape class."""
+    OB = O * B
+    OBp = _ceil_to(OB, 8)
+    S = B
+    TB = 2 * B
+    R2B1 = 2 * B + 1
+    N_CAND = K + 3
+
+
+    def _mm(a, b):
+        """Batched matmul [L, M, OBp] x [L, OBp, N] -> [L, M, N] (f32 exact)."""
+        return lax.dot_general(
+            a, b, (((2,), (1,)), ((0,), (0,))), preferred_element_type=_F32, precision=lax.Precision.HIGHEST
+        )
+
+    def _rowdot(mat, vec):
+        """[L, M, P] x [L, P] -> [L, M] one-hot gather contraction."""
+        return lax.dot_general(
+            mat, vec, (((2,), (1,)), ((0,), (0,))), preferred_element_type=_F32, precision=lax.Precision.HIGHEST
+        )
+
+    def _bdot(m, x):
+        """[L, OBp, OBp] x [L, OBp] -> [L, OBp] dynamic-shift contraction."""
+        return lax.dot_general(
+            m, x, (((2,), (1,)), ((0,), (0,))), preferred_element_type=_F32, precision=lax.Precision.HIGHEST
+        )
+
+    def _col(scal, idx: int):
+        """Column ``idx`` of the [L, 128] scalar plane as [L, 1] int32."""
+        mask = lax.broadcasted_iota(_I32, (L, 128), 1) == idx
+        return jnp.sum(jnp.where(mask, scal, 0), axis=1, keepdims=True)
+
+    def _put_col(scal, idx: int, val):
+        mask = lax.broadcasted_iota(_I32, (L, 128), 1) == idx
+        return jnp.where(mask, val, scal)
+
+    def _sshift_up(x, s: int, cmod, obok):
+        """y[.., c] = x[.., c + s] within the same o-block (static s)."""
+        if s == 0:
+            return x * obok
+        y = jnp.pad(x[..., s:], ((0, 0),) * (x.ndim - 1) + ((0, s),))
+        return y * ((cmod + s < B).astype(_F32) * obok)
+
+    def _sshift_dn(x, s: int, cmod, obok):
+        """y[.., c] = x[.., c - s] within the same o-block (static s)."""
+        if s == 0:
+            return x * obok
+        y = jnp.pad(x[..., : OBp - s], ((0, 0),) * (x.ndim - 1) + ((s, 0),))
+        return y * ((cmod >= s).astype(_F32) * obok)
+
+    def kernel(scal_i, E_i, qm_i, rec_i, tv_i, tc_i, scal_o, E_o, qm_o, rec_o, tv_s, tc_s):
+        # bit-plane geometry from iota (pallas kernels cannot capture array
+        # constants); flattened ob = o * B + b
+        ob_iota = lax.broadcasted_iota(_I32, (1, OBp), 1)
+        cmod = ob_iota % B  # [1, OBp] bit index within block
+        obok = (ob_iota < OB).astype(_F32)
+        c_i = lax.broadcasted_iota(_I32, (1, OBp, OBp), 1)
+        b_i = lax.broadcasted_iota(_I32, (1, OBp, OBp), 2)
+        sameblk = (c_i // B == b_i // B) & (c_i < OB) & (b_i < OB)  # [1, OBp, OBp]
+        dup_m = b_i - c_i  # y[c] = x[c+s]  <=>  b - c == s
+        ddn_m = c_i - b_i  # y[c] = x[c-s]  <=>  c - b == s
+
+        iota_P = lax.broadcasted_iota(_I32, (L, P), 1)  # [L, P]
+        iota_P3 = lax.broadcasted_iota(_I32, (1, TB, P), 2)
+        tb_iota = lax.broadcasted_iota(_I32, (1, TB, 1), 1)
+        s_iota3 = lax.broadcasted_iota(_I32, (1, S, 1), 1)  # [1, S, 1]
+        iota_NI = lax.broadcasted_iota(_I32, (L, NIp), 1)
+
+        # seed the mutable state from the input blocks (plain outputs +
+        # scratch; no reliance on input/output aliasing semantics)
+        scal_o[:] = scal_i[:]
+        E_o[:] = E_i[:]
+        qm_o[:] = qm_i[:]
+        rec_o[:] = rec_i[:]
+        tv_s[:] = tv_i[:]
+        tc_s[:] = tc_i[:]
+
+        def body(carry):
+            it, _ = carry
+            scal = scal_o[:]
+            cur = _col(scal, 0)  # [L, 1]
+            meth = _col(scal, 1)
+            go = _col(scal, 2) > 0
+            cur0 = _col(scal, 3)
+            meth3 = meth[:, :, None]  # [L, 1, 1]
+
+            # ---- selection: host-order argmax over the cached row maxima
+            tv0 = tv_s[:, 0:TB, :]  # [L, TB, P] rank-0 cache entries
+            tc0 = tc_s[:, 0:TB, :]
+            sub_ax = tb_iota // S
+            s_ax = tb_iota % S
+            i_ax = iota_P3
+            j_ax = tc0
+            id0_a = jnp.minimum(i_ax, j_ax)
+            id1_a = jnp.maximum(i_ax, j_ax)
+            shift_a = jnp.where(i_ax < j_ax, s_ax, -s_ax)
+            major = id1_a * P + id0_a
+            minor = sub_ax * R2B1 + shift_a + B
+            m = jnp.max(tv0, axis=(1, 2), keepdims=True)  # [L, 1, 1]
+            anyv = m[:, :, 0] != -jnp.inf  # [L, 1]
+            tie = tv0 == m
+            r1 = jnp.max(jnp.where(tie, major, -1), axis=(1, 2), keepdims=True)
+            tie = tie & (major == r1)
+            r2 = jnp.max(jnp.where(tie, minor, -1), axis=(1, 2), keepdims=True)
+            r1s, r2s = r1[:, :, 0], r2[:, :, 0]  # [L, 1]
+            id1 = r1s // P
+            id0 = r1s - id1 * P
+            subv = r2s // R2B1
+            shift = r2s - subv * R2B1 - B
+            i_v = jnp.where(shift >= 0, id0, id1)
+            j_v = jnp.where(shift >= 0, id1, id0)
+            s_v = jnp.abs(shift)
+            # a budget-exhausted lane (cur == P) must FREEZE — neither mutate
+            # state nor latch its go flag — exactly like the vmapped
+            # while_loop cond ``go & (cur < P)`` freezes it for resume at the
+            # next rung (where the cache is rebuilt fresh)
+            active = cur < P  # [L, 1]
+            upd = go & anyv & active
+
+            # ---- substitution (flat [L, OBp] row algebra)
+            ohi = iota_P == i_v  # [L, P]
+            ohj = iota_P == j_v
+            ohc = iota_P == cur
+            E = E_o[:]
+            row_i = _rowdot(E, ohi.astype(_F32))  # [L, OBp]
+            row_j = _rowdot(E, ohj.astype(_F32))
+            s3 = s_v[:, :, None]  # [L, 1, 1]
+            Mup = ((dup_m == s3) & sameblk).astype(_F32)  # [L, OBp, OBp]
+            Mdn = ((ddn_m == s3) & sameblk).astype(_F32)
+            shifted_j = _bdot(Mup, row_j)
+            target = jnp.where(subv == 1, -1.0, 1.0).astype(_F32)  # [L, 1]
+            sign_ok = (row_i != 0) & (shifted_j != 0) & (row_i * shifted_j == target)
+
+            # i == j: digits chain (b, b+s, b+2s); greedy ascending-bit match
+            availf = (row_i != 0).astype(_F32)
+            matched = jnp.zeros((L, OBp), dtype=jnp.bool_)
+            in_range = (cmod + s_v) < B  # [L, OBp]
+            for b in range(B):
+                posb = cmod == b
+                avail_sh = _bdot(Mup, availf) > 0.5
+                okb = sign_ok & (availf > 0.5) & avail_sh & posb & in_range
+                okf = okb.astype(_F32)
+                availf = availf * (1.0 - okf)
+                ok_up = _bdot(Mdn, okf)
+                availf = availf * (1.0 - ok_up)
+                matched = matched | okb
+
+            ieqj = i_v == j_v  # [L, 1]
+            Mm = jnp.where(ieqj, matched, sign_ok)
+            M_up = _bdot(Mdn, Mm.astype(_F32)) > 0.5
+            row_i_clr = jnp.where(Mm, 0.0, row_i)
+            row_j_base = jnp.where(ieqj, row_i_clr, row_j)
+            row_j_clr = jnp.where(M_up, 0.0, row_j_base)
+            anchor_lo = jnp.where(Mm, row_i, 0.0)
+            anchor_hi = jnp.where(M_up, row_j, 0.0)
+            new_row = jnp.where(i_v < j_v, anchor_lo, anchor_hi)
+
+            wi = (ohi & upd)[:, None, :]  # [L, 1, P]
+            wj = (ohj & upd)[:, None, :]
+            wc = (ohc & upd)[:, None, :]
+            E1 = jnp.where(wi, row_i_clr[:, :, None], E)
+            E2 = jnp.where(wj, row_j_clr[:, :, None], E1)
+            E3 = jnp.where(wc, new_row[:, :, None], E2)
+            E_o[:] = E3
+
+            # ---- record the decision: new slot metadata + op record
+            qm = qm_o[:]  # [L, 8, P] rows lo, hi, step, latency
+            q0 = _rowdot(qm, (iota_P == id0).astype(_F32))  # [L, 8]
+            q1 = _rowdot(qm, (iota_P == id1).astype(_F32))
+
+            def _f(q, k):
+                mask = lax.broadcasted_iota(_I32, (L, 8), 1) == k
+                return jnp.sum(jnp.where(mask, q, 0.0), axis=1, keepdims=True)
+
+            lo0, hi0, st0, la0 = _f(q0, 0), _f(q0, 1), _f(q0, 2), _f(q0, 3)
+            lo1, hi1, st1, la1 = _f(q1, 0), _f(q1, 1), _f(q1, 2), _f(q1, 3)
+            sp = jnp.exp2(shift.astype(_F32))
+            is_sub = subv == 1
+            dlat_c, _ = _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, sp, is_sub, adder_size, carry_size)
+            nlat = jnp.maximum(la0, la1) + dlat_c
+            min1 = jnp.where(is_sub, -hi1, lo1) * sp
+            max1 = jnp.where(is_sub, -lo1, hi1) * sp
+            payload_q = jnp.concatenate(
+                [lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp), nlat, jnp.zeros((L, 4), _F32)], axis=1
+            )  # [L, 8]
+            qm_n = jnp.where(wc, payload_q[:, :, None], qm)
+            qm_o[:] = qm_n
+
+            rec = rec_o[:]
+            ohr = ((iota_NI == (cur - cur0)) & upd)[:, None, :]  # [L, 1, NIp]
+            payload_r = jnp.concatenate([id0, id1, subv, shift, jnp.zeros((L, 4), _I32)], axis=1)
+            rec_o[:] = jnp.where(ohr, payload_r[:, :, None], rec)
+
+            # ---- exact dirty-row recount (rows i, j, cur) on the MXU
+            absE = jnp.abs(E3)
+            er0 = jnp.where(ieqj, row_j_clr, row_i_clr)  # E3 column i
+            er1 = row_j_clr
+            er2 = new_row
+            ers = (er0, er1, er2)
+            aers = tuple(jnp.abs(e) for e in ers)
+            dn_rows = [_sshift_dn(e, s, cmod, obok) for e in ers for s in range(S)]
+            dn_abs = [_sshift_dn(e, s, cmod, obok) for e in aers for s in range(S)]
+            up_rows = [_sshift_up(e, s, cmod, obok) for e in ers for s in range(S)]
+            up_abs = [_sshift_up(e, s, cmod, obok) for e in aers for s in range(S)]
+            dn_st = jnp.stack(dn_rows, axis=1)  # [L, 3S, OBp] (r-major rows)
+            dn_ast = jnp.stack(dn_abs, axis=1)
+            up_st = jnp.stack(up_rows, axis=1)
+            up_ast = jnp.stack(up_abs, axis=1)
+            rowA = _mm(dn_st, E3)  # [L, 3S, P] pairs (R_r first operand, p second)
+            rowD = _mm(dn_ast, absE)
+            colA = _mm(up_st, E3)  # [L, 3S, P] pairs (p first operand, R_r second)
+            colD = _mm(up_ast, absE)
+            row_same = (rowD + rowA) * 0.5
+            row_diff = (rowD - rowA) * 0.5
+            col_same = (colD + colA) * 0.5
+            col_diff = (colD - colA) * 0.5
+
+            # dirty-row metadata against all slots (post-update qm)
+            ohR = jnp.stack([ohi.astype(_F32), ohj.astype(_F32), ohc.astype(_F32)], axis=2)  # [L, P, 3]
+            qR = lax.dot_general(
+                qm_n, ohR, (((2,), (1,)), ((0,), (0,))), preferred_element_type=_F32,
+                precision=lax.Precision.HIGHEST,
+            )  # [L, 8, 3]
+            lo_all = qm_n[:, 0, :]  # [L, P]
+            hi_all = qm_n[:, 1, :]
+            st_all = qm_n[:, 2, :]
+            la_all = qm_n[:, 3, :]
+            Rv = (i_v, j_v, cur)
+
+            rowS_blocks = []  # r-major: [r0_same, r0_diff, r1_same, ...]
+            colS_cands = []  # per-r merge candidates [L, TB, P]
+            for r in range(3):
+                loR = qR[:, 0, r][:, None]  # [L, 1]
+                hiR = qR[:, 1, r][:, None]
+                stR = qR[:, 2, r][:, None]
+                laR = qR[:, 3, r][:, None]
+                nov_r = _overlap_vec(loR, hiR, stR, lo_all, hi_all, st_all)[:, None, :]  # [L, 1, P]
+                dlt_r = jnp.abs(laR - la_all)[:, None, :]
+                okR = (s_iota3 > 0) | (Rv[r][:, :, None] < iota_P3[:, 0:S, :])  # [L, S, P]
+                okC = (s_iota3 > 0) | (iota_P3[:, 0:S, :] < Rv[r][:, :, None])
+                sl = slice(r * S, (r + 1) * S)
+                rowS_blocks.append(_score_cand(row_same[:, sl, :], nov_r, dlt_r, meth3, okR))
+                rowS_blocks.append(_score_cand(row_diff[:, sl, :], nov_r, dlt_r, meth3, okR))
+                cS = _score_cand(col_same[:, sl, :], nov_r, dlt_r, meth3, okC)
+                cD = _score_cand(col_diff[:, sl, :], nov_r, dlt_r, meth3, okC)
+                colS_cands.append(jnp.concatenate([cS, cD], axis=1))  # [L, TB, P]
+
+            # duplicate fresh column (i == j chains) would break the cache's
+            # distinct-col invariant; mask the r=1 candidate out
+            dup1 = ieqj[:, :, None]  # [L, 1, 1]
+            colS_cands[1] = jnp.where(dup1, -jnp.inf, colS_cands[1])
+            cols3 = [
+                jnp.broadcast_to(i_v[:, :, None], (L, TB, P)),
+                jnp.broadcast_to(jnp.where(ieqj, -1, j_v)[:, :, None], (L, TB, P)),
+                jnp.broadcast_to(cur[:, :, None], (L, TB, P)),
+            ]
+
+            # ---- cache maintenance: stale-drop + rank merge + row rebuild
+            tv_c = tv_s[:]  # [L, K*TB, P]
+            tc_c = tc_s[:]
+            i3 = i_v[:, :, None]
+            j3 = j_v[:, :, None]
+            c3 = cur[:, :, None]
+            dropm = (tc_c == i3) | (tc_c == j3) | (tc_c == c3)
+            tv_d = jnp.where(dropm, -jnp.inf, tv_c)
+
+            cand_v = [jnp.maximum(tv_d[:, k * TB : (k + 1) * TB, :], _FIN) for k in range(K)]
+            cand_c = [tc_c[:, k * TB : (k + 1) * TB, :] for k in range(K)]
+            cand_v += [jnp.maximum(v, _FIN) for v in colS_cands]
+            cand_c += cols3
+
+            pos = [jnp.zeros((L, TB, P), _I32) for _ in range(N_CAND)]
+            for a in range(N_CAND):
+                for bb in range(a + 1, N_CAND):
+                    bt = (cand_v[a] > cand_v[bb]) | ((cand_v[a] == cand_v[bb]) & (cand_c[a] >= cand_c[bb]))
+                    bti = bt.astype(_I32)
+                    pos[bb] = pos[bb] + bti
+                    pos[a] = pos[a] + (1 - bti)
+
+            mrg_v, mrg_c = [], []
+            for k in range(K):
+                acc_v = jnp.full((L, TB, P), _FIN, _F32)
+                acc_c = jnp.full((L, TB, P), -1, _I32)
+                for mth in range(N_CAND):
+                    hit = pos[mth] == k
+                    acc_v = jnp.where(hit, cand_v[mth], acc_v)
+                    acc_c = jnp.where(hit, cand_c[mth], acc_c)
+                dead = acc_v <= _FIN
+                mrg_v.append(jnp.where(dead, -jnp.inf, acc_v))
+                mrg_c.append(jnp.where(dead, -1, acc_c))
+            tv_m = jnp.concatenate(mrg_v, axis=1)  # [L, K*TB, P]
+            tc_m = jnp.concatenate(mrg_c, axis=1)
+
+            # rebuild rows R exactly from the dirty-row scores (k-pass top-k)
+            rowS = jnp.concatenate(rowS_blocks, axis=1)  # [L, 3*TB, P]
+            v = rowS
+            tvR_cols, tcR_cols = [], []
+            iota_P6 = lax.broadcasted_iota(_I32, (L, 3 * TB, P), 2)
+            for _ in range(K):
+                mR = jnp.max(v, axis=-1, keepdims=True)
+                fin = mR != -jnp.inf
+                candc = jnp.where((v == mR) & fin, iota_P6, -_BIG)
+                cR = jnp.max(candc, axis=-1, keepdims=True)
+                tvR_cols.append(mR)
+                tcR_cols.append(jnp.where(fin, cR, -1))
+                v = jnp.where((iota_P6 == cR) & (v == mR), -jnp.inf, v)
+            tvR = jnp.concatenate(tvR_cols, axis=-1)  # [L, 3*TB, K]
+            tcR = jnp.concatenate(tcR_cols, axis=-1)
+            for r in range(3):
+                blk_v = tvR[:, r * TB : (r + 1) * TB, :]  # [L, TB, K]
+                blk_c = tcR[:, r * TB : (r + 1) * TB, :]
+                kv = jnp.transpose(blk_v, (0, 2, 1)).reshape(L, K * TB)  # k-major
+                kc = jnp.transpose(blk_c, (0, 2, 1)).reshape(L, K * TB)
+                mP = ((iota_P == Rv[r]) & upd)[:, None, :]  # [L, 1, P]
+                tv_m = jnp.where(mP, kv[:, :, None], tv_m)
+                tc_m = jnp.where(mP, kc[:, :, None], tc_m)
+
+            upd3 = upd[:, :, None]  # [L, 1, 1]
+            tv_s[:] = jnp.where(upd3, tv_m, tv_c)
+            tc_s[:] = jnp.where(upd3, tc_m, tc_c)
+
+            # ---- per-lane scalar state (frozen lanes keep go untouched)
+            cur_n = cur + upd.astype(_I32)
+            go_n = jnp.where(active, go & anyv, go).astype(_I32)
+            scal_n = _put_col(_put_col(scal, 0, cur_n), 2, go_n)
+            scal_o[:] = scal_n
+            alive = jnp.any((go_n > 0) & (cur_n < P))
+            return it + 1, alive
+
+        def cond(carry):
+            it, alive = carry
+            return alive & (it < P + 1)
+
+        alive0 = jnp.any(_col(scal_o[:], 2) > 0)
+        lax.while_loop(cond, body, (jnp.int32(0), alive0))
+
+    def call(scal, Ef, qm, rec, tv, tc):
+        Npad = scal.shape[0]
+        nb = Npad // L
+
+        def bs(shape):
+            return pl.BlockSpec((L,) + shape, lambda b: (b,) + (0,) * len(shape), memory_space=pltpu.VMEM)
+
+        out_shapes = (
+            jax.ShapeDtypeStruct((Npad, 128), _I32),
+            jax.ShapeDtypeStruct((Npad, OBp, P), _F32),
+            jax.ShapeDtypeStruct((Npad, 8, P), _F32),
+            jax.ShapeDtypeStruct((Npad, 8, NIp), _I32),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[bs((128,)), bs((OBp, P)), bs((8, P)), bs((8, NIp)), bs((K * TB, P)), bs((K * TB, P))],
+            out_specs=(bs((128,)), bs((OBp, P)), bs((8, P)), bs((8, NIp))),
+            out_shape=out_shapes,
+            scratch_shapes=[pltpu.VMEM((L, K * TB, P), _F32), pltpu.VMEM((L, K * TB, P), _I32)],
+            compiler_params=pltpu.CompilerParams(dimension_semantics=('arbitrary',)),
+            interpret=interpret,
+        )(scal, Ef, qm, rec, tv, tc)
+
+    return call
+
+
+def build_fused_runner(spec, init_cache_single):
+    """Driver-facing runner with the ``_build_cse_fn`` batched signature.
+
+    ``init_cache_single`` is the per-lane stage-entry cache builder closed
+    over the same shape class (shared with the XLA top4 path). All layout
+    conversion (trit unpack, transposes, digit packing) runs in XLA once per
+    rung; the greedy loop itself is the single Pallas kernel.
+    """
+    P, O, B, K = spec.P, spec.O, spec.B, spec.topk
+    OB = O * B
+    OBp = _ceil_to(OB, 8)
+    TB = 2 * B
+    R_in = spec.R_in
+    n_iters = P - R_in if R_in else P
+    NIp = _ceil_to(n_iters, 128)
+    L = _pick_L(P, O, B, K)
+    interpret = jax.default_backend() != 'tpu'
+    loop = _build_pallas_loop(L, P, O, B, K, NIp, spec.adder_size, spec.carry_size, interpret)
+
+    def _unpack_input(E0p):
+        if R_in and R_in < P:
+            if OB % 16 == 0:
+                w = lax.bitcast_convert_type(E0p, jnp.uint32)
+                code = (w[..., None] >> (2 * jnp.arange(16, dtype=jnp.uint32))) & 3
+                E0 = (code.astype(jnp.int8) - 1).reshape(-1, R_in, O, B)
+            elif OB % 4 == 0:
+                E0 = lax.bitcast_convert_type(E0p, jnp.int8).reshape(-1, R_in, O, B)
+            else:
+                E0 = E0p
+            return jnp.pad(E0, ((0, 0), (0, P - R_in), (0, 0), (0, 0)))
+        return E0p
+
+    def _pack_digits(E):
+        """Batched twin of jax_search._pack_digits (int8 [N,P,O,B] in)."""
+        N = E.shape[0]
+        if OB % 16 == 0:
+            code = (E.astype(jnp.int32) + 1).reshape(N, P, OB // 16, 16)
+            return (code << (2 * jnp.arange(16, dtype=jnp.int32))).sum(-1).astype(jnp.int32)
+        if OB % 4 == 0:
+            return lax.bitcast_convert_type(E.reshape(N, P, OB // 4, 4), jnp.int32)
+        return E
+
+    @jax.jit
+    def run(E0p, qmeta0, lat0, cur0, method):
+        N = E0p.shape[0]
+        E0 = _unpack_input(E0p)  # [N, P, O, B] int8
+        if R_in and R_in < P:
+            pad_q = jnp.tile(jnp.asarray([0.0, 0.0, 1.0], _F32), (P - R_in, 1))
+            qmeta = jnp.concatenate([qmeta0, jnp.broadcast_to(pad_q, (N, P - R_in, 3))], axis=1)
+            lat = jnp.pad(lat0, ((0, 0), (0, P - R_in)))
+        else:
+            qmeta, lat = qmeta0, lat0
+        tv, tc = jax.vmap(init_cache_single)(E0, qmeta, lat, method)  # [N, 2, B, P, K]
+
+        Npad = _ceil_to(max(N, L), L)
+        pad = Npad - N
+
+        # kernel layouts: slots on the minor axis, k-major cache rows
+        Ek = jnp.pad(
+            E0.astype(_F32).transpose(0, 2, 3, 1).reshape(N, OB, P), ((0, pad), (0, OBp - OB), (0, 0))
+        )
+        tvk = jnp.pad(
+            tv.reshape(N, TB, P, K).transpose(0, 3, 1, 2).reshape(N, K * TB, P),
+            ((0, pad), (0, 0), (0, 0)),
+            constant_values=-jnp.inf,
+        )
+        tck = jnp.pad(
+            tc.reshape(N, TB, P, K).transpose(0, 3, 1, 2).reshape(N, K * TB, P),
+            ((0, pad), (0, 0), (0, 0)),
+            constant_values=-1,
+        )
+        qmk = jnp.pad(
+            jnp.concatenate([qmeta.transpose(0, 2, 1), lat[:, None, :]], axis=1), ((0, pad), (0, 4), (0, 0))
+        )  # [Npad, 8, P] (rows: lo, hi, step, latency, 4 spare)
+        iota128 = jnp.arange(128, dtype=_I32)[None, :]
+        curp = jnp.pad(cur0.astype(_I32), (0, pad), constant_values=P)
+        methp = jnp.pad(method.astype(_I32), (0, pad))
+        scal = (
+            jnp.where(iota128 == 0, curp[:, None], 0)
+            + jnp.where(iota128 == 1, methp[:, None], 0)
+            + jnp.where(iota128 == 2, (curp < P).astype(_I32)[:, None], 0)
+            + jnp.where(iota128 == 3, curp[:, None], 0)
+        )
+        rec0 = jnp.zeros((Npad, 8, NIp), _I32)
+
+        scal_f, E_f, qm_f, rec_f = loop(scal, Ek, qmk, rec0, tvk, tck)
+
+        E_out = (
+            jnp.round(E_f[:N, :OB, :]).astype(jnp.int8).reshape(N, O, B, P).transpose(0, 3, 1, 2)
+        )  # [N, P, O, B]
+        q_out = qm_f[:N, 0:3, :].transpose(0, 2, 1)
+        l_out = qm_f[:N, 3, :]
+        rec_out = rec_f[:N, 0:4, :n_iters].transpose(0, 2, 1)
+        cur_out = scal_f[:N, 0]
+        return _pack_digits(E_out), q_out, l_out, rec_out, cur_out
+
+    return run

@@ -105,7 +105,7 @@ def _pmax() -> int:
     ceiling for its last rung) agrees with the pre-route estimate. Values
     <= 0 mean "no ceiling" (the repo-wide -1 convention).
     """
-    default = 32768 if _select() == 'top4' else 4096
+    default = 32768 if _select() in ('top4', 'fused') else 4096
     try:
         raw = int(os.environ.get('DA4ML_JAX_PMAX', '') or default)
     except ValueError:
@@ -212,6 +212,58 @@ def _count_itemsize(O: int, B: int) -> int:
     HBM budget estimate in ``solve_single_lanes``.
     """
     return 2 if O * B < 32000 else 4
+
+
+def _score_cand(cnt, nov, dlat, method, pair_ok):
+    """Candidate scoring, validity folded to -inf (shared by the XLA top4
+    path and the fused Pallas loop so the two can never diverge)."""
+    base_mc = cnt
+    base_wmc = cnt * nov
+    score = jnp.where(
+        method == 0,
+        base_mc,
+        jnp.where(
+            method == 1,
+            base_mc - 1e9 * dlat,
+            jnp.where(
+                method == 2,
+                base_mc - 1e9 * dlat,
+                jnp.where(method == 3, base_wmc, base_wmc - 256.0 * dlat),
+            ),
+        ),
+    )
+    valid = (cnt >= 2.0) & pair_ok
+    absolute = (method == 1) | (method == 3) | (method == 4)
+    valid &= jnp.where(absolute, score >= 0, True)
+    return jnp.where(valid, score, -jnp.inf)
+
+
+def _topk_scan(vals, k: int):
+    """Exact (score desc, col desc) top-k along a full [.., P] score axis.
+
+    Within one cache row (fixed sub, s, i) the host scan key (id1, id0,
+    sub, shift) is strictly increasing in the column j, so col-desc tie
+    order realizes the host's ``>=``-scan preference. lax.top_k breaks
+    ties by the FIRST position, so the axis is reversed going in and the
+    indices mirrored back — one fused op instead of k max/mask passes.
+    """
+    if os.environ.get('DA4ML_JAX_TOPK_IMPL') == 'sort':
+        v, pos = jax.lax.top_k(vals[..., ::-1], k)
+        cols = vals.shape[-1] - 1 - pos
+        return v, jnp.where(v == -jnp.inf, -1, cols.astype(jnp.int32))
+    cols = jax.lax.broadcasted_iota(jnp.int32, vals.shape, vals.ndim - 1)
+    big = jnp.iinfo(jnp.int32).max
+    out_v, out_c = [], []
+    v = vals
+    for _ in range(k):
+        m = jnp.max(v, axis=-1, keepdims=True)
+        fin = m != -jnp.inf
+        cand = jnp.where((v == m) & fin, cols, -big)
+        c = jnp.max(cand, axis=-1, keepdims=True)
+        out_v.append(m[..., 0])
+        out_c.append(jnp.where(fin[..., 0], c[..., 0], -1))
+        v = jnp.where((cols == c) & (v == m), -jnp.inf, v)
+    return jnp.stack(out_v, -1), jnp.stack(out_c, -1)
 
 
 @dataclass(frozen=True)
@@ -536,27 +588,9 @@ def _build_cse_fn(spec: _KernelSpec):
     # *order* may deviate from the full-rescan reference (select='xla' keeps
     # decision identity; tests pin top4 cost to within a few % of it).
 
-    def _score(cnt, nov, dlat, method, pair_ok):
-        """Scoring identical to select_pair, validity folded to -inf."""
-        base_mc = cnt
-        base_wmc = cnt * nov
-        score = jnp.where(
-            method == 0,
-            base_mc,
-            jnp.where(
-                method == 1,
-                base_mc - 1e9 * dlat,
-                jnp.where(
-                    method == 2,
-                    base_mc - 1e9 * dlat,
-                    jnp.where(method == 3, base_wmc, base_wmc - 256.0 * dlat),
-                ),
-            ),
-        )
-        valid = (cnt >= 2.0) & pair_ok
-        absolute = (method == 1) | (method == 3) | (method == 4)
-        valid &= jnp.where(absolute, score >= 0, True)
-        return jnp.where(valid, score, -jnp.inf)
+    # scoring shared with the fused Pallas kernel (module level) so the two
+    # backends can never diverge
+    _score = _score_cand
 
     def _meta_rows(qmeta, lat, R):
         """(n_overlap, |dlat|) of rows R against all slots: [|R|, P] each.
@@ -570,31 +604,8 @@ def _build_cse_fn(spec: _KernelSpec):
         return nov, dlt
 
     def _extract_topk(vals, k=K_CACHE):
-        """Exact (score desc, col desc) top-k along a full [.., P] score axis.
-
-        Within one cache row (fixed sub, s, i) the host scan key (id1, id0,
-        sub, shift) is strictly increasing in the column j, so col-desc tie
-        order realizes the host's ``>=``-scan preference. lax.top_k breaks
-        ties by the FIRST position, so the axis is reversed going in and the
-        indices mirrored back — one fused op instead of k max/mask passes.
-        """
-        if os.environ.get('DA4ML_JAX_TOPK_IMPL') == 'sort':
-            v, pos = jax.lax.top_k(vals[..., ::-1], k)
-            cols = vals.shape[-1] - 1 - pos
-            return v, jnp.where(v == -jnp.inf, -1, cols.astype(jnp.int32))
-        cols = jax.lax.broadcasted_iota(jnp.int32, vals.shape, vals.ndim - 1)
-        big = jnp.iinfo(jnp.int32).max
-        out_v, out_c = [], []
-        v = vals
-        for _ in range(k):
-            m = jnp.max(v, axis=-1, keepdims=True)
-            fin = m != -jnp.inf
-            cand = jnp.where((v == m) & fin, cols, -big)
-            c = jnp.max(cand, axis=-1, keepdims=True)
-            out_v.append(m[..., 0])
-            out_c.append(jnp.where(fin[..., 0], c[..., 0], -1))
-            v = jnp.where((cols == c) & (v == m), -jnp.inf, v)
-        return jnp.stack(out_v, -1), jnp.stack(out_c, -1)
+        """Module-level ``_topk_scan`` with this shape class's cache depth."""
+        return _topk_scan(vals, k)
 
     _FIN = _SP_FIN  # shared finite stand-in for -inf during merge arithmetic
 
@@ -759,6 +770,13 @@ def _build_cse_fn(spec: _KernelSpec):
         state = (E0, Cs0, Cd0, nov0, dlt0, qmeta0, lat0, cur0, op_rec, jnp.bool_(True))
         E, _, _, _, _, qmeta, lat, cur, op_rec, _ = jax.lax.while_loop(cond, body, state)
         return _pack_digits(E), qmeta, lat, op_rec, cur
+
+    if spec.select == 'fused':
+        # the whole greedy loop runs as ONE Pallas kernel per lane block
+        # (launch-overhead-free); the stage-entry cache build stays in XLA
+        from .fused_cse import build_fused_runner
+
+        return build_fused_runner(spec, init_cache)
 
     inner = lane_fn_top4 if spec.select == 'top4' else lane_fn
 
@@ -1056,6 +1074,19 @@ def solve_single_lanes(
             # the cache is exact at small P; a deeper K narrows its
             # understatement window at large P (env overrides)
             topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
+            if select == 'fused':
+                from .fused_cse import fused_feasible
+
+                # the fused kernel keeps a lane block resident in VMEM; pad
+                # tiny classes up to the 128-lane tile (decisions are
+                # P-independent — padding slots are never selectable) and
+                # fall back to the XLA top4 loop — at the NATURAL rung P —
+                # when a class outgrows VMEM
+                P_f = max(P, 128) if pmax >= 128 else P
+                if fused_feasible(P_f, O, B, topk):
+                    P = P_f
+                else:
+                    select = 'top4'
             # rows actually carrying state this rung: n_in_max on entry, the
             # previous rung's P on resume (st_cur hits the cap exactly).
             # Rounded up to a power of two so the compile-class lattice stays
@@ -1069,13 +1100,17 @@ def solve_single_lanes(
             # HBM guard: bound the lanes per device call so a wide batch of
             # large matrices cannot OOM-crash the worker; excess lanes run in
             # sequential chunks of the same compiled program.
-            if select == 'top4':
+            if select in ('top4', 'fused'):
                 # no carried [S, P, P] state: the footprint is the shifted
                 # digit stack + abs copy at stage entry (bf16 [P, O, S, B]
                 # each), the blocked init scoring transient, the top-k cache
                 # (f32+int32 [2, S, P, K] each), and the merge transient
                 blk = min(128, P)
                 per_lane = 4 * P * O * B * B + 16 * B * blk * P + 16 * B * P * topk + 96 * B * P + P * O * B + 32 * P
+                if select == 'fused':
+                    # HBM side of the fused path: f32 digit plane + layout
+                    # transposes (the loop state itself lives in VMEM)
+                    per_lane += 16 * P * O * B
             else:
                 itemsize = _count_itemsize(O, B)
                 # carried counts (+f32 scoring transients) dominate; the

@@ -1,0 +1,68 @@
+"""Fused Pallas CSE loop: decision identity with the XLA top4 path.
+
+The fused kernel (cmvm/fused_cse.py) runs the whole greedy loop as one
+pallas_call per lane block; on CPU it executes in interpreter mode, which is
+semantics-identical with the TPU compile. The contract pinned here is strict
+decision identity — op-for-op equality with the default top4 backend — plus
+the usual exactness oracle (``Pipeline.kernel == kernel``).
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm.jax_search import _build_cse_fn, solve_jax_many
+
+
+def random_kernel(rng, n_dim, bits, m=None):
+    mag = rng.integers(0, 2**bits, (n_dim, m or n_dim)).astype(np.float64)
+    sign = rng.choice([-1.0, 1.0], (n_dim, m or n_dim))
+    return mag * sign
+
+
+def ops_sig(p):
+    return [[(o.id0, o.id1, o.opcode, o.data) for o in st.ops] for st in p.stages]
+
+
+def _solve_with(monkeypatch, select, kernels, **kw):
+    monkeypatch.setenv('DA4ML_JAX_SELECT', select)
+    _build_cse_fn.cache_clear()
+    out = solve_jax_many(kernels, **kw)
+    _build_cse_fn.cache_clear()
+    return out
+
+
+@pytest.mark.slow
+def test_fused_identity_batch(rng, monkeypatch):
+    """Mixed-size batch (exercises trimmed upload + lane padding)."""
+    kernels = [random_kernel(rng, n, b) for n, b in [(6, 3), (8, 4), (12, 4)]]
+    top4 = _solve_with(monkeypatch, 'top4', kernels)
+    fused = _solve_with(monkeypatch, 'fused', kernels)
+    for k, a, b in zip(kernels, top4, fused):
+        np.testing.assert_array_equal(np.asarray(b.kernel, np.float64), k)
+        assert ops_sig(a) == ops_sig(b)
+        assert float(a.cost) == float(b.cost)
+
+
+@pytest.mark.slow
+def test_fused_identity_multirung(rng, monkeypatch):
+    """A dense kernel that exhausts the first slot rung and resumes, batched
+    with a sparser lane that stays active — pins the freeze semantics: an
+    exhausted lane must neither mutate state nor latch its go flag while its
+    block mates keep iterating (the vmapped while_loop cond equivalent)."""
+    kernels = [random_kernel(rng, 20, 6), random_kernel(rng, 20, 2)]
+    top4 = _solve_with(monkeypatch, 'top4', kernels)
+    fused = _solve_with(monkeypatch, 'fused', kernels)
+    for k, a, b in zip(kernels, top4, fused):
+        np.testing.assert_array_equal(np.asarray(b.kernel, np.float64), k)
+        assert ops_sig(a) == ops_sig(b)
+
+
+@pytest.mark.slow
+def test_fused_identity_methods_and_budget(rng, monkeypatch):
+    """Heuristic sweep lanes + a latency-budget dc ladder stay identical."""
+    kernels = [random_kernel(rng, 8, 4)]
+    kw = dict(method0_candidates=['wmc', 'mc', 'wmc-dc'], hard_dc=1)
+    top4 = _solve_with(monkeypatch, 'top4', kernels, **kw)
+    fused = _solve_with(monkeypatch, 'fused', kernels, **kw)
+    np.testing.assert_array_equal(np.asarray(fused[0].kernel, np.float64), kernels[0])
+    assert ops_sig(top4[0]) == ops_sig(fused[0])
