@@ -1096,6 +1096,8 @@ def solve_single_lanes(
             fn = _build_cse_fn(
                 _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk)
             )
+            if select == 'fused' and mesh is not None and sh is not None:
+                fn = _fused_sharded(fn, mesh)
 
             # HBM guard: bound the lanes per device call so a wide batch of
             # large matrices cannot OOM-crash the worker; excess lanes run in
@@ -1259,6 +1261,33 @@ def solve_single_lanes(
                 results[k] = to_solution(state, adder_size, carry_size)
 
     return [results[k] for k in range(len(lanes))]
+
+
+_FUSED_SHARDED_CACHE: dict[tuple, object] = {}
+
+
+def _fused_sharded(fn, mesh):
+    """shard_map-wrap the fused runner for a mesh, cached per (fn, mesh).
+
+    A pallas_call does not auto-partition under the SPMD partitioner, so each
+    device runs the fused kernel over its own lane shard (no collectives).
+    Caching preserves the one-compiled-program-per-shape-class design: the
+    jitted wrapper's compile cache would otherwise restart empty every rung.
+    check_vma=False because pallas out_shapes carry no varying-mesh-axes
+    annotation; every output is lane-sharded anyway.
+    """
+    key = (id(fn), mesh)
+    hit = _FUSED_SHARDED_CACHE.get(key)
+    if hit is None or hit[0] is not fn:
+        from jax.sharding import PartitionSpec as _PS
+
+        _pl = _PS(mesh.axis_names[0])
+        wrapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(_pl,) * 5, out_specs=(_pl,) * 5, check_vma=False))
+        # the strong ref to fn keeps its id from being recycled after an
+        # lru eviction in _build_cse_fn, so a stale hit can never alias
+        hit = (fn, wrapped)
+        _FUSED_SHARDED_CACHE[key] = hit
+    return hit[1]
 
 
 @lru_cache(maxsize=1)

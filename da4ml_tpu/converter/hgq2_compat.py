@@ -1,0 +1,130 @@
+"""HGQ2 (Keras-3) model ingestion: duck-typed quantizer/weight readers.
+
+HGQ2 (github.com/calad0i/HGQ2) is the reference ecosystem's primary
+quantized front-end; its layers carry trainable heterogeneous fixed-point
+quantizers on inputs (``iq``), weights (``kq``/``bq``) and outputs (``oq``),
+and expose the already-quantized weights as ``qkernel`` / ``qbias``
+(reference src/da4ml/converter/__init__.py:10-78 dispatches such models to
+an out-of-tree plugin; here the in-tree Keras tracer handles them).
+
+Nothing in this module imports ``hgq`` — all access is duck-typed over the
+attribute surface HGQ2 layers/quantizers expose, so the tracer ingests real
+HGQ2 checkpoints when the package is installed and the mock-surface test
+exercises the same code paths without it:
+
+- ``layer.iq`` / ``layer.oq``: quantizer objects whose internals carry
+  per-element (k, i, f) — KIF parameterization — or (k, b, i) with
+  ``f = b - i`` — KBI — as tensors, plus overflow/round mode strings.
+- ``layer.qkernel`` / ``layer.qbias``: the quantized weight values (exact;
+  no spec needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+#: attribute spellings for the internal fixed-point parameter tensors
+_INNER_ATTRS = ('quantizer', 'q', '_quantizer')
+_OVERFLOW_ATTRS = ('overflow_mode', 'overflow')
+_ROUND_ATTRS = ('round_mode', 'rounding')
+
+_OVERFLOW_MAP = {'WRAP': 'WRAP', 'SAT': 'SAT', 'SAT_SYM': 'SAT_SYM'}
+#: S_RND (stochastic) trains stochastically but quantizes deterministically
+#: at inference time (== RND). RND_CONV is ties-to-even: it maps to RND,
+#: which rounds ties up — bit-exact EXCEPT on exact half-LSB ties (the same
+#: carve-out the QKeras front-end documents). Unknown modes raise.
+_ROUND_MAP = {'TRN': 'TRN', 'RND': 'RND', 'S_RND': 'RND', 'RND_CONV': 'RND'}
+
+
+def is_hgq_layer(layer) -> bool:
+    """An HGQ2-style layer: by module, or by its quantizer attribute surface."""
+    mod = type(layer).__module__ or ''
+    if mod.split('.', 1)[0] == 'hgq':
+        return True
+    return hasattr(layer, 'oq') and (hasattr(layer, 'iq') or hasattr(layer, 'qkernel'))
+
+
+def _tensor(v) -> np.ndarray | None:
+    if v is None:
+        return None
+    try:
+        arr = np.asarray(v, dtype=np.float64)
+    except Exception:
+        return None
+    return arr if arr.size else None
+
+
+def _squeeze_batch(arr: np.ndarray) -> np.ndarray:
+    """HGQ2 parameter tensors keep a leading broadcast (batch) axis of 1."""
+    while arr.ndim > 0 and arr.shape[0] == 1 and arr.ndim > 1:
+        arr = arr[0]
+    return arr
+
+
+def _mode(obj, attrs: tuple[str, ...], mapping: dict[str, str], default: str) -> str:
+    """Read a mode string; an attribute that is present but unmapped raises
+    (silent fallback would break the bit-exact ingestion contract)."""
+    for a in attrs:
+        v = getattr(obj, a, None)
+        if v is None:
+            continue
+        name = v if isinstance(v, str) else type(v).__name__
+        key = name.upper().replace('-', '_')
+        if key in mapping:
+            return mapping[key]
+        raise NotImplementedError(f'HGQ2 quantizer mode {name!r} (attribute {a!r}) is not supported')
+    return default
+
+
+def quantizer_kif(q) -> dict[str, Any] | None:
+    """Per-element (k, i, f) + overflow/round of an HGQ2-style quantizer.
+
+    Returns ``{'k': arr, 'i': arr, 'f': arr, 'overflow_mode': str,
+    'round_mode': str}`` (arrays already rounded to ints, leading broadcast
+    axis squeezed) or None when no fixed-point surface is found.
+    """
+    if q is None:
+        return None
+    seen = [q] + [getattr(q, a) for a in _INNER_ATTRS if getattr(q, a, None) is not None]
+    for c in seen:
+        k = _tensor(getattr(c, 'k', None))
+        if k is None:
+            k = _tensor(getattr(c, 'keep_negative', None))
+        i = _tensor(getattr(c, 'i', None))
+        f = _tensor(getattr(c, 'f', None))
+        b = _tensor(getattr(c, 'b', None))
+        if k is None or i is None or (f is None and b is None):
+            continue
+        if f is None:
+            f = b - i  # KBI: total (non-sign) bits b = i + f
+        k, i, f = (np.round(_squeeze_batch(t)).astype(np.int64) for t in (k, i, f))
+        over = _mode(c, _OVERFLOW_ATTRS, _OVERFLOW_MAP, 'WRAP')
+        rnd = _mode(c, _ROUND_ATTRS, _ROUND_MAP, 'RND')
+        for other in seen:  # mode strings may live on the wrapper
+            over = _mode(other, _OVERFLOW_ATTRS, _OVERFLOW_MAP, over)
+            rnd = _mode(other, _ROUND_ATTRS, _ROUND_MAP, rnd)
+        return {'k': k, 'i': i, 'f': f, 'overflow_mode': over, 'round_mode': rnd}
+    return None
+
+
+def apply_hgq_quantizer(x, q, where: str):
+    """Quantize a traced array with an HGQ2 quantizer's (k, i, f) surface."""
+    if q is None or getattr(q, 'enabled', True) is False:
+        return x
+    spec = quantizer_kif(q)
+    if spec is None:
+        raise NotImplementedError(
+            f'HGQ2 {where} quantizer {type(q).__name__!r} exposes no readable (k, i, f) surface'
+        )
+    from ..trace.fixed_variable import FixedVariableInput
+    from ..trace.fixed_variable_array import FixedVariableArray
+    from ..trace.ops.quantization import quantize
+
+    k, i, f = spec['k'], spec['i'], spec['f']
+    over, rnd = spec['overflow_mode'], spec['round_mode']
+    flat = x._vars.ravel() if isinstance(x, FixedVariableArray) else np.array([])
+    if flat.size and isinstance(flat[0], FixedVariableInput):
+        over = 'WRAP'  # sentinel inputs only record precision; data is in range
+    return quantize(x, k, i, f, over, rnd)

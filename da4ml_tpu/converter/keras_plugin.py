@@ -50,6 +50,29 @@ _QUANTIZED_BASE = {
     'QDepthwiseConv2D': 'DepthwiseConv2D',
     'QSeparableConv2D': 'SeparableConv2D',
     'QBatchNormalization': 'BatchNormalization',
+    # HGQ2 names (batchnorm-fused variants expose the fused qkernel/qbias)
+    'QDenseBatchnorm': 'Dense',
+    'QConv1DBatchnorm': 'Conv1D',
+    'QConv2DBatchnorm': 'Conv2D',
+    'QEinsumDense': 'EinsumDense',
+    'QEinsumDenseBatchnorm': 'EinsumDense',
+    'QMaxPool1D': 'MaxPooling1D',
+    'QMaxPool2D': 'MaxPooling2D',
+    'QAveragePooling1D': 'AveragePooling1D',
+    'QAveragePooling2D': 'AveragePooling2D',
+    'QGlobalAveragePooling1D': 'GlobalAveragePooling1D',
+    'QGlobalAveragePooling2D': 'GlobalAveragePooling2D',
+    'QGlobalMaxPooling1D': 'GlobalMaxPooling1D',
+    'QGlobalMaxPooling2D': 'GlobalMaxPooling2D',
+    'QAdd': 'Add',
+    'QSubtract': 'Subtract',
+    'QMultiply': 'Multiply',
+    'QMaximum': 'Maximum',
+    'QMinimum': 'Minimum',
+    'QAverage': 'Average',
+    'QConcatenate': 'Concatenate',
+    'QFlatten': 'Flatten',
+    'QReshape': 'Reshape',
 }
 
 
@@ -58,10 +81,16 @@ def _weight(w) -> np.ndarray:
 
 
 def _quantized_weight(layer, attr: str, quantizer_attrs: tuple[str, ...]) -> np.ndarray:
-    """A layer weight, passed through its quantizer when one is attached
-    (QKeras-style duck typing: the first readable quantizer attribute wins)."""
+    """A layer weight, passed through its quantizer when one is attached.
+
+    HGQ2 layers expose the already-quantized values under a ``q`` prefix
+    (``qkernel``/``qbias``) — exact, so they win outright; otherwise QKeras-
+    style duck typing applies the first readable quantizer attribute."""
     from .qkeras_compat import quantize_weights
 
+    qw = getattr(layer, 'q' + attr, None)
+    if qw is not None:
+        return _weight(qw)
     w = _weight(getattr(layer, attr))
     for qa in quantizer_attrs:
         q = getattr(layer, qa, None)
@@ -125,6 +154,30 @@ class KerasTracer(TracerPluginBase):
     # ------------------------------------------------------------ layers
 
     def _trace_layer(self, layer, args: tuple, kwargs: dict):
+        """HGQ2-aware entry: wrap the base handler with the layer's input /
+        output quantizers (heterogeneous per-element kif), then dispatch."""
+        from .hgq2_compat import apply_hgq_quantizer, is_hgq_layer
+
+        if not is_hgq_layer(layer):
+            return self._trace_layer_inner(layer, args, kwargs)
+
+        def _maybe_q(a, q, where):
+            if isinstance(a, FixedVariableArray):
+                return apply_hgq_quantizer(a, q, where)
+            if isinstance(a, (list, tuple)):
+                return type(a)(_maybe_q(e, q, where) for e in a)
+            return a
+
+        iq = getattr(layer, 'iq', None)
+        if iq is not None:
+            args = tuple(_maybe_q(a, iq, 'input') for a in args)
+        out = self._trace_layer_inner(layer, args, kwargs)
+        oq = getattr(layer, 'oq', None)
+        if oq is not None and isinstance(out, FixedVariableArray):
+            out = apply_hgq_quantizer(out, oq, 'output')
+        return out
+
+    def _trace_layer_inner(self, layer, args: tuple, kwargs: dict):
         name = type(layer).__name__
 
         if name == 'QActivation':
@@ -147,6 +200,25 @@ class KerasTracer(TracerPluginBase):
             x = args[0]
             y = x @ _quantized_weight(layer, 'kernel', ('kernel_quantizer_internal', 'kernel_quantizer'))
             if layer.use_bias:
+                y = y + _quantized_weight(layer, 'bias', ('bias_quantizer_internal', 'bias_quantizer'))
+            return _apply_activation(y, layer.activation)
+
+        if name == 'EinsumDense':
+            eq = layer.equation.replace(' ', '')
+            lhs, rhs = eq.split('->')
+            in_spec, k_spec = lhs.split(',')
+            # drop the batch token ('...' or a leading letter absent from the
+            # kernel spec) — tracing is per-sample
+            if in_spec.startswith('...') and rhs.startswith('...') and '...' not in k_spec:
+                eq2 = f'{in_spec[3:]},{k_spec}->{rhs[3:]}'
+            elif in_spec and rhs and in_spec[0] == rhs[0] and in_spec[0] not in k_spec:
+                eq2 = f'{in_spec[1:]},{k_spec}->{rhs[1:]}'
+            else:
+                raise NotImplementedError(f'EinsumDense equation {eq!r}: cannot identify the batch axis')
+            from ..trace.ops import einsum as _einsum
+
+            y = _einsum(eq2, args[0], _quantized_weight(layer, 'kernel', ('kernel_quantizer_internal', 'kernel_quantizer')))
+            if getattr(layer, 'qbias', None) is not None or getattr(layer, 'bias', None) is not None:
                 y = y + _quantized_weight(layer, 'bias', ('bias_quantizer_internal', 'bias_quantizer'))
             return _apply_activation(y, layer.activation)
 

@@ -28,6 +28,112 @@ def _i32(x: int) -> int:
     return ((int(x) & 0xFFFFFFFF) + (1 << 31)) % (1 << 32) - (1 << 31)
 
 
+# ---------------------------------------------------------------------------
+# per-opcode replay semantics
+#
+# One handler per opcode family, shared by the numeric (float) and symbolic
+# (tracer-variable) replay paths — the same registry style as the tracer's
+# ``_ENCODERS`` (trace/tracer.py) and the numpy runtime's dispatch, so op
+# semantics live in exactly one place per representation. Handlers receive
+# the program, the op, the value buffer so far, and the scaled inputs, and
+# return the op's value.
+# ---------------------------------------------------------------------------
+
+_REPLAY: dict[int, object] = {}
+
+
+def _replays(*opcodes: int):
+    def register(fn):
+        for oc in opcodes:
+            _REPLAY[oc] = fn
+        return fn
+
+    return register
+
+
+@_replays(-1)
+def _rp_input(comb: 'CombLogic', op: Op, buf: list, inputs: list):
+    return inputs[op.id0]
+
+
+@_replays(0, 1)
+def _rp_shift_add(comb, op, buf, inputs):
+    shifted = buf[op.id1] * 2.0**op.data
+    return buf[op.id0] + shifted if op.opcode == 0 else buf[op.id0] - shifted
+
+
+@_replays(2, -2)
+def _rp_relu(comb, op, buf, inputs):
+    _, i, f = minimal_kif(op.qint)
+    return apply_relu(buf[op.id0], i, f, inv=op.opcode < 0, round_mode='TRN')
+
+
+@_replays(3, -3)
+def _rp_quantize(comb, op, buf, inputs):
+    v = buf[op.id0] if op.opcode > 0 else -buf[op.id0]
+    k, i, f = minimal_kif(op.qint)
+    return apply_quantize(v, k, i, f, round_mode='TRN', force_wrap=True)
+
+
+@_replays(4)
+def _rp_const_add(comb, op, buf, inputs):
+    return buf[op.id0] + op.data * op.qint.step
+
+
+@_replays(5)
+def _rp_const(comb, op, buf, inputs):
+    return op.data * op.qint.step
+
+
+@_replays(6, -6)
+def _rp_msb_mux(comb, op, buf, inputs):
+    cond_slot = op.data & 0xFFFFFFFF
+    shift = _i32(op.data >> 32)
+    key = buf[cond_slot]
+    on_neg = buf[op.id0]
+    on_pos = buf[op.id1] * 2.0**shift
+    if op.opcode < 0:
+        on_pos = -on_pos
+    if hasattr(key, 'msb_mux'):  # symbolic replay
+        return key.msb_mux(on_neg, on_pos, op.qint)
+    q_key = comb.ops[cond_slot].qint
+    if q_key.min < 0:
+        return on_neg if key < 0 else on_pos
+    _, i, _ = minimal_kif(q_key)  # unsigned key: MSB = top magnitude bit
+    return on_neg if key >= 2.0 ** (i - 1) else on_pos
+
+
+@_replays(7)
+def _rp_mul(comb, op, buf, inputs):
+    return buf[op.id0] * buf[op.id1]
+
+
+@_replays(8)
+def _rp_lookup(comb, op, buf, inputs):
+    if comb.lookup_tables is None:
+        raise ValueError('No lookup table for lookup op')
+    return comb.lookup_tables[op.data].lookup(buf[op.id0], comb.ops[op.id0].qint)
+
+
+@_replays(9, -9)
+def _rp_bit_unary(comb, op, buf, inputs):
+    v = buf[op.id0] if op.opcode > 0 else -buf[op.id0]
+    return apply_unary_bit_op(v, op.data, comb.ops[op.id0].qint, op.qint)
+
+
+@_replays(10)
+def _rp_bit_binary(comb, op, buf, inputs):
+    v0 = -buf[op.id0] if (op.data >> 32) & 1 else buf[op.id0]
+    v1 = -buf[op.id1] if (op.data >> 33) & 1 else buf[op.id1]
+    shift = _i32(op.data)
+    subop = (op.data >> 56) & 0xFF
+    s = 2.0**shift
+    q1 = comb.ops[op.id1].qint
+    return apply_binary_bit_op(
+        v0, v1 * s, subop, comb.ops[op.id0].qint, QInterval(q1.min * s, q1.max * s, q1.step * s), op.qint
+    )
+
+
 class CombLogic(NamedTuple):
     """A combinational SSA program: ops fill a buffer; outputs are scaled reads.
 
@@ -48,77 +154,36 @@ class CombLogic(NamedTuple):
     lookup_tables: tuple[LookupTable, ...] | None = None
 
     def __call__(self, inp, quantize: bool = False, dump: bool = False):
-        """Replay the op list over the input — numeric (floats) or symbolic."""
-        buf = np.empty(len(self.ops), dtype=object)
-        inp = np.asarray(inp)
-        if quantize:
-            k, i, f = self.inp_kifs
-            inp = [apply_quantize(x, *kif, round_mode='TRN') for x, *kif in zip(inp, k, i, f)]
-        inp = inp * (2.0 ** np.array(self.inp_shifts))
+        """Replay the op list over the input — numeric (floats) or symbolic.
 
-        for n, op in enumerate(self.ops):
-            oc = op.opcode
-            if oc == -1:
-                buf[n] = inp[op.id0]
-            elif oc in (0, 1):
-                v0, v1 = buf[op.id0], 2.0**op.data * buf[op.id1]
-                buf[n] = v0 + v1 if oc == 0 else v0 - v1
-            elif oc in (2, -2):
-                _, _i, _f = minimal_kif(op.qint)
-                buf[n] = apply_relu(buf[op.id0], _i, _f, inv=oc == -2, round_mode='TRN')
-            elif oc in (3, -3):
-                v = buf[op.id0] if oc == 3 else -buf[op.id0]
-                _k, _i, _f = minimal_kif(op.qint)
-                buf[n] = apply_quantize(v, _k, _i, _f, round_mode='TRN', _force_factor_clear=True)
-            elif oc == 4:
-                buf[n] = buf[op.id0] + op.data * op.qint.step
-            elif oc == 5:
-                buf[n] = op.data * op.qint.step
-            elif oc in (6, -6):
-                id_c = op.data & 0xFFFFFFFF
-                k, v0, v1 = buf[id_c], buf[op.id0], buf[op.id1]
-                shift = _i32(op.data >> 32)
-                if oc == -6:
-                    v1 = -v1
-                if hasattr(k, 'msb_mux'):
-                    buf[n] = k.msb_mux(v0, v1 * 2**shift, op.qint)
-                else:
-                    qint_k = self.ops[id_c].qint
-                    if qint_k.min < 0:
-                        buf[n] = v0 if k < 0 else v1 * 2.0**shift
-                    else:
-                        _, _i, _ = minimal_kif(qint_k)
-                        buf[n] = v0 if k >= 2.0 ** (_i - 1) else v1 * 2.0**shift
-            elif oc == 7:
-                buf[n] = buf[op.id0] * buf[op.id1]
-            elif oc == 8:
-                assert self.lookup_tables is not None, 'No lookup table for lookup op'
-                buf[n] = self.lookup_tables[op.data].lookup(buf[op.id0], self.ops[op.id0].qint)
-            elif oc in (9, -9):
-                v0 = buf[op.id0] if oc == 9 else -buf[op.id0]
-                buf[n] = apply_unary_bit_op(v0, op.data, self.ops[op.id0].qint, op.qint)
-            elif oc == 10:
-                v0, v1 = buf[op.id0], buf[op.id1]
-                if (op.data >> 32) & 1:
-                    v0 = -v0
-                if (op.data >> 33) & 1:
-                    v1 = -v1
-                shift = _i32(op.data)
-                subop = (op.data >> 56) & 0xFF
-                q1 = self.ops[op.id1].qint
-                s = 2.0**shift
-                qint1 = QInterval(q1.min * s, q1.max * s, q1.step * s)
-                buf[n] = apply_binary_bit_op(v0, v1 * s, subop, self.ops[op.id0].qint, qint1, op.qint)
-            else:
-                raise ValueError(f'Unknown opcode {oc} in {op}')
+        Op semantics come from the module-level ``_REPLAY`` registry (one
+        handler per opcode family); this method only owns input scaling, the
+        SSA value buffer, and output read-out.
+        """
+        values = list(np.asarray(inp))
+        if quantize:
+            ks, is_, fs = self.inp_kifs
+            values = [apply_quantize(x, k, i, f, round_mode='TRN') for x, k, i, f in zip(values, ks, is_, fs)]
+        scaled = [v * 2.0**s for v, s in zip(values, self.inp_shifts)]
+
+        buf: list = []
+        for op in self.ops:
+            handler = _REPLAY.get(op.opcode)
+            if handler is None:
+                raise ValueError(f'Unknown opcode {op.opcode} in {op}')
+            buf.append(handler(self, op, buf, scaled))
 
         if dump:
-            return buf
-        sf = 2.0 ** np.array(self.out_shifts, dtype=np.float64)
-        sign = np.where(self.out_negs, -1, 1)
-        out_idx = np.array(self.out_idxs, dtype=np.int32)
-        mask = np.where(out_idx < 0, 0, 1)
-        return buf[out_idx] * sf * sign * mask
+            return np.array(buf, dtype=object)
+        out = []
+        for idx, sh, neg in zip(self.out_idxs, self.out_shifts, self.out_negs):
+            v = buf[idx] * 2.0**sh
+            if neg:
+                v = -v
+            # idx < 0 marks a dead output lane; keep a typed zero of the
+            # replayed element kind (symbolic zero under symbolic replay)
+            out.append(v * 0 if idx < 0 else v)
+        return np.array(out, dtype=object)
 
     # ---------------------------------------------------------------- metrics
 

@@ -35,10 +35,10 @@ def apply_relu(v, i=None, f=None, inv: bool = False, round_mode: str = 'TRN'):
     return v.relu(i, f, round_mode=round_mode)
 
 
-def apply_quantize(v, k, i, f, round_mode: str = 'TRN', _force_factor_clear: bool = False):
+def apply_quantize(v, k, i, f, round_mode: str = 'TRN', force_wrap: bool = False):
     if isinstance(v, _NUMERIC):
         return quantize_float(v, k, i, f, round_mode=round_mode)
-    return v.quantize(k, i, f, round_mode=round_mode, _force_factor_clear=_force_factor_clear)
+    return v.quantize(k, i, f, round_mode=round_mode, force_wrap=force_wrap)
 
 
 def numeric_unary_bit_op(a: float, op: int, qint_from: QInterval, qint_to: QInterval | None = None) -> float:

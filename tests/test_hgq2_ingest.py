@@ -1,0 +1,221 @@
+"""HGQ2 front-end ingestion.
+
+Two tiers:
+
+1. ``test_hgq2_surface_*`` (always run): mock layers replicating HGQ2's
+   duck-typed attribute surface — wrapper quantizers with per-element
+   heterogeneous (k, i, f) tensors (KIF and KBI parameterizations), leading
+   broadcast axes, ``qkernel``/``qbias`` quantized weights, iq/oq input and
+   output quantizers — traced through the real Keras plugin and pinned
+   bit-exact against the model's own keras-ops forward.
+
+2. ``test_hgq2_genuine_*`` (skipped without the ``hgq`` package): an actual
+   HGQ2 model saved to ``.keras``, reloaded, traced, and pinned bit-exact
+   against ``model.predict``. One-command run wherever HGQ2 is installed:
+
+       pytest tests/test_hgq2_ingest.py -k genuine
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+import keras
+import numpy as np
+import pytest
+from keras import ops
+
+from da4ml_tpu.converter import trace_model
+from da4ml_tpu.trace import HWConfig, comb_trace
+
+_HAS_HGQ = importlib.util.find_spec('hgq') is not None
+
+
+def _q_ops(x, k, i, f, round_mode='RND'):
+    """keras-ops twin of the golden quantize_float (WRAP, TRN/RND)."""
+    k = np.asarray(k, np.float64)
+    i = np.asarray(i, np.float64)
+    f = np.asarray(f, np.float64)
+    eps = 2.0**-f
+    b = k + i + f
+    bias = 2.0 ** (b - 1) * k
+    v = x + (eps * 0.5 if round_mode == 'RND' else 0.0)
+    return eps * (ops.mod(ops.floor(v / eps) + bias, 2.0**b) - bias)
+
+
+class _InnerKIF:
+    """HGQ2-style internal fixed-point quantizer, KIF parameterization."""
+
+    def __init__(self, k, i, f, overflow='WRAP', round_mode='RND'):
+        # leading broadcast (batch) axis of 1, as HGQ2 parameter tensors carry
+        self.k = np.asarray(k, np.float32)[None]
+        self.i = np.asarray(i, np.float32)[None]
+        self.f = np.asarray(f, np.float32)[None]
+        self.overflow_mode = overflow
+        self.round_mode = round_mode
+
+
+class _InnerKBI:
+    """KBI parameterization: f = b - i."""
+
+    def __init__(self, k, b, i, overflow='WRAP', round_mode='RND'):
+        self.k = np.asarray(k, np.float32)[None]
+        self.b = np.asarray(b, np.float32)[None]
+        self.i = np.asarray(i, np.float32)[None]
+        self.overflow_mode = overflow
+        self.round_mode = round_mode
+
+    @property
+    def f(self):
+        return None  # force the KBI branch of the reader
+
+
+class _Quantizer:
+    """The wrapper object (hgq.quantizer.Quantizer look-alike)."""
+
+    def __init__(self, inner):
+        self.quantizer = inner
+        self.enabled = True
+
+    def kif(self):
+        c = self.quantizer
+        f = getattr(c, 'f', None)
+        if f is None:
+            f = c.b - c.i
+        return c.k, c.i, f
+
+    def __call__(self, x):
+        k, i, f = self.kif()
+        return _q_ops(x, k, i, f, self.quantizer.round_mode)
+
+
+class QDense(keras.layers.Layer):
+    """Mock with HGQ2 QDense's name and attribute surface (iq, oq, qkernel)."""
+
+    def __init__(self, kernel, bias, iq, oq, activation='linear', **kw):
+        super().__init__(**kw)
+        self._kernel = np.asarray(kernel, np.float64)
+        self._bias = np.asarray(bias, np.float64) if bias is not None else None
+        self.iq = iq
+        self.oq = oq
+        self.activation = activation
+        self.use_bias = bias is not None
+
+    # weight quantizers: 4-bit fractional grid, exactly representable values
+    @property
+    def qkernel(self):
+        return np.round(self._kernel * 16) / 16
+
+    @property
+    def qbias(self):
+        return None if self._bias is None else np.round(self._bias * 16) / 16
+
+    # the plugin reads .kernel only when qkernel is absent; keep both valid
+    @property
+    def kernel(self):
+        return self.qkernel
+
+    @property
+    def bias(self):
+        return self.qbias
+
+    def call(self, x):
+        y = x
+        if self.iq is not None:
+            y = self.iq(y)
+        y = ops.matmul(y, ops.convert_to_tensor(self.qkernel, dtype=y.dtype))
+        if self.qbias is not None:
+            y = y + ops.convert_to_tensor(self.qbias, dtype=y.dtype)
+        if self.activation == 'relu':
+            y = ops.relu(y)
+        if self.oq is not None:
+            y = self.oq(y)
+        return y
+
+
+def _hetero_kif(rng, n, lo_i=1, hi_i=4, lo_f=1, hi_f=5, k=1):
+    return (
+        np.full(n, k, np.int64),
+        rng.integers(lo_i, hi_i + 1, n),
+        rng.integers(lo_f, hi_f + 1, n),
+    )
+
+
+@pytest.mark.parametrize('param', ['kif', 'kbi'])
+def test_hgq2_surface_dense_chain(rng, param):
+    """Two mock QDense layers with heterogeneous per-element kif, traced via
+    the plugin, bit-exact vs the keras-ops forward."""
+    n_in, n_mid, n_out = 6, 5, 3
+    k0, i0, f0 = _hetero_kif(rng, n_in)
+    k1, i1, f1 = _hetero_kif(rng, n_mid, k=0)  # post-relu: unsigned
+
+    def make_q(k, i, f):
+        if param == 'kif':
+            return _Quantizer(_InnerKIF(k, i, f))
+        return _Quantizer(_InnerKBI(k, i + f, i))
+
+    iq0 = make_q(k0, i0, f0)
+    oq0 = make_q(k1, i1, f1)
+    w0 = rng.uniform(-2, 2, (n_in, n_mid))
+    b0 = rng.uniform(-1, 1, n_mid)
+    w1 = rng.uniform(-2, 2, (n_mid, n_out))
+    k2, i2, f2 = _hetero_kif(rng, n_out)
+    oq1 = make_q(k2, i2 + 4, f2)  # wide enough to pass sums through
+
+    inp = keras.Input((n_in,))
+    h = QDense(w0, b0, iq=iq0, oq=oq0, activation='relu')(inp)
+    out = QDense(w1, None, iq=None, oq=oq1)(h)
+    model = keras.Model(inp, out)
+
+    x = rng.uniform(-4, 4, (64, n_in))
+    golden = np.asarray(model(ops.convert_to_tensor(x, 'float64')))
+
+    t_in, t_out = trace_model(model, HWConfig(1, -1, -1))
+    comb = comb_trace(t_in, t_out)
+    got = comb.predict(x)
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_hgq2_surface_einsum_dense(rng):
+    """EinsumDense path (HGQ2's flagship layer family) via keras's own layer
+    with an hgq-style qkernel attached."""
+    inp = keras.Input((4, 5))
+    layer = keras.layers.EinsumDense('bij,jk->bik', (4, 6), bias_axes='k')
+    out = layer(inp)
+    model = keras.Model(inp, out)
+    # quantize the built weights onto an exact grid, hgq-style
+    qk = np.round(np.asarray(layer.kernel) * 8) / 8
+    qb = np.round(np.asarray(layer.bias) * 8) / 8
+    layer._kernel.assign(qk.astype(np.float32))
+    layer.bias.assign(qb.astype(np.float32))
+
+    x = (rng.integers(-32, 32, (16, 4, 5)) / 8.0).astype(np.float64)
+    golden = np.einsum('bij,jk->bik', x, qk) + qb
+
+    t_in, t_out = trace_model(model, HWConfig(1, -1, -1), inputs_kif=(1, 3, 3))
+    comb = comb_trace(t_in, t_out)
+    got = comb.predict(x.reshape(16, -1)).reshape(16, 4, 6)
+    np.testing.assert_array_equal(got, golden)
+
+
+@pytest.mark.skipif(not _HAS_HGQ, reason='hgq (HGQ2) not installed')
+def test_hgq2_genuine_checkpoint(rng, tmp_path):
+    """A real HGQ2 model: build, save .keras, reload, trace, bit-exact."""
+    import hgq  # noqa: F401
+    from hgq.layers import QDense
+
+    inp = keras.Input((8,))
+    h = QDense(16, activation='relu')(inp)
+    out = QDense(4)(h)
+    model = keras.Model(inp, out)
+    x = rng.uniform(-2, 2, (256, 8)).astype(np.float32)
+    _ = model(x)  # build quantizer state
+
+    path = tmp_path / 'hgq2_model.keras'
+    model.save(path)
+    loaded = keras.models.load_model(path, compile=False)
+
+    golden = np.asarray(loaded.predict(x, verbose=0), np.float64)
+    t_in, t_out = trace_model(loaded, HWConfig(1, -1, -1))
+    comb = comb_trace(t_in, t_out)
+    np.testing.assert_array_equal(comb.predict(np.asarray(x, np.float64)), golden)

@@ -148,7 +148,18 @@ def test_binder_emission_consistent(tmp_path, pipelined):
 @pytest.mark.skipif(shutil.which('verilator') is None, reason='verilator not installed')
 @pytest.mark.parametrize('pipelined', [False, True])
 def test_verilator_emulation_exact(tmp_path, pipelined):
-    """Full emulation path == DAIS interpreter (reference test_rtl_gen)."""
+    """Full co-simulation triangle where verilator exists: the compiled
+    Verilator emulator == DAIS interpreter == in-tree netlist simulator
+    (reference test_rtl_gen; rtl_model.py:252-330 of calad0i/da4ml).
+
+    One-command run on a machine with verilator installed:
+        pytest tests/test_rtl_binder.py -k verilator
+    """
     model = _project(tmp_path, pipelined).compile()
     data = np.random.default_rng(9).uniform(-8, 8, (64, 6))
-    np.testing.assert_array_equal(model.predict(data, backend='emu'), model.predict(data, backend='interp'))
+    emu = model.predict(data, backend='emu')
+    np.testing.assert_array_equal(emu, model.predict(data, backend='interp'))
+    if not pipelined:  # the netlist sim oracle covers the comb project
+        from da4ml_tpu.codegen.rtl.verilog.netlist_sim import simulate_comb
+
+        np.testing.assert_array_equal(emu, simulate_comb(model.solution, name='binder_t', data=data))
