@@ -241,6 +241,22 @@ def convert(
 
 
 def convert_main(args: argparse.Namespace) -> int:
+    if getattr(args, 'warmup', False) and args.solver_backend == 'jax':
+        # overlap the dominant-shape-class compile ladder with model load +
+        # host-side tracing (CSD/decompose): by the time the first device
+        # solve dispatches, the small classes are already in the caches.
+        # Only meaningful for the device solver — 'auto' resolves to the
+        # host path, which compiles nothing.
+        import threading
+
+        from .warmup import warmup_main
+
+        wargs = argparse.Namespace(
+            max_dim=args.warmup_max_dim, bits=6, verbose=args.verbose > 1, quiet=args.verbose < 1
+        )
+        threading.Thread(target=warmup_main, args=(wargs,), daemon=True, name='da4ml-warmup').start()
+    elif getattr(args, 'warmup', False) and args.verbose:
+        print('[INFO] --warmup skipped: only applies with --solver-backend jax')
     convert(
         args.model,
         args.outdir,
@@ -285,6 +301,12 @@ def add_convert_args(parser: argparse.ArgumentParser):
     parser.add_argument(
         '--solver-backend', type=str, default='auto', choices=['auto', 'cpu', 'cpp', 'jax'], help='CMVM solver backend'
     )
+    parser.add_argument(
+        '--warmup',
+        action='store_true',
+        help='Pre-compile the dominant device shape classes in the background while the model loads/traces',
+    )
+    parser.add_argument('--warmup-max-dim', type=int, default=64, help='Largest square class the --warmup ladder compiles')
     parser.add_argument(
         '--n-restarts',
         type=int,

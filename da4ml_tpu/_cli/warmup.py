@@ -30,8 +30,12 @@ def warmup_main(args) -> int:
     import jax
 
     try:
-        jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
-        jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+        # arm the persistent cache only when the process has not configured
+        # one — when warmup runs inside a conversion process (--warmup) it
+        # must never redirect a user-configured cache dir mid-run
+        if not jax.config.read('jax_compilation_cache_dir'):
+            jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
+            jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
     except Exception:
         pass
 
@@ -49,5 +53,6 @@ def warmup_main(args) -> int:
         assert np.array_equal(np.asarray(sol.kernel, np.float64), kern)
         if args.verbose:
             print(f'  {d}x{d}: {time.perf_counter() - t0:.1f}s')
-    print(f'warmup: {len(dims)} shape-class ladders compiled/cached in {time.perf_counter() - t_all:.1f}s')
+    if not getattr(args, 'quiet', False):
+        print(f'warmup: {len(dims)} shape-class ladders compiled/cached in {time.perf_counter() - t_all:.1f}s')
     return 0

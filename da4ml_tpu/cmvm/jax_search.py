@@ -1070,34 +1070,36 @@ def solve_single_lanes(
                     pend = []
                     break
             n_pend = len(pend)
-            select = _select()
-            # the cache is exact at small P; a deeper K narrows its
-            # understatement window at large P (env overrides)
-            topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
-            if select == 'fused':
-                from .fused_cse import fused_feasible
-
-                # the fused kernel keeps a lane block resident in VMEM; pad
-                # tiny classes up to the 128-lane tile (decisions are
-                # P-independent — padding slots are never selectable) and
-                # fall back to the XLA top4 loop — at the NATURAL rung P —
-                # when a class outgrows VMEM
-                P_f = max(P, 128) if pmax >= 128 else P
-                if fused_feasible(P_f, O, B, topk):
-                    P = P_f
-                else:
-                    select = 'top4'
             # rows actually carrying state this rung: n_in_max on entry, the
             # previous rung's P on resume (st_cur hits the cap exactly).
             # Rounded up to a power of two so the compile-class lattice stays
             # coarse — a fresh R_in value would otherwise recompile the whole
-            # CSE program just to trim the upload.
-            rows_in = min(_next_pow2(int(st_cur[pend].max())), P)
-            fn = _build_cse_fn(
-                _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk)
+            # CSE program just to trim the upload. The topk rule (cache is
+            # exact at small P; deeper K at large P) and the fused pad-up /
+            # VMEM-fallback policy live in _resolve_rung_class, shared with
+            # the prewarm estimators.
+            spec = _resolve_rung_class(
+                P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(int(st_cur[pend].max()))
             )
+            P, select, topk = spec.P, spec.select, spec.topk
+            rows_in = spec.R_in or P
+            fn = _build_cse_fn(spec)
             if select == 'fused' and mesh is not None and sh is not None:
                 fn = _fused_sharded(fn, mesh)
+
+            if _prewarm_enabled() and P < pmax:
+                # lanes whose slot demand outgrows this rung will resume at
+                # the next one; AOT-compile that class while this rung runs
+                resume_est = [
+                    a
+                    for a in pend
+                    if lanes[active[a]].csd.shape[0] + _lane_initial_digits(lanes[active[a]]) // 2 > P
+                ]
+                P2 = min(_next_pow2(P + step), pmax)
+                if resume_est and P2 > P:
+                    spec2 = _resolve_rung_class(P2, O, B, adder_size, carry_size, _select(), pmax, P)
+                    bucket2 = _bucket_lanes(len(resume_est), mesh)
+                    _prewarm_submit(lambda s=spec2, b=bucket2: _prewarm_class(s, b))
 
             # HBM guard: bound the lanes per device call so a wide batch of
             # large matrices cannot OOM-crash the worker; excess lanes run in
@@ -1261,6 +1263,136 @@ def solve_single_lanes(
                 results[k] = to_solution(state, adder_size, carry_size)
 
     return [results[k] for k in range(len(lanes))]
+
+
+# --------------------------------------------------------------------------
+# background shape-class pre-warm (cold-conversion latency)
+# --------------------------------------------------------------------------
+
+import queue as _queue
+import threading as _threading
+
+_PREWARM_Q: _queue.SimpleQueue | None = None
+_PREWARM_LOCK = _threading.Lock()
+
+
+def _prewarm_enabled() -> bool:
+    """Pre-warm only where compiles are the bottleneck (remote TPU compiler);
+    env DA4ML_JAX_PREWARM=1/0 forces it on/off (tests force on, CPU default
+    off so interpret-mode pallas compiles never run speculatively)."""
+    env = os.environ.get('DA4ML_JAX_PREWARM', '')
+    if env in ('0', '1'):
+        return env == '1'
+    return jax.default_backend() == 'tpu'
+
+
+def _prewarm_worker(q: '_queue.SimpleQueue') -> None:
+    while True:
+        job = q.get()
+        try:
+            job()
+        except Exception:
+            pass
+
+
+def _prewarm_submit(job) -> None:
+    """Queue a speculative compile on the single DAEMON worker thread (a
+    ThreadPoolExecutor would be joined at interpreter exit, hanging shutdown
+    on a queued remote compile; daemon threads just die)."""
+    global _PREWARM_Q
+    with _PREWARM_LOCK:
+        if _PREWARM_Q is None:
+            _PREWARM_Q = _queue.SimpleQueue()
+            _threading.Thread(target=_prewarm_worker, args=(_PREWARM_Q,), daemon=True, name='da4ml-prewarm').start()
+    _PREWARM_Q.put(job)
+
+
+def _prewarm_class(spec: _KernelSpec, bucket: int) -> None:
+    """AOT-compile a shape class (lower + compile, NO execution — a prewarm
+    must never contend for device HBM with the live solve). With the
+    persistent XLA cache armed the later real call deserializes instead of
+    recompiling; failures are swallowed."""
+    try:
+        # arm the persistent cache if the process has not configured one —
+        # without it an AOT compile warms nothing (never override a
+        # user-configured dir)
+        if not jax.config.read('jax_compilation_cache_dir'):
+            jax.config.update(
+                'jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache')
+            )
+            jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+        fn = _build_cse_fn(spec)
+        P, O, B = spec.P, spec.O, spec.B
+        rows = spec.R_in or P
+        if spec.R_in and (O * B) % 16 == 0:
+            E = jax.ShapeDtypeStruct((bucket, rows, (O * B) // 16), jnp.int32)
+        elif spec.R_in and (O * B) % 4 == 0:
+            E = jax.ShapeDtypeStruct((bucket, rows, (O * B) // 4), jnp.int32)
+        else:
+            E = jax.ShapeDtypeStruct((bucket, rows, O, B), jnp.int8)
+        q = jax.ShapeDtypeStruct((bucket, rows, 3), jnp.float32)
+        lat = jax.ShapeDtypeStruct((bucket, rows), jnp.float32)
+        cc = jax.ShapeDtypeStruct((bucket,), jnp.int32)
+        cm = jax.ShapeDtypeStruct((bucket,), jnp.int32)
+        fn.lower(E, q, lat, cc, cm).compile()
+    except Exception:
+        pass
+
+
+def _resolve_rung_class(
+    P: int, O: int, B: int, adder_size: int, carry_size: int, select: str, pmax: int, rows_cap: int
+) -> _KernelSpec:
+    """Final (P, select, topk, R_in) policy for a device rung — the single
+    source of truth shared by the live rung loop and both prewarm
+    estimators, so the speculative compile always targets the class the
+    real rung will use."""
+    topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
+    if select == 'fused':
+        from .fused_cse import fused_feasible
+
+        # the fused kernel keeps a lane block resident in VMEM; pad tiny
+        # classes up to the 128-lane tile (decisions are P-independent —
+        # padding slots are never selectable) and fall back to the XLA top4
+        # loop — at the NATURAL rung P — when a class outgrows VMEM
+        P_f = max(P, 128) if pmax >= 128 else P
+        if fused_feasible(P_f, O, B, topk):
+            P = P_f
+        else:
+            select = 'top4'
+    rows_in = min(rows_cap, P)
+    return _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk)
+
+
+def _first_rung_spec(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None):
+    """(spec, bucket) the FIRST device rung of ``solve_single_lanes`` will
+    use for these lanes — a mirror of the rung-entry calculation there, used
+    only to pre-warm compiles; a drifted estimate wastes one background
+    compile and can never change results. Returns None when nothing routes
+    to the device."""
+
+    def _ceil_to(x: int, q: int) -> int:
+        return -(-x // q) * q
+
+    active = [ln for ln in lanes if ln.method != 'dummy']
+    for ln in active:
+        if ln.csd is None:
+            _prepare_lane(ln)
+    pmax = _pmax()
+    active = [ln for ln in active if ln.csd.shape[0] + _lane_initial_digits(ln) // 2 <= pmax]
+    if not active:
+        return None
+    n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in active))
+    O = max(8, _next_pow2(max(ln.csd.shape[1] for ln in active)))
+    B = _ceil_to(max(ln.csd.shape[2] for ln in active), 2)
+    digits_max = max(_lane_initial_digits(ln) for ln in active)
+    step = _ceil_to(max(16, -(-digits_max // 8)), 8)
+    P = _next_pow2(n_in_max + step)
+    if P > pmax:
+        if n_in_max >= pmax:
+            return None
+        P = pmax
+    spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, n_in_max)
+    return spec, _bucket_lanes(len(active), mesh)
 
 
 _FUSED_SHARDED_CACHE: dict[tuple, object] = {}
@@ -1526,6 +1658,24 @@ def solve_jax_many(
             perm = prng.permutation(mat0.shape[0])
         lanes0.append(_Lane(mat0, list(qints), list(lats), method_0, perm=perm))
         mats1.append(mat1)
+
+    if _prewarm_enabled() and mats1:
+        # stage-1's first shape class compiles in the background while the
+        # stage-0 searches occupy the device — serial per-class compiles are
+        # the cold-conversion bottleneck. Probe lanes carry default
+        # qintervals (the spec depends only on CSD shapes; the CSD cache
+        # makes the real stage-1 pass reuse this work).
+        probe = [
+            _Lane(m1, [QInterval(-128.0, 127.0, 1.0)] * m1.shape[0], [0.0] * m1.shape[0], _lane_method(mpairs[mp][1], dc, _hard_eff))
+            for (mi, dc, mp, r), m1 in zip(jobs, mats1)
+        ]
+
+        def _warm_stage1(probe=probe):
+            got = _first_rung_spec(probe, adder_size, carry_size, mesh)
+            if got is not None:
+                _prewarm_class(*got)
+
+        _prewarm_submit(_warm_stage1)
     sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)

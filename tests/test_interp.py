@@ -150,3 +150,21 @@ def test_packed_io_plan_and_roundtrip():
     assert ex._in_group in (2, 4) and ex._out_group in (2, 4)  # narrow lanes packed
     data = rng.uniform(-4, 4, (64, 6))
     np.testing.assert_array_equal(ex(data), comb.predict(data, backend='numpy'))
+
+
+def test_chunked_overlap_bit_exact(rng, monkeypatch):
+    """The overlapped chunked inference path (large batches / env override)
+    is bit-identical to the monolithic device call and the numpy oracle."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput(6, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 3), np.full(6, 3))
+    w = rng.integers(-8, 8, (6, 4)).astype(np.float64)
+    comb = comb_trace(inp, (x @ w).relu(i=np.full(4, 6), f=np.full(4, 3)))
+    data = rng.uniform(-8, 8, (1000, 6))  # not divisible by the chunk count
+    golden = comb.predict(data, backend='numpy')
+    mono = comb.predict(data, backend='jax')
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNKS', '7')
+    chunked = comb.predict(data, backend='jax')
+    np.testing.assert_array_equal(mono, golden)
+    np.testing.assert_array_equal(chunked, golden)

@@ -370,3 +370,24 @@ def test_lane_level_routing_partial_device(rng, monkeypatch):
     # at least one decomposed candidate must have stayed on device
     n_lanes_total = 2 * (2 + min(10**9, int(np.ceil(np.log2(n_in)))) + 1)
     assert routed < n_lanes_total, 'not every lane may route to the host'
+
+
+def test_prewarm_paths(rng, monkeypatch):
+    """Forced-on background prewarm never changes results and its spec
+    mirror stays callable (a drifted estimate may waste a compile, never
+    break a solve)."""
+    from da4ml_tpu.cmvm import jax_search as js
+
+    monkeypatch.setenv('DA4ML_JAX_PREWARM', '1')
+    kernels = [random_kernel(rng, 8, 4), random_kernel(rng, 20, 6)]  # 2nd resumes a rung
+    sols = solve_jax_many(kernels)
+    for k, s in zip(kernels, sols):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+    # the mirror agrees with an actual first-rung spec for simple lanes
+    lanes = [js._Lane(kernels[0], [QInterval(-128.0, 127.0, 1.0)] * 8, [0.0] * 8, 'wmc')]
+    got = js._first_rung_spec(lanes, -1, -1)
+    assert got is not None
+    spec, bucket = got
+    assert spec.P >= 8 and spec.O >= 8 and bucket >= 1
+    # the worker is a daemon on a SimpleQueue: queued AOT compiles never
+    # block interpreter exit, so there is nothing to drain here
