@@ -395,13 +395,13 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
             'jax_rate': round(n / jt, 2),
         }
     if name == 'select_modes':
-        # selection-mode microbench: top4 (default, O(S*P) score cache) vs
-        # the full-rescan xla path vs its fused-pallas variant
+        # selection-mode microbench: top4 (XLA O(S*P) score cache) vs the
+        # full-rescan xla path vs the single-kernel fused Pallas loop
         from da4ml_tpu.cmvm.jax_search import _build_cse_fn
 
         k1 = _section_kernels('1_16x16_int4', n1, limited)
         out = {}
-        for mode in ('top4', 'xla', 'pallas'):
+        for mode in ('top4', 'xla', 'fused'):
             os.environ['DA4ML_JAX_SELECT'] = mode
             _build_cse_fn.cache_clear()
             try:
@@ -412,7 +412,7 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
             out[f'{mode}_rate'] = round(len(k1) / steady, 3)
             out[f'{mode}_compile_s'] = round(compile_t, 2)
         out['top4_vs_xla'] = round(out['top4_rate'] / out['xla_rate'], 3)
-        out['pallas_vs_xla'] = round(out['pallas_rate'] / out['xla_rate'], 3)
+        out['fused_vs_top4'] = round(out['fused_rate'] / out['top4_rate'], 3)
         return out
     return _with_shape_classes(_run_config(name, _section_kernels(name, n1, limited), host_backend))
 

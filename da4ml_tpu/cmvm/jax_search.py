@@ -88,9 +88,16 @@ def _select() -> str:
     full-rescan xla path is decision-identical by construction but its
     [2, S, P, P] per-iteration program costs minutes of (remote) compile
     per shape class at P >= 512 — a cold-cache conversion would stall on
-    it — so it stays opt-in.
+    it — so it stays opt-in. 'fused' runs the whole greedy loop as one
+    Pallas kernel per lane block (fused_cse.py). The removed 'pallas'
+    mode aliases to its successor 'fused'; anything else raises.
     """
-    return os.environ.get('DA4ML_JAX_SELECT', 'top4')
+    sel = os.environ.get('DA4ML_JAX_SELECT', 'top4')
+    if sel == 'pallas':  # pre-round-4 name for the (partial) fused path
+        return 'fused'
+    if sel not in ('top4', 'xla', 'fused'):
+        raise ValueError(f"DA4ML_JAX_SELECT={sel!r}: valid modes are 'top4', 'xla', 'fused'")
+    return sel
 
 
 def _pmax() -> int:
@@ -273,7 +280,7 @@ class _KernelSpec:
     B: int  # CSD bit planes
     adder_size: int
     carry_size: int
-    select: str = 'top4'  # 'top4' | 'xla' | 'pallas' (DA4ML_JAX_SELECT)
+    select: str = 'top4'  # 'top4' | 'xla' | 'fused' (DA4ML_JAX_SELECT)
     R_in: int = 0  # provided input rows (0 = full P); the rest are device-padded
     topk: int = 8  # top4 score-cache depth (deeper at large P, see _select)
 
@@ -471,29 +478,6 @@ def _build_cse_fn(spec: _KernelSpec):
         i_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
         j_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
         return _argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax)
-
-    def select_pair_pallas(Cs, Cd, nov, dlat, method):
-        """Fused VMEM select (pallas): decision-identical with select_pair.
-
-        One grid pass over the count tensor computes score + mask + the
-        host-order tie reduction per tile without materializing the f32
-        score tensor in HBM.
-        """
-        from .pallas_select import make_select
-
-        sel_fn = make_select(P, B, str(Cs.dtype), interpret=jax.default_backend() != 'tpu')
-        is_dc = (method == 1) | (method == 2)
-        is_wdc = (method == 4) | (method == 5)
-        coef = jnp.stack(
-            [
-                jnp.where(method < 3, 1.0, 0.0),
-                jnp.where(method >= 3, 1.0, 0.0),
-                jnp.where(is_dc, 1e9, jnp.where(is_wdc, 256.0, 0.0)),
-                jnp.where((method == 1) | (method == 3) | (method == 4), 1.0, 0.0),
-            ]
-        ).reshape(1, 4)
-        r1, r2, any_valid = sel_fn(Cs, Cd, nov, dlat, coef)
-        return any_valid, *_rank_decode(r1, r2)
 
     b_idx = jnp.arange(B)
 
@@ -744,10 +728,7 @@ def _build_cse_fn(spec: _KernelSpec):
 
         def body(state):
             E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec, _ = state
-            if spec.select == 'pallas':
-                any_valid, sub, s, i, j = select_pair_pallas(Cs, Cd, nov, dlt, method)
-            else:
-                any_valid, sub, s, i, j = select_pair(Cs, Cd, nov, dlt, method)
+            any_valid, sub, s, i, j = select_pair(Cs, Cd, nov, dlt, method)
 
             def do_update(args):
                 E, Cs, Cd, nov, dlt, qmeta, lat, cur, op_rec = args
