@@ -1,0 +1,106 @@
+"""On-hardware fused-vs-top4 profiler (manual tool, not a pytest suite).
+
+The first thing to run in a healthy tunnel window:
+
+    python tests_tpu/profile_fused.py [n_matrices]
+
+Phases, each bounded so a Mosaic lowering failure or wedge costs minutes,
+not the window:
+
+1. tiny fused Mosaic-compile smoke (the real risk: interpret mode passes
+   where Mosaic tiling constraints bite),
+2. decision-identity spot check fused vs top4 on hardware,
+3. steady-rate head-to-head on the BASELINE config-1 class (16x16 int4),
+4. the derived per-iteration loop-body time for both modes.
+
+Exit code 0 = fused compiled, identical, and its rate is printed; the
+select_modes bench section then captures the formal numbers.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+
+def _mk(rng, n, bits, count):
+    return [
+        (rng.integers(0, 2**bits, (n, n)) * rng.choice([-1.0, 1.0], (n, n))).astype(np.float64)
+        for _ in range(count)
+    ]
+
+
+def _solve(kernels, select):
+    # no cache_clear: the select mode is baked into the _KernelSpec lru key,
+    # so top4/fused programs never alias and warm compiles stay warm
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    os.environ['DA4ML_JAX_SELECT'] = select
+    try:
+        return solve_jax_many(kernels)
+    finally:
+        os.environ.pop('DA4ML_JAX_SELECT', None)
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    os.environ.setdefault('DA4ML_JAX_DEBUG', '1')
+
+    import jax
+
+    jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    print(f'backend: {jax.default_backend()}, devices: {jax.devices()}', flush=True)
+
+    rng = np.random.default_rng(20260731)
+
+    # 1) Mosaic smoke: one tiny fused solve (small class, fast compile)
+    t0 = time.perf_counter()
+    tiny = _mk(rng, 6, 3, 2)
+    sols = _solve(tiny, 'fused')
+    for k, s in zip(tiny, sols):
+        if not np.array_equal(np.asarray(s.kernel, np.float64), k):
+            raise SystemExit('FAIL: fused exactness failed on hardware')
+    print(f'[1] fused Mosaic smoke: OK ({time.perf_counter() - t0:.1f}s incl. compile)', flush=True)
+
+    # 2) identity spot check vs top4
+    ks = _mk(rng, 12, 4, 4) + _mk(rng, 8, 6, 2)
+    st = _solve(ks, 'top4')
+    sf = _solve(ks, 'fused')
+    n_id = 0
+    for a, b in zip(st, sf):
+        ops_a = [[(o.id0, o.id1, o.opcode, o.data) for o in stg.ops] for stg in a.stages]
+        ops_b = [[(o.id0, o.id1, o.opcode, o.data) for o in stg.ops] for stg in b.stages]
+        n_id += ops_a == ops_b
+    print(f'[2] decision identity fused vs top4 on hardware: {n_id}/{len(ks)}', flush=True)
+    if n_id != len(ks):
+        raise SystemExit('FAIL: fused diverged from top4 on hardware')
+
+    # 3) config-1 head-to-head; the warm pass uses the FULL batch so the
+    # timed pass hits the exact compiled (bucketed) program
+    k1 = _mk(rng, 16, 4, n)
+    rates = {}
+    for mode in ('top4', 'fused'):
+        _solve(k1, mode)  # compile pass at the real lane bucket
+        t0 = time.perf_counter()
+        sols = _solve(k1, mode)
+        dt = time.perf_counter() - t0
+        rates[mode] = n / dt
+        cost = float(np.mean([s.cost for s in sols]))
+        print(f'[3] {mode}: {n / dt:.1f} matrices/s (mean cost {cost:.1f})', flush=True)
+    print(
+        f'[4] fused/top4 rate ratio: {rates["fused"] / rates["top4"]:.2f}x '
+        f'(per-iteration body time scales inversely)',
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())
