@@ -36,6 +36,7 @@ the single-kernel TPU mapping is original.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 
 import jax
@@ -84,9 +85,21 @@ def fused_feasible(P: int, O: int, B: int, K: int) -> bool:
 
 
 def _pick_L(P: int, O: int, B: int, K: int) -> int:
+    """Lanes per grid block: largest power of two whose working set fits the
+    VMEM budget (throughput scales ~linearly with L until the VPU saturates,
+    since the per-iteration instruction count is L-independent). Env
+    DA4ML_FUSED_L pins it for on-chip tuning."""
+    try:
+        env = int(os.environ.get('DA4ML_FUSED_L', '0') or 0)
+    except ValueError:
+        env = 0
+    if env > 0:
+        # honored verbatim (any L works — the runner pads the lane count to a
+        # multiple); the operator owns the VMEM budget when pinning
+        return env
     per = _per_lane_vmem(P, O, B, K)
     L = 1
-    while L < 8 and (2 * L) * per <= _VMEM_BUDGET:
+    while L < 32 and (2 * L) * per <= _VMEM_BUDGET:
         L *= 2
     return L
 

@@ -1157,14 +1157,33 @@ def solve_single_lanes(
                     import time as _time
 
                     _t0 = _time.perf_counter()
-                oE, oq, ol, o_rec, ocur = fn(*args)
-                # one tree fetch (not one device_get per output): the remote
-                # tunnel charges a round trip per call, so cur/records/digits
-                # come back together. qmeta/lat are only needed for lanes
-                # that resume at a larger P (finished lanes' metadata is
-                # re-derived on host in f64 from the records) — a second
-                # fetch only in that (rare) case.
-                h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                try:
+                    oE, oq, ol, o_rec, ocur = fn(*args)
+                    # one tree fetch (not one device_get per output): the
+                    # remote tunnel charges a round trip per call, so
+                    # cur/records/digits come back together. qmeta/lat are
+                    # only needed for lanes that resume at a larger P
+                    # (finished lanes' metadata is re-derived on host in f64
+                    # from the records) — a second fetch only in that case.
+                    h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                except Exception as e:
+                    if select != 'fused':
+                        raise
+                    # Mosaic compile / runtime failure of the fused kernel
+                    # (interpret mode passes where TPU tiling constraints can
+                    # bite): retry THIS chunk on the XLA top4 program of the
+                    # SAME shape class — identical P/R_in/topk means the
+                    # already-packed arguments fit unchanged and decisions
+                    # are identical — and disable fused for the process.
+                    import dataclasses
+                    import warnings
+
+                    _mark_fused_broken(e)
+                    warnings.warn(f'fused CSE kernel failed ({type(e).__name__}); using the XLA top4 loop: {e}')
+                    select = 'top4'
+                    fn = _build_cse_fn(dataclasses.replace(spec, select='top4'))
+                    oE, oq, ol, o_rec, ocur = fn(*args)
+                    h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
                 cur_f = np.asarray(h_cur)[:n_chunk]
                 if debug:
                     print(
@@ -1320,6 +1339,16 @@ def _prewarm_class(spec: _KernelSpec, bucket: int) -> None:
         pass
 
 
+#: set when the fused pallas kernel fails to compile/run on this platform;
+#: all later rungs route to top4 (per process — a wedged compile is sticky)
+_FUSED_BROKEN: list = []
+
+
+def _mark_fused_broken(err: Exception) -> None:
+    if not _FUSED_BROKEN:
+        _FUSED_BROKEN.append(f'{type(err).__name__}: {err}'[:300])
+
+
 def _resolve_rung_class(
     P: int, O: int, B: int, adder_size: int, carry_size: int, select: str, pmax: int, rows_cap: int
 ) -> _KernelSpec:
@@ -1327,6 +1356,8 @@ def _resolve_rung_class(
     source of truth shared by the live rung loop and both prewarm
     estimators, so the speculative compile always targets the class the
     real rung will use."""
+    if select == 'fused' and _FUSED_BROKEN:
+        select = 'top4'
     topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
     if select == 'fused':
         from .fused_cse import fused_feasible

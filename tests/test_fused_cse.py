@@ -66,3 +66,38 @@ def test_fused_identity_methods_and_budget(rng, monkeypatch):
     fused = _solve_with(monkeypatch, 'fused', kernels, **kw)
     np.testing.assert_array_equal(np.asarray(fused[0].kernel, np.float64), kernels[0])
     assert ops_sig(top4[0]) == ops_sig(fused[0])
+
+
+def test_fused_runtime_fallback(rng, monkeypatch):
+    """A fused kernel that fails at run time (the Mosaic-only failure mode)
+    falls back to the XLA top4 program of the same shape class, warns once,
+    and disables fused for the rest of the process."""
+    from da4ml_tpu.cmvm import fused_cse
+    from da4ml_tpu.cmvm import jax_search as js
+
+    def boom_runner(spec, init_cache):
+        def run(*args):
+            raise RuntimeError('synthetic mosaic failure')
+
+        return run
+
+    monkeypatch.setattr(fused_cse, 'build_fused_runner', boom_runner)
+    monkeypatch.setenv('DA4ML_JAX_SELECT', 'fused')
+    js._build_cse_fn.cache_clear()
+    js._FUSED_BROKEN.clear()
+    try:
+        kernels = [random_kernel(rng, 8, 4)]
+        with pytest.warns(UserWarning, match='fused CSE kernel failed'):
+            sols = solve_jax_many(kernels)
+        np.testing.assert_array_equal(np.asarray(sols[0].kernel, np.float64), kernels[0])
+        assert js._FUSED_BROKEN, 'failure must latch the process-wide fused kill switch'
+        # later solves route straight to top4 with no further warnings
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter('error')
+            sols2 = solve_jax_many(kernels)
+        np.testing.assert_array_equal(np.asarray(sols2[0].kernel, np.float64), kernels[0])
+    finally:
+        js._FUSED_BROKEN.clear()
+        js._build_cse_fn.cache_clear()

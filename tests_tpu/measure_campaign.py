@@ -79,6 +79,10 @@ def main() -> int:
             print('ladder failed — stopping (chip unhealthy)')
             return 1
 
+    # fused-kernel first: Mosaic smoke + identity + head-to-head rate (the
+    # round-4 lever); its outcome decides whether to flip the default select
+    results.append(run('fused_profile', [sys.executable, 'tests_tpu/profile_fused.py', '64'], 1500))
+
     results.append(run('bench_full', [sys.executable, 'bench.py', '64'], 900, {'DA4ML_BENCH_BUDGET_S': '560'}))
     # refresh the committed snapshot when the live run was on a real TPU
     for ln in reversed(results[-1]['tail']):

@@ -1,0 +1,86 @@
+"""Large-P (>=512 slot) quality evidence (manual tool).
+
+Runs on TPU when the plugin is active, or on CPU XLA (decision-equivalent;
+set JAX_PLATFORMS=cpu) when the tunnel is down.
+
+Compares the default deep-cache top4 (K=16 above P=256) against the
+decision-identical full-rescan 'xla' reference and against the host solver
+on kernels whose slot demand lands in the P=512 class, quantifying the
+cache's identity-vs-cost tradeoff (VERDICT r3 item 8).
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    # the axon plugin ignores the env var; pin via config before backend init
+    import jax
+
+    jax.config.update('jax_platforms', 'cpu')
+
+from da4ml_tpu.cmvm import solve as host_solve
+from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+
+def _solve(kernels, select):
+    os.environ['DA4ML_JAX_SELECT'] = select
+    try:
+        return solve_jax_many(kernels)
+    finally:
+        os.environ.pop('DA4ML_JAX_SELECT', None)
+
+
+def ops_sig(p):
+    return [[(o.id0, o.id1, o.opcode, o.data) for o in st.ops] for st in p.stages]
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    rng = np.random.default_rng(512)
+    kernels = []
+    for _ in range(n):
+        d = int(rng.integers(80, 129))
+        b = int(rng.integers(5, 8))
+        kernels.append((rng.integers(0, 2**b, (d, d)) * rng.choice([-1.0, 1.0], (d, d))).astype(np.float64))
+
+    t0 = time.perf_counter()
+    sols_t = _solve(kernels, 'top4')
+    t_top4 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sols_x = _solve(kernels, 'xla')
+    t_xla = time.perf_counter() - t0
+    host = [host_solve(k, backend='auto') for k in kernels]
+
+    ct = np.array([s.cost for s in sols_t])
+    cx = np.array([s.cost for s in sols_x])
+    ch = np.array([s.cost for s in host])
+    ident = sum(ops_sig(a) == ops_sig(b) for a, b in zip(sols_t, sols_x))
+    for k, s in zip(kernels, sols_t):
+        assert np.array_equal(np.asarray(s.kernel, np.float64), k)
+    out = {
+        'n_kernels': n,
+        'dims': [int(k.shape[0]) for k in kernels],
+        'slot_class': 'P=512 rung (deep cache K=16)',
+        'ops_identical_top4_vs_rescan': f'{ident}/{n}',
+        'cost_top4': ct.tolist(),
+        'cost_rescan': cx.tolist(),
+        'cost_host': ch.tolist(),
+        'mean_delta_top4_vs_rescan_pct': round(float((ct - cx).sum() / cx.sum()) * 100, 3),
+        'mean_delta_top4_vs_host_pct': round(float((ct - ch).sum() / ch.sum()) * 100, 3),
+        'win_or_tie_vs_host': f'{int((ct <= ch).sum())}/{n}',
+        'platform': 'cpu-xla (decision-equivalent to tpu)',
+        'wall_top4_s': round(t_top4, 1),
+        'wall_rescan_s': round(t_xla, 1),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == '__main__':
+    main()
