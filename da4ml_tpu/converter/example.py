@@ -1,10 +1,11 @@
 """Built-in example model + plugin — the template third parties follow.
 
-Mirrors the behavior of the reference example (reference
-src/da4ml/converter/example.py): a small numpy-defined model exercising
-quantize / relu / slicing / a sin lookup table / matmul / einsum, plus the
-plugin that traces it. The same ``operation`` runs both eagerly on numpy
-arrays (the golden path) and symbolically on FixedVariableArrays.
+Fills the same role as the reference's example plugin (reference
+src/da4ml/converter/example.py) but demonstrates a different computation: a
+tiny gated-residual block exercising quantize / relu / slicing / a tanh
+lookup table / an elementwise variable product / matmul / einsum. The same
+``operation`` runs both eagerly on numpy arrays (the golden path) and
+symbolically on FixedVariableArrays.
 """
 
 from __future__ import annotations
@@ -17,19 +18,27 @@ from .plugin import TracerPluginBase
 
 
 def operation(inp):
-    """Example computation, traceable and numpy-executable alike."""
-    w = np.arange(-60, 60).reshape(4, 5, 6).astype(np.float64) / 2**7
-    inp = quantize(inp, 1, 7, 0)  # inputs must be quantized before use
-    out1 = relu(inp)
+    """Example computation, traceable and numpy-executable alike.
 
-    out2 = inp[:, 1:3].transpose()
-    out2 = quantize(np.sin(out2), 1, 0, 7, 'SAT', 'RND')
-    out2 = np.repeat(out2, 2, axis=0) * 3 + 4
-    out2 = np.amax(np.stack([out2, -out2 * 2], axis=0), axis=0)
+    A gated-residual block on a (4, 5) input: the first two rows drive a
+    tanh gate, the last two rows go through a CMVM mixing matrix; the gated
+    product and the mixed features are concatenated and contracted with a
+    per-row head tensor.
+    """
+    # Deterministic pseudo-random fixed-point weights (exact on a 2^-6 grid).
+    w_mix = ((np.arange(35) * 13 + 5) % 29 - 14).reshape(5, 7).astype(np.float64) / 2**6
+    w_head = ((np.arange(96) * 7 % 41) - 20).reshape(2, 12, 4).astype(np.float64) / 2**5
 
-    out3 = quantize(out2 @ out1, 1, 10, 2)
-    out = einsum('ijk,ij->ik', w, out3)  # CMVM-optimized contraction
-    return out
+    x = quantize(inp, 1, 5, 2)  # inputs must be quantized before use
+    head, tail = x[:2], x[2:]
+
+    gate = quantize(np.tanh(head), 1, 0, 6, 'SAT_SYM', 'RND')
+    mixed = quantize(tail @ w_mix, 1, 9, 3)  # CMVM-optimized matmul
+    gated = quantize(gate * tail, 1, 6, 4)  # elementwise variable product
+    resid = relu(np.abs(mixed) - 1)
+
+    feats = np.concatenate([gated, resid], axis=1)  # (2, 12)
+    return einsum('ki,kio->ko', feats, w_head)  # CMVM-optimized contraction
 
 
 class ExampleModel:

@@ -123,6 +123,7 @@ CASES = {
     'square': (lambda x: x * x, None),
     'where': (lambda x: np.where(x[:4] > 0, x[:4], x[4:]), lambda x: np.where(x[:4] > 0, x[:4], x[4:])),
     'clip': (lambda x: np.clip(x, -1.0, 1.0), None),
+    'matmul_var': (lambda x: x[:4].reshape(2, 2) @ x[4:].reshape(2, 2), None),
     'matmul_int': (lambda x: x @ np.arange(-2 * N, 2 * N).reshape(N, 4), None),
     'matmul_frac': (lambda x: x @ (np.arange(-2 * N, 2 * N).reshape(N, 4) * 0.25), None),
     'einsum': (lambda x: np.einsum('i,ij->j', x, np.arange(N * 3).reshape(N, 3) * 1.0), None),
