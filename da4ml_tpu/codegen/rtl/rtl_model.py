@@ -402,7 +402,9 @@ clean:
         return out
 
     def predict(self, data: NDArray, backend: str = 'auto', n_threads: int = 0) -> NDArray[np.float64]:
-        """Bit-exact inference: 'emu' (Verilator .so), 'interp' (DAIS), 'auto'."""
+        """Bit-exact inference: 'emu' (Verilator .so), 'interp' (DAIS),
+        'netlist' (execute the emitted HDL in the bundled simulator — the
+        clocked pipelined top for pipelines), or 'auto'."""
         data = np.asarray(data, dtype=np.float64).reshape(len(data), -1)
         if backend == 'auto':
             try:
@@ -412,6 +414,18 @@ clean:
                 backend = 'interp'
         if backend == 'interp':
             return self.solution.predict(data)
+        if backend == 'netlist':
+            if self.flavor == 'verilog':
+                from .verilog.netlist_sim import simulate_comb, simulate_pipeline
+
+                if self.is_pipeline:
+                    return simulate_pipeline(self.solution, self.name, data, self.register_layers)
+                return simulate_comb(self.solution, self.name, data)
+            from .vhdl.netlist_sim import simulate_comb_vhdl, simulate_pipeline_vhdl
+
+            if self.is_pipeline:
+                return simulate_pipeline_vhdl(self.solution, self.name, data, self.register_layers)
+            return simulate_comb_vhdl(self.solution, self.name, data)
         lib = self._load_lib()
         codes = np.ascontiguousarray(self._to_codes(data))
         out = np.empty((len(data), len(self.solution.out_qint)), dtype=np.int64)

@@ -253,23 +253,13 @@ class VerilogNetlistSim:
         env[inst.ports['o']] = r & _mask(p['WO'])
 
 
-def run_netlist(em, sim, comb, data: NDArray) -> NDArray[np.float64]:
-    """Pack samples into wrapper bit lanes, run `sim`, descale the outputs.
-
-    Shared by the Verilog and VHDL flavors; the returned values use the same
-    output interpretation as ``CombLogic.predict``, so results are directly
-    comparable.
-    """
+def pack_inputs(in_lay, comb, data: NDArray) -> list[int]:
+    """Pack float samples into the wrapper's input bit lanes."""
     from ....ir.types import minimal_kif
 
-    data = np.asarray(data, dtype=np.float64)
-    in_lay = em.input_layout()
-    out_lay = em.output_layout()
     inp_kifs = [minimal_kif(q) for q in comb.inp_qint]
-    out_kifs = [minimal_kif(q) for q in comb.out_qint]
-
-    out = np.zeros((len(data), comb.shape[1]), dtype=np.float64)
-    for s, row in enumerate(data):
+    packed: list[int] = []
+    for row in np.asarray(data, dtype=np.float64):
         bits = 0
         for e, (off, w) in enumerate(in_lay):
             if w == 0:
@@ -277,7 +267,17 @@ def run_netlist(em, sim, comb, data: NDArray) -> NDArray[np.float64]:
             k, i, f = inp_kifs[e]
             v = int(np.floor(row[e] * 2.0 ** (f + int(comb.inp_shifts[e]))))
             bits |= (v & _mask(w)) << off
-        out_bits = sim.run_sample(bits)
+        packed.append(bits)
+    return packed
+
+
+def descale_outputs(out_lay, comb, out_bits_seq) -> NDArray[np.float64]:
+    """Unpack raw output bits into floats, same interpretation as predict."""
+    from ....ir.types import minimal_kif
+
+    out_kifs = [minimal_kif(q) for q in comb.out_qint]
+    out = np.zeros((len(out_bits_seq), comb.shape[1]), dtype=np.float64)
+    for s, out_bits in enumerate(out_bits_seq):
         for e, (off, w) in enumerate(out_lay):
             if w == 0:
                 continue
@@ -285,6 +285,150 @@ def run_netlist(em, sim, comb, data: NDArray) -> NDArray[np.float64]:
             raw = (out_bits >> off) & _mask(w)
             out[s, e] = float(_sext(raw, w) if k else raw) * 2.0**-f
     return out
+
+
+def run_netlist(em, sim, comb, data: NDArray) -> NDArray[np.float64]:
+    """Pack samples into wrapper bit lanes, run `sim`, descale the outputs.
+
+    Shared by the Verilog and VHDL flavors; the returned values use the same
+    output interpretation as ``CombLogic.predict``, so results are directly
+    comparable.
+    """
+    packed = pack_inputs(em.input_layout(), comb, data)
+    out_bits = [sim.run_sample(bits) for bits in packed]
+    return descale_outputs(em.output_layout(), comb, out_bits)
+
+
+class PipelineNetlistSim:
+    """Clock-accurate simulator for the emitted II=1 pipelined top module.
+
+    Executes the registered *top-module text* — stage instances evaluate
+    through the per-stage netlist simulators, and the `always @(posedge clk)`
+    (resp. ``rising_edge(clk)``) registers latch with nonblocking semantics.
+    One new sample is fed every clock (II=1) and outputs are read after the
+    pipeline's register latency, mirroring the clocked `_inference` loop of
+    the reference's Verilator binder (reference
+    codegen/rtl/common_source/binder_util.hh:11-40).
+
+    The parsed structure is flavor-agnostic: subclasses fill ``aliases``
+    (continuous lhs = src), ``insts`` [(stage_sim, in_wire, out_wire)],
+    ``regs`` {reg: src}, and ``out_src``.
+    """
+
+    aliases: list[tuple[str, str]]
+    insts: list[tuple[VerilogNetlistSim, str, str]]
+    regs: dict[str, str]
+    out_src: str
+    in_width: int
+    out_width: int
+
+    @property
+    def latency_ticks(self) -> int:
+        """Clock cycles from a sample entering to its result on `out`."""
+        return len(self.regs)
+
+    def _settle(self, env: dict[str, int]) -> None:
+        pending = [('alias', a) for a in self.aliases] + [('inst', i) for i in self.insts]
+        for _ in range(len(pending) + 2):
+            if not pending:
+                return
+            nxt = []
+            for kind, item in pending:
+                try:
+                    if kind == 'alias':
+                        lhs, src = item
+                        env[lhs] = env[src]
+                    else:
+                        sim, iw, ow = item
+                        env[ow] = sim.run_sample(env[iw])
+                except KeyError:
+                    nxt.append((kind, item))
+            pending = nxt
+        if pending:
+            raise RuntimeError(f'Unresolved top-module elements: {pending[:3]}')
+
+    def run_stream(self, samples: list[int]) -> list[int]:
+        """Feed one sample per rising edge; return one output per sample."""
+        regs = dict.fromkeys(self.regs, 0)
+        lat = self.latency_ticks
+        outs: list[int] = []
+        for t in range(len(samples) + lat):
+            env = dict(regs)
+            env['inp'] = (samples[t] & _mask(self.in_width)) if t < len(samples) else 0
+            self._settle(env)
+            if t >= lat:
+                outs.append(env[self.out_src] & _mask(self.out_width))
+            # nonblocking: every register samples its source from this cycle
+            regs = {r: env[src] for r, src in self.regs.items()}
+        return outs
+
+
+_RE_TOP_ALIAS = re.compile(r'wire\s+\[(\d+):0\]\s+(\w+)\s*=\s*(\w+);')
+_RE_TOP_DECL = re.compile(r'(?:wire|reg)\s+\[(\d+):0\]\s+(\w+);')
+_RE_TOP_FF = re.compile(r'always\s*@\(posedge clk\)\s+(\w+)\s*<=\s*(\w+);')
+_RE_TOP_INST = re.compile(r'(\w+)\s+(\w+)\s*\(\s*\.inp\((\w+)\),\s*\.out\((\w+)\)\s*\);')
+_RE_TOP_OUT = re.compile(r'assign\s+out\s*=\s*(\w+);')
+
+
+class VerilogPipelineSim(PipelineNetlistSim):
+    """Parse + simulate the Verilog pipelined top emitted by emit_pipeline."""
+
+    def __init__(self, top_text: str, stage_texts: list[str], mem_files: dict[str, str]):
+        stage_sims: dict[str, VerilogNetlistSim] = {}
+        for t in stage_texts:
+            mname = re.search(r'module\s+(\w+)', t).group(1)
+            stage_sims[mname] = VerilogNetlistSim(t, mem_files)
+
+        self.aliases, self.insts, self.regs = [], [], {}
+        self.out_src = ''
+        m = re.search(r'input\s+\[(\d+):0\]\s+inp', top_text)
+        self.in_width = int(m.group(1)) + 1 if m else 0
+        m = re.search(r'output\s+\[(\d+):0\]\s+out', top_text)
+        self.out_width = int(m.group(1)) + 1 if m else 0
+
+        body = top_text[top_text.index(');') + 2 :]
+        for raw in body.splitlines():
+            line = raw.split('//')[0].strip()
+            if not line or line == 'endmodule':
+                continue
+            if m := _RE_TOP_ALIAS.match(line):
+                self.aliases.append((m.group(2), m.group(3)))
+            elif _RE_TOP_DECL.match(line):
+                pass  # width declaration only
+            elif m := _RE_TOP_FF.match(line):
+                self.regs[m.group(1)] = m.group(2)
+            elif m := _RE_TOP_INST.match(line):
+                self.insts.append((stage_sims[m.group(1)], m.group(3), m.group(4)))
+            elif m := _RE_TOP_OUT.match(line):
+                self.out_src = m.group(1)
+            else:
+                raise ValueError(f'Unparsed top-module line: {line}')
+        if not self.out_src:
+            raise ValueError('pipelined top has no `assign out = ...`')
+
+
+def run_pipeline_netlist(em_in, em_out, sim, pipeline, data: NDArray) -> NDArray[np.float64]:
+    """Pack `data`, stream it through the clocked top `sim`, descale.
+
+    Shared by the Verilog and VHDL flavors (the streaming analog of
+    ``run_netlist``). Returns floats with the same interpretation as
+    ``Pipeline``-replay / ``CombLogic.predict``.
+    """
+    packed = pack_inputs(em_in.input_layout(), pipeline, data)
+    out_bits = sim.run_stream(packed)
+    return descale_outputs(em_out.output_layout(), pipeline, out_bits)
+
+
+def simulate_pipeline(pipeline, name: str = 'sim', data: NDArray | None = None, register_layers: int = 1) -> NDArray[np.float64]:
+    """Emit `pipeline` to Verilog and stream `data` through the clocked top."""
+    from .comb import VerilogCombEmitter
+    from .pipeline import emit_pipeline
+
+    top, mem_files, stage_texts = emit_pipeline(pipeline, name, register_layers=register_layers)
+    sim = VerilogPipelineSim(top, stage_texts, mem_files)
+    em_in = VerilogCombEmitter(pipeline.stages[0], f'{name}_s0')
+    em_out = VerilogCombEmitter(pipeline.stages[-1], f'{name}_s{len(pipeline.stages) - 1}')
+    return run_pipeline_netlist(em_in, em_out, sim, pipeline, data)
 
 
 def simulate_comb(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:

@@ -13,7 +13,7 @@ import re
 import numpy as np
 from numpy.typing import NDArray
 
-from ..verilog.netlist_sim import VerilogNetlistSim, _Instance, _mask, _sext, _shr
+from ..verilog.netlist_sim import PipelineNetlistSim, VerilogNetlistSim, _Instance, _mask, _sext, _shr
 
 _RE_SIG = re.compile(r'signal\s+(\w+)\s*:\s*(std_logic_vector|signed|unsigned)\((\d+)\s+downto\s+0\);')
 _RE_ASSIGN = re.compile(r'(\w+)(?:\((\d+)\s+downto\s+(\d+)\))?\s*<=\s*(.+?);')
@@ -118,3 +118,57 @@ def simulate_comb_vhdl(comb, name: str = 'sim', data: NDArray | None = None) -> 
     em = VHDLCombEmitter(comb, name)
     sim = VHDLNetlistSim(em.emit(), em.mem_files)
     return run_netlist(em, sim, comb, data)
+
+
+_RE_VTOP_SIG = re.compile(r'signal\s+(\w+)\s*:\s*std_logic_vector\((\d+)\s+downto\s+0\);')
+_RE_VTOP_INST = re.compile(r'\w+\s*:\s*entity\s+work\.(\w+)\s+port map\s*\(inp\s*=>\s*(\w+),\s*out_port\s*=>\s*(\w+)\);')
+_RE_VTOP_FF = re.compile(r'process\s*\(clk\)\s*begin\s*if\s*rising_edge\(clk\)\s*then\s*(\w+)\s*<=\s*(\w+);\s*end if;\s*end process;')
+_RE_VTOP_OUT = re.compile(r'out_port\s*<=\s*(\w+);')
+
+
+class VHDLPipelineSim(PipelineNetlistSim):
+    """Parse + simulate the VHDL pipelined top emitted by emit_pipeline_vhdl."""
+
+    def __init__(self, top_text: str, stage_texts: list[str], mem_files: dict[str, str]):
+        stage_sims: dict[str, VHDLNetlistSim] = {}
+        for t in stage_texts:
+            ename = re.search(r'entity\s+(\w+)\s+is', t).group(1)
+            stage_sims[ename] = VHDLNetlistSim(t, mem_files)
+
+        self.aliases, self.insts, self.regs = [], [], {}
+        self.out_src = ''
+        m = re.search(r'inp : in std_logic_vector\((\d+) downto 0\)', top_text)
+        self.in_width = int(m.group(1)) + 1 if m else 0
+        m = re.search(r'out_port : out std_logic_vector\((\d+) downto 0\)', top_text)
+        self.out_width = int(m.group(1)) + 1 if m else 0
+
+        body = top_text[top_text.index('architecture') :]
+        for raw in body.splitlines():
+            line = raw.split('--')[0].strip()
+            if not line or line in ('begin', 'end architecture;') or line.startswith('architecture'):
+                continue
+            if m := _RE_VTOP_SIG.match(line):
+                pass  # width declaration only
+            elif m := _RE_VTOP_FF.match(line):
+                self.regs[m.group(1)] = m.group(2)
+            elif m := _RE_VTOP_INST.match(line):
+                self.insts.append((stage_sims[m.group(1)], m.group(2), m.group(3)))
+            elif m := _RE_VTOP_OUT.match(line):
+                self.out_src = m.group(1)
+            else:
+                raise ValueError(f'Unparsed VHDL top line: {line}')
+        if not self.out_src:
+            raise ValueError('pipelined top has no `out_port <= ...`')
+
+
+def simulate_pipeline_vhdl(pipeline, name: str = 'sim', data: NDArray | None = None, register_layers: int = 1) -> NDArray[np.float64]:
+    """Emit `pipeline` to VHDL and stream `data` through the clocked top."""
+    from ..verilog.netlist_sim import run_pipeline_netlist
+    from .comb import VHDLCombEmitter
+    from .pipeline import emit_pipeline_vhdl
+
+    top, mem_files, stage_texts = emit_pipeline_vhdl(pipeline, name, register_layers=register_layers)
+    sim = VHDLPipelineSim(top, stage_texts, mem_files)
+    em_in = VHDLCombEmitter(pipeline.stages[0], f'{name}_s0')
+    em_out = VHDLCombEmitter(pipeline.stages[-1], f'{name}_s{len(pipeline.stages) - 1}')
+    return run_pipeline_netlist(em_in, em_out, sim, pipeline, data)

@@ -51,6 +51,19 @@ def test_vhdl_solver_pipeline():
     np.testing.assert_array_equal(cur, x @ kernel)
 
 
+@pytest.mark.parametrize('cutoff,register_layers', [(1.0, 1), (2.0, 2)])
+def test_vhdl_pipelined_top_exact(cutoff, register_layers):
+    """The registered VHDL top, executed clock-by-clock, == interpreter."""
+    from da4ml_tpu.codegen.rtl.vhdl.netlist_sim import simulate_pipeline_vhdl
+
+    comb = _trace(CASES['matmul_int'][0])
+    pipe = to_pipeline(comb, cutoff)
+    assert len(pipe.stages) > 1
+    golden = comb.predict(DATA, backend='numpy')
+    got = simulate_pipeline_vhdl(pipe, data=DATA, register_layers=register_layers)
+    np.testing.assert_array_equal(got, golden)
+
+
 def test_vhdl_project_write(tmp_path):
     comb = _trace(CASES['matmul_int'][0])
     pipe = to_pipeline(comb, 2.0)
@@ -62,6 +75,7 @@ def test_vhdl_project_write(tmp_path):
     assert (src / 'shift_adder.vhd').exists()
     assert 'ghdl' in (tmp_path / 'binder' / 'Makefile').read_text().lower()
     np.testing.assert_array_equal(model.predict(DATA, backend='interp'), comb.predict(DATA, backend='numpy'))
+    np.testing.assert_array_equal(model.predict(DATA, backend='netlist'), comb.predict(DATA, backend='numpy'))
 
 
 @pytest.mark.skipif(not VHDLModel.emulation_available(), reason='verilator/ghdl not installed')
