@@ -41,4 +41,19 @@ __all__ = [
     'kernel_decompose',
     'prim_mst_dc',
     'solver_options_t',
+    'solve_jax',
+    'solve_jax_many',
+    'prewarm_for_kernels',
 ]
+
+_LAZY_JAX = ('solve_jax', 'solve_jax_many', 'prewarm_for_kernels')
+
+
+def __getattr__(name: str):
+    """Lazy re-exports of the device-search surface — importing the package
+    must not pull in jax (host-only users, import-time cost)."""
+    if name in _LAZY_JAX:
+        from . import jax_search
+
+        return getattr(jax_search, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

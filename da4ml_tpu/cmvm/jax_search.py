@@ -1380,7 +1380,8 @@ def _first_rung_spec(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=
     use for these lanes — a mirror of the rung-entry calculation there, used
     only to pre-warm compiles; a drifted estimate wastes one background
     compile and can never change results. Returns None when nothing routes
-    to the device."""
+    to the device. Repeated lane references (restart copies) share one CSD
+    decomposition while counting toward the bucket."""
 
     def _ceil_to(x: int, q: int) -> int:
         return -(-x // q) * q
@@ -1405,6 +1406,104 @@ def _first_rung_spec(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=
         P = pmax
     spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, n_in_max)
     return spec, _bucket_lanes(len(active), mesh)
+
+
+def prewarm_for_kernels(
+    kernel_groups: list[list[NDArray]],
+    method0: str = 'wmc',
+    method1: str = 'auto',
+    hard_dc: int = -1,
+    decompose_dc: int = -2,
+    adder_size: int = -1,
+    carry_size: int = -1,
+    search_all_decompose_dc: bool = True,
+    method0_candidates: list[str] | None = None,
+    n_restarts: int = 1,
+    mesh=None,
+    **_ignored,
+) -> int:
+    """Model-level background prewarm: AOT-compile every device shape class a
+    later ``solve_jax_many`` over these kernel groups will hit.
+
+    ``kernel_groups`` holds one list of constant matrices per future solve
+    call — e.g. one group per model layer, with a conv layer's im2col blocks
+    forming one group (the grouping determines the class dims exactly as the
+    real batched call will). Both search stages' first rung classes compile
+    on the background prewarm thread, concurrently with whatever the device
+    is doing, so a cold model conversion stops paying one serial
+    trace+compile per layer class. Estimates mirror the solve path's lane
+    construction; the specs depend only on CSD shapes, so default
+    qintervals/latencies in the probes are exact. A drifted estimate wastes
+    one background compile and can never change results.
+
+    Returns the number of background jobs queued (0 when prewarming is
+    disabled on this platform; force with ``DA4ML_JAX_PREWARM=1``). Unknown
+    solver options are ignored so callers can forward ``solver_options``
+    wholesale.
+    """
+    if not _prewarm_enabled():
+        return 0
+    groups = [[np.ascontiguousarray(np.asarray(k, np.float64)) for k in g] for g in kernel_groups if g]
+    groups = [g for g in groups if all(k.ndim == 2 and k.size for k in g)]
+    if not groups:
+        return 0
+    _hard_eff = 10**9 if (search_all_decompose_dc and hard_dc < 0) else hard_dc
+    mpairs = list(dict.fromkeys(_resolve_methods(mc, method1, _hard_eff) for mc in (method0_candidates or [method0])))
+    n_restarts = max(1, int(n_restarts))
+
+    def _job():
+        from .decompose import kernel_decompose
+
+        for kernels in groups:
+            jobs: list[tuple[int, int, int]] = []
+            for mi, kern in enumerate(kernels):
+                n_in = kern.shape[0]
+                log2_n = int(ceil(log2(max(n_in, 1))))
+                if search_all_decompose_dc:
+                    _hard = hard_dc if hard_dc >= 0 else 10**9
+                    dcs = list(range(-1, min(_hard, log2_n) + 1))
+                else:
+                    dc = min(hard_dc, log2_n, decompose_dc) if decompose_dc != -2 else min(hard_dc, log2_n)
+                    dcs = list(range(dc, -2, -1)) if hard_dc >= 0 else [dc]
+                jobs.extend((mi, dc, mp) for dc in dcs for mp in range(len(mpairs)))
+            uniq_md: dict[tuple[int, int], int] = {}
+            for mi, dc, _ in jobs:
+                uniq_md.setdefault((mi, dc), len(uniq_md))
+            if _native_emit_available():
+                from ..native.bindings import decompose_batch
+
+                splits_u = decompose_batch([kernels[mi] for mi, dc in uniq_md], [dc for _, dc in uniq_md])
+            else:
+                splits_u = [kernel_decompose(kernels[mi], dc) for mi, dc in uniq_md]
+            lanes0: list[_Lane] = []
+            lanes1: list[_Lane] = []
+            def _probe(mat, meth, dc):
+                return _Lane(
+                    mat,
+                    [QInterval(-128.0, 127.0, 1.0)] * mat.shape[0],
+                    [0.0] * mat.shape[0],
+                    _lane_method(meth, dc, _hard_eff),
+                )
+
+            for mi, dc, mp in jobs:
+                mat0, mat1 = splits_u[uniq_md[(mi, dc)]]
+                p0 = _probe(mat0, mpairs[mp][0], dc)
+                p1 = _probe(mat1, mpairs[mp][1], dc)
+                # mirror the solve's restart expansion exactly: dummy
+                # stage-0 lanes get no restart copies, and each restart of a
+                # non-dummy job adds one lane to BOTH stages. Repeated
+                # references share one CSD decomposition while counting
+                # toward the lane bucket.
+                copies = n_restarts if p0.method != 'dummy' else 1
+                lanes0.extend([p0] * copies)
+                lanes1.extend([p1] * copies)
+            for lanes in (lanes0, lanes1):
+                got = _first_rung_spec(lanes, adder_size, carry_size, mesh)
+                if got is not None:
+                    _prewarm_class(*got)
+
+    _prewarm_submit(_job)
+    return 1
 
 
 _FUSED_SHARDED_CACHE: dict[tuple, object] = {}

@@ -151,6 +151,45 @@ class KerasTracer(TracerPluginBase):
             return None
         return shapes or None
 
+    def prewarm_kernel_groups(self):
+        """One weight-matrix group per CMVM-bearing layer, mirroring how the
+        trace handlers shape each layer's solve call (Dense: one matrix;
+        Conv: the im2col matrix; Depthwise: one small matrix per channel),
+        so the background prewarm compiles exactly the classes the real
+        layer-by-layer flow will request. Best-effort — unreadable layers
+        are skipped."""
+        groups: list[list[np.ndarray]] = []
+        try:
+            layers = list(self.model.layers)
+        except Exception:
+            return None
+        for layer in layers:
+            try:
+                name = _QUANTIZED_BASE.get(type(layer).__name__, type(layer).__name__)
+                if name == 'Dense':
+                    w = _quantized_weight(layer, 'kernel', ('kernel_quantizer_internal', 'kernel_quantizer'))
+                    groups.append([w])
+                elif name in ('Conv1D', 'Conv2D'):
+                    k = _quantized_weight(layer, 'kernel', ('kernel_quantizer_internal', 'kernel_quantizer'))
+                    groups.append([k.reshape(-1, k.shape[-1])])
+                elif name in ('DepthwiseConv1D', 'DepthwiseConv2D', 'SeparableConv1D', 'SeparableConv2D'):
+                    dk_attr = 'kernel' if getattr(layer, 'depthwise_kernel', None) is None else 'depthwise_kernel'
+                    dk = _quantized_weight(
+                        layer, dk_attr, ('depthwise_quantizer_internal', 'depthwise_quantizer', 'kernel_quantizer')
+                    )
+                    if dk.ndim == 3:  # [k, C, M] -> lift like depthwise_conv1d
+                        dk = dk[:, None]
+                    kh, kw, cin, mult = dk.shape
+                    groups.append([dk[:, :, c, :].reshape(kh * kw, mult) for c in range(cin)])
+                    if name.startswith('Separable'):
+                        pk = _quantized_weight(
+                            layer, 'pointwise_kernel', ('pointwise_quantizer_internal', 'pointwise_quantizer')
+                        )
+                        groups.append([pk.reshape(pk.shape[-2], pk.shape[-1])])
+            except Exception:
+                continue
+        return groups or None
+
     # ------------------------------------------------------------ layers
 
     def _trace_layer(self, layer, args: tuple, kwargs: dict):

@@ -75,6 +75,18 @@ class TracerPluginBase:
         """Input shapes (batch dim excluded), or None if not inferable."""
         raise NotImplementedError
 
+    def prewarm_kernel_groups(self) -> list[list[np.ndarray]] | None:
+        """Constant-matrix groups (one per future CMVM solve call) for
+        background shape-class prewarming, or None.
+
+        Front-ends that can enumerate their layers' weight matrices before
+        tracing should override this; ``trace`` then AOT-compiles every
+        device shape class concurrently with the layer-by-layer solve flow
+        instead of paying one serial trace+compile per class. Best-effort:
+        a missed or extra group only costs a background compile.
+        """
+        return None
+
     # ------------------------------------------------------------ plumbing
 
     def _get_inputs(
@@ -127,6 +139,15 @@ class TracerPluginBase:
         otherwise returns ``(inputs, outputs)`` as flat FixedVariableArrays,
         ready for ``comb_trace``.
         """
+        if (self.solver_options or {}).get('backend') == 'jax':
+            groups = self.prewarm_kernel_groups()
+            if groups:
+                from ..cmvm import jax_search
+
+                opts = {k: v for k, v in (self.solver_options or {}).items() if k != 'backend'}
+                opts.setdefault('adder_size', self.hwconf.adder_size)
+                opts.setdefault('carry_size', self.hwconf.carry_size)
+                jax_search.prewarm_for_kernels(groups, **opts)
         inps = self._get_inputs(inputs, inputs_kif)
         all_traces, output_names = self.apply_model(verbose=verbose, inputs=inps)
         if dump:

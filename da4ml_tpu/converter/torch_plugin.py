@@ -67,6 +67,33 @@ class TorchTracer(TracerPluginBase):
         shape = tuple(int(d) for d in shape)
         return [shape]
 
+    def prewarm_kernel_groups(self):
+        """One weight-matrix group per CMVM-bearing module, shaped as the
+        trace handlers will shape the solve calls (Linear: W.T; Conv: the
+        im2col matrix; depthwise: one matrix per channel). Best-effort."""
+        try:
+            import torch.nn as nn
+        except Exception:
+            return None
+        groups: list[list[np.ndarray]] = []
+        for mod in self.model.modules():
+            try:
+                if isinstance(mod, nn.Linear):
+                    groups.append([_w(mod.weight).T])
+                elif isinstance(mod, (nn.Conv1d, nn.Conv2d)):
+                    depthwise = mod.groups == mod.in_channels and mod.out_channels % mod.in_channels == 0
+                    w = _w(mod.weight)
+                    if depthwise and mod.groups != 1:
+                        cin, mult = mod.in_channels, mod.out_channels // mod.in_channels
+                        k2 = w.reshape(cin, mult, -1)  # flatten the spatial taps
+                        groups.append([k2[c].T for c in range(cin)])  # [kh*kw, mult] each
+                    elif mod.groups == 1:
+                        cout = w.shape[0]
+                        groups.append([w.reshape(cout, -1).T])  # [kh*kw*cin, cout]
+            except Exception:
+                continue
+        return groups or None
+
     # ------------------------------------------------------------ modules
 
     def _trace_module(self, mod, args: tuple):

@@ -21,6 +21,19 @@ import jax
 
 jax.config.update('jax_platforms', 'cpu')
 
+# Share compiled XLA programs across test processes: test data is seeded, so
+# program shapes repeat run-to-run and the suite is compile-dominated on
+# small boxes. First run populates the cache; later runs (local re-runs, CI
+# with a cached dir) skip the compiles. Point DA4ML_TEST_JAX_CACHE elsewhere
+# or at '' to disable.
+import getpass
+
+_cache_dir = os.environ.get('DA4ML_TEST_JAX_CACHE', f'/tmp/da4ml_test_jax_cache_{getpass.getuser()}')
+if _cache_dir:
+    jax.config.update('jax_compilation_cache_dir', _cache_dir)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+    jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+
 import numpy as np
 import pytest
 

@@ -97,6 +97,46 @@ def test_keras_conv2d_model(rng):
     np.testing.assert_array_equal(out, ref)
 
 
+def test_keras_prewarm_kernel_groups(rng):
+    """The keras plugin enumerates one kernel group per CMVM layer, shaped
+    exactly as the trace handlers shape the solve calls."""
+    from keras import layers
+
+    from da4ml_tpu.converter.keras_plugin import KerasTracer
+    from da4ml_tpu.trace import HWConfig
+
+    model = keras.Sequential(
+        [
+            layers.Input((6, 6, 2)),
+            layers.Conv2D(3, (3, 3)),
+            layers.DepthwiseConv2D((2, 2), depth_multiplier=2),
+            layers.Flatten(),
+            layers.Dense(4),
+        ]
+    )
+    _int_weights_keras(model, rng, -3, 3)
+    groups = KerasTracer(model, HWConfig(1, -1, -1), {'backend': 'jax'}).prewarm_kernel_groups()
+    assert groups is not None and len(groups) == 3
+    assert [k.shape for k in groups[0]] == [(3 * 3 * 2, 3)]  # conv im2col
+    assert [k.shape for k in groups[1]] == [(2 * 2, 2)] * 3  # depthwise, per channel
+    assert [k.shape for k in groups[2]] == [(3 * 3 * 6, 4)]  # dense on the flattened (3,3,6) map
+
+
+def test_torch_prewarm_kernel_groups(rng):
+    import torch.nn as nn
+
+    from da4ml_tpu.converter.torch_plugin import TorchTracer
+    from da4ml_tpu.trace import HWConfig
+
+    model = nn.Sequential(nn.Conv2d(2, 3, 3), nn.Flatten(), nn.LazyLinear(4))
+    model(torch.zeros(1, 2, 5, 5))  # materialize lazy shapes
+    model.input_shape = (2, 5, 5)
+    groups = TorchTracer(model, HWConfig(1, -1, -1), {'backend': 'jax'}).prewarm_kernel_groups()
+    assert groups is not None and len(groups) == 2
+    assert groups[0][0].shape == (3 * 3 * 2, 3)
+    assert groups[1][0].shape[1] == 4
+
+
 def test_keras_concat_multi_branch(rng):
     from keras import layers
 

@@ -391,3 +391,57 @@ def test_prewarm_paths(rng, monkeypatch):
     assert spec.P >= 8 and spec.O >= 8 and bucket >= 1
     # the worker is a daemon on a SimpleQueue: queued AOT compiles never
     # block interpreter exit, so there is nothing to drain here
+
+
+def test_prewarm_for_kernels_covers_solve_classes(rng, monkeypatch):
+    """The model-level prewarm estimates exactly the shape classes a later
+    solve_jax_many over the same kernel groups requests (both stages)."""
+    from da4ml_tpu.cmvm import jax_search as js
+    from da4ml_tpu.cmvm.jax_search import prewarm_for_kernels
+
+    monkeypatch.setenv('DA4ML_JAX_PREWARM', '0')
+    assert prewarm_for_kernels([[random_kernel(rng, 8, 4)]]) == 0  # disabled: no-op
+
+    monkeypatch.setenv('DA4ML_JAX_PREWARM', '1')
+    warmed: list = []
+    monkeypatch.setattr(js, '_prewarm_submit', lambda job: job())  # run inline
+    monkeypatch.setattr(js, '_prewarm_class', lambda spec, bucket: warmed.append((spec, bucket)))
+    kernels = [random_kernel(rng, 8, 4), random_kernel(rng, 12, 6)]
+    assert prewarm_for_kernels([kernels]) == 1
+    assert warmed, 'prewarm must estimate at least one class'
+
+    used: list = []
+    real_build = js._build_cse_fn
+    monkeypatch.setattr(js, '_build_cse_fn', lambda spec: (used.append(spec), real_build(spec))[1])
+    monkeypatch.setenv('DA4ML_JAX_PREWARM', '0')  # no in-loop prewarm noise
+    sols = solve_jax_many(kernels)
+    for k, s in zip(kernels, sols):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+    warmed_specs = {spec for spec, _ in warmed}
+    # no drift: every estimated class is one the real solve actually built
+    # (resume rungs beyond the first are covered by the in-loop prewarm)
+    assert warmed_specs <= set(used), f'drifted estimate: warmed={warmed_specs}, used={set(used)}'
+    assert warmed_specs & set(used)
+
+
+def test_plugin_prewarm_hook(monkeypatch):
+    """TracerPluginBase.trace fires the model-level prewarm exactly when the
+    backend is jax and the plugin reports kernel groups."""
+    from da4ml_tpu.cmvm import jax_search as js
+    from da4ml_tpu.converter.example import ExampleModel, ExampleTracer
+    from da4ml_tpu.trace import HWConfig
+
+    calls: list = []
+    monkeypatch.setattr(js, 'prewarm_for_kernels', lambda groups, **kw: calls.append((groups, kw)) or 1)
+
+    class WarmTracer(ExampleTracer):
+        def prewarm_kernel_groups(self):
+            return [[np.eye(4)]]
+
+    # backend jax -> hook fires with hwconf defaults forwarded
+    WarmTracer(ExampleModel((4, 5)), HWConfig(1, -1, -1), {'backend': 'jax'}).trace()
+    assert len(calls) == 1
+    assert calls[0][1]['adder_size'] == 1 and calls[0][1]['carry_size'] == -1
+    # non-jax backend -> no prewarm
+    WarmTracer(ExampleModel((4, 5)), HWConfig(1, -1, -1), {'backend': 'cpu'}).trace()
+    assert len(calls) == 1

@@ -1,0 +1,115 @@
+"""Checkpointed quality_1000 sweep (manual tool).
+
+Reproduces the exact kernel distribution of ``bench.py --section
+quality_1000`` (seed 1000, dims 2-32, 1-8 bit) and walks it in 20-kernel
+chunks with a JSON checkpoint after every chunk, so a multi-hour CPU-XLA
+run survives interruption. Per-kernel host cost, device cost, and
+op-for-op identity are recorded (stronger than the cost-only ``identical``
+of the bench section).
+
+Usage:
+    JAX_PLATFORMS=cpu DA4ML_JAX_HBM_BUDGET=512000000 \
+        python tests_tpu/quality_1000_resume.py [start] [stop] [ckpt.json]
+
+Defaults: start=400 (rounds 1-4 already captured 0..400 in
+docs/quality_r4_cpu.json), stop=1000, ckpt=docs/quality_1000_ckpt.json.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    import jax
+
+    jax.config.update('jax_platforms', 'cpu')
+
+CHUNK = 20
+
+
+def gen_kernels(n=1000):
+    """The exact quality_1000 sequence (bench.py seed/sampling order)."""
+    rng = np.random.default_rng(1000)
+    kernels = []
+    for _ in range(n):
+        d1, d2 = int(rng.integers(2, 33)), int(rng.integers(2, 33))
+        bits = int(rng.integers(1, 9))
+        mag = rng.integers(0, 2**bits, (d1, d2)).astype(np.float64)
+        kernels.append(mag * rng.choice([-1.0, 1.0], (d1, d2)))
+    return kernels
+
+
+def ops_sig(p):
+    return [[(o.id0, o.id1, o.opcode, o.data) for o in st.ops] for st in p.stages]
+
+
+def main():
+    start = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    stop = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    ckpt_path = Path(sys.argv[3]) if len(sys.argv) > 3 else Path(__file__).resolve().parents[1] / 'docs' / 'quality_1000_ckpt.json'
+
+    from da4ml_tpu.cmvm import solve as host_solve
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    kernels = gen_kernels()
+    state = {'records': []}
+    if ckpt_path.exists():
+        state = json.loads(ckpt_path.read_text())
+    done = {r['idx'] for r in state['records']}
+
+    idxs = [i for i in range(start, stop) if i not in done]
+    print(f'{len(idxs)} kernels to go ({start}..{stop}), ckpt={ckpt_path}', flush=True)
+    while idxs:
+        batch, idxs = idxs[:CHUNK], idxs[CHUNK:]
+        ks = [kernels[i] for i in batch]
+        t0 = time.perf_counter()
+        host = [host_solve(k, backend='auto') for k in ks]
+        t_host = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dev = solve_jax_many(ks)
+        t_dev = time.perf_counter() - t0
+        for i, k, h, d in zip(batch, ks, host, dev):
+            assert np.array_equal(np.asarray(d.kernel, np.float64), k), f'exactness violated at {i}'
+            state['records'].append(
+                {
+                    'idx': i,
+                    'dims': list(k.shape),
+                    'cost_host': float(h.cost),
+                    'cost_dev': float(d.cost),
+                    'ops_identical': ops_sig(h) == ops_sig(d),
+                }
+            )
+        state['meta'] = {
+            'platform': 'cpu-xla' if os.environ.get('JAX_PLATFORMS') == 'cpu' else 'device',
+            'seed': 1000,
+            'chunk_host_s': round(t_host, 1),
+            'chunk_dev_s': round(t_dev, 1),
+            'n_done': len(state['records']),
+        }
+        ckpt_path.write_text(json.dumps(state))
+        print(f'{len(state["records"])} done (chunk host {t_host:.0f}s dev {t_dev:.0f}s)', flush=True)
+
+    recs = state['records']
+    hc = np.array([r['cost_host'] for r in recs])
+    dc = np.array([r['cost_dev'] for r in recs])
+    summary = {
+        'n_kernels': len(recs),
+        'cost_identical': int((dc == hc).sum()),
+        'ops_identical': int(sum(r['ops_identical'] for r in recs)),
+        'win': int((dc < hc).sum()),
+        'loss': int((dc > hc).sum()),
+        'mean_cost_host': round(float(hc.mean()), 3),
+        'mean_cost_dev': round(float(dc.mean()), 3),
+        'max_loss': float((dc - hc).max()),
+    }
+    print(json.dumps(summary))
+
+
+if __name__ == '__main__':
+    main()

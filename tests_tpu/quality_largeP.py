@@ -46,10 +46,14 @@ def ops_sig(p):
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith('-')]
     n = int(args[0]) if args else 6
+    # dim range: defaults to the original 80-128 class; pass lo hi for the
+    # 44-64 (first P=512 rung) class of VERDICT r4 item 8
+    d_lo = int(args[1]) if len(args) > 1 else 80
+    d_hi = int(args[2]) if len(args) > 2 else 128
     rng = np.random.default_rng(512)
     kernels = []
     for _ in range(n):
-        d = int(rng.integers(80, 129))
+        d = int(rng.integers(d_lo, d_hi + 1))
         b = int(rng.integers(5, 8))
         kernels.append((rng.integers(0, 2**b, (d, d)) * rng.choice([-1.0, 1.0], (d, d))).astype(np.float64))
 
@@ -66,7 +70,7 @@ def main():
     out = {
         'n_kernels': n,
         'dims': [int(k.shape[0]) for k in kernels],
-        'slot_class': 'P=512 rung (deep cache K=16)',
+        'slot_class': f'dims {d_lo}-{d_hi} (deep cache K=16 above P=256)',
         'ops_identical_vs_host': f'{ident_host}/{n}',
         'cost_top4': ct.tolist(),
         'cost_host': ch.tolist(),
