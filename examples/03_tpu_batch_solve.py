@@ -27,8 +27,13 @@ from da4ml_tpu.cmvm import solve
 from da4ml_tpu.cmvm.jax_search import solve_jax_many
 from da4ml_tpu.parallel import default_mesh
 
+import os
+
 rng = np.random.default_rng(7)
-kernels = [(rng.integers(0, 16, (16, 16)) * rng.choice([-1.0, 1.0], (16, 16))).astype(np.float64) for _ in range(16)]
+# batch size: 16 shows off throughput; the test gallery shrinks it via env
+# (CPU-XLA executes the search ~100x slower than a TPU chip)
+N = int(os.environ.get('DA4ML_EXAMPLE_N', '16'))
+kernels = [(rng.integers(0, 16, (16, 16)) * rng.choice([-1.0, 1.0], (16, 16))).astype(np.float64) for _ in range(N)]
 
 solve_jax_many(kernels[:2])  # warm the XLA compile cache
 t0 = time.perf_counter()

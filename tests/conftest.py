@@ -33,6 +33,12 @@ if _cache_dir:
     jax.config.update('jax_compilation_cache_dir', _cache_dir)
     jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
     jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    # child processes (example scripts, CLI converts, the two-process
+    # distributed test) inherit the same cache — thresholds included, or
+    # their sub-second compiles would never persist
+    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', _cache_dir)
+    os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '0.5')
+    os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES', '0')
 
 import numpy as np
 import pytest

@@ -17,6 +17,7 @@ def test_example_runs(script, tmp_path):
     env = {k: v for k, v in os.environ.items() if k != 'PALLAS_AXON_POOL_IPS'}
     env['JAX_PLATFORMS'] = 'cpu'
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'
+    env['DA4ML_EXAMPLE_N'] = '6'  # CPU-XLA executes the search ~100x slower than a chip
     r = subprocess.run(
         [sys.executable, str(script), str(tmp_path / 'out')],
         capture_output=True,

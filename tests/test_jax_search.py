@@ -438,6 +438,9 @@ def test_plugin_prewarm_hook(monkeypatch):
         def prewarm_kernel_groups(self):
             return [[np.eye(4)]]
 
+        def apply_model(self, verbose, inputs):  # the hook gating is the test
+            return {'out': inputs[0]}, ['out']
+
     # backend jax -> hook fires with hwconf defaults forwarded
     WarmTracer(ExampleModel((4, 5)), HWConfig(1, -1, -1), {'backend': 'jax'}).trace()
     assert len(calls) == 1
