@@ -14,6 +14,10 @@ Robustness: the axon TPU plugin can *hang* (not just fail) at backend init,
 so the TPU is probed in a bounded subprocess with retries; on failure the
 bench runs the device path on CPU XLA and records the probe error in the
 JSON line instead of crashing (round-1 failure mode: BENCH_r01 rc=1).
+``--resume`` (alias ``--resume-check``) runs the checkpointed-resume drill:
+a 3-kernel campaign is started, hard-killed after its first durable
+checkpoint record, resumed, and compared bit-for-bit against an
+uninterrupted run (docs/reliability.md).
 
 Acceptance per matrix (BASELINE.md): Pipeline.kernel == kernel exactly and
 total cost <= host's.
@@ -456,6 +460,67 @@ def _run_section_child(name: str, n1: int, timeout: float, env: dict | None = No
     return {'error': (' | '.join(tail))[-300:] or f'rc={r.returncode}'}
 
 
+def _resume_campaign_kernels():
+    """The fixed 3-kernel campaign of the --resume-check drill."""
+    rng = np.random.default_rng(20260804)
+    return [_rand_kernel(rng, 12, 12, 4) for _ in range(3)]
+
+
+def _resume_child(ckpt: str) -> None:
+    """Child mode: run the drill campaign against `ckpt` (killed by the
+    parent's injected fault after the first durable save on pass 1)."""
+    from da4ml_tpu.reliability import solve_many
+
+    results, report = solve_many(_resume_campaign_kernels(), backend='auto', checkpoint=ckpt)
+    print(json.dumps({'n_done': len(results), 'checkpoint_hits': report.checkpoint_hits}))
+
+
+def run_resume_check() -> dict:
+    """Self-check of crash-safe checkpointed resume (docs/reliability.md):
+    a 3-kernel campaign is started in a child that is hard-killed
+    (``os._exit`` via fault injection) right after its first result is
+    durable, then resumed in a second child; the resumed results must be
+    byte-identical to an uninterrupted in-process run.
+    """
+    import tempfile
+
+    from da4ml_tpu.ir import Pipeline
+    from da4ml_tpu.reliability import CheckpointStore, solve_many
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, 'campaign.json')
+        env = dict(os.environ, DA4ML_FAULT_INJECT='checkpoint.post_save=kill:1')
+        r1 = subprocess.run(
+            [sys.executable, sys.argv[0], '--resume-child', ckpt], capture_output=True, text=True, timeout=300, env=env
+        )
+        out['killed_rc'] = r1.returncode
+        out['records_after_kill'] = len(CheckpointStore(ckpt).records)
+        env2 = dict(os.environ)
+        env2.pop('DA4ML_FAULT_INJECT', None)
+        r2 = subprocess.run(
+            [sys.executable, sys.argv[0], '--resume-child', ckpt], capture_output=True, text=True, timeout=300, env=env2
+        )
+        out['resume_rc'] = r2.returncode
+        lines = [ln for ln in (r2.stdout or '').splitlines() if ln.startswith('{')]
+        out['resume'] = json.loads(lines[-1]) if lines else None
+        resumed = [Pipeline.from_dict(rec['pipeline']) for rec in CheckpointStore(ckpt).records.values()]
+
+    fresh, _ = solve_many(_resume_campaign_kernels(), backend='auto')
+    fresh_dicts = sorted(json.dumps(p.to_dict(), sort_keys=True) for p in fresh)
+    resumed_dicts = sorted(json.dumps(p.to_dict(), sort_keys=True) for p in resumed)
+    out['identical_to_uninterrupted'] = fresh_dicts == resumed_dicts
+    out['ok'] = (
+        out['killed_rc'] != 0
+        and out['records_after_kill'] == 1
+        and out['resume_rc'] == 0
+        and bool(out['resume'])
+        and out['resume']['checkpoint_hits'] == 1
+        and out['identical_to_uninterrupted']
+    )
+    return out
+
+
 def main():
     n1 = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     detail: dict = {'host_threads': HOST_THREADS, 'nproc': os.cpu_count()}
@@ -559,6 +624,13 @@ def main():
 
 
 if __name__ == '__main__':
+    if len(sys.argv) >= 3 and sys.argv[1] == '--resume-child':
+        _resume_child(sys.argv[2])
+        raise SystemExit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] in ('--resume', '--resume-check'):
+        _check = run_resume_check()
+        print(json.dumps({'metric': 'resume_check', 'value': int(_check.get('ok', False)), 'detail': _check}))
+        raise SystemExit(0 if _check.get('ok') else 1)
     if len(sys.argv) >= 3 and sys.argv[1] == '--section':
         # child mode: run one section, print its result as one JSON line
         _name = sys.argv[2]

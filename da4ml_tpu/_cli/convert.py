@@ -65,6 +65,9 @@ def convert(
     solver_backend: str = 'auto',
     n_restarts: int = 1,
     method0_candidates: list[str] | None = None,
+    deadline: float | None = None,
+    fallback: str | bool | None = None,
+    resume: Path | None = None,
 ):
     from ..codegen import HLSModel, RTLModel, VHDLModel
 
@@ -102,6 +105,18 @@ def convert(
         from ..converter import trace_model
         from ..trace import HWConfig, comb_trace
 
+        # reliability layer (docs/reliability.md): per-solve deadline,
+        # backend fallback chain, and crash-safe per-kernel checkpoint so a
+        # killed conversion resumes instead of re-solving finished layers
+        reliability_opts: dict = {}
+        if deadline is not None:
+            reliability_opts['deadline'] = deadline
+        if fallback is not None:
+            reliability_opts['fallback'] = {'on': True, 'off': False}.get(fallback, fallback)
+        if resume is not None:
+            from ..reliability import store_for
+
+            reliability_opts['checkpoint'] = store_for(resume)
         inp, out = trace_model(
             model,
             HWConfig(*hwconf),
@@ -110,6 +125,7 @@ def convert(
                 'backend': solver_backend,
                 'n_restarts': n_restarts,
                 **({'method0_candidates': method0_candidates} if method0_candidates else {}),
+                **reliability_opts,
             },
             verbose > 1,
             inputs_kif=inputs_kif,
@@ -275,6 +291,9 @@ def convert_main(args: argparse.Namespace) -> int:
         solver_backend=args.solver_backend,
         n_restarts=args.n_restarts,
         method0_candidates=args.methods,
+        deadline=args.deadline,
+        fallback=args.fallback,
+        resume=args.resume,
     )
     return 0
 
@@ -320,4 +339,24 @@ def add_convert_args(parser: argparse.ArgumentParser):
         default=None,
         choices=['mc', 'wmc', 'mc-dc', 'mc-pdc', 'wmc-dc', 'wmc-pdc'],
         help='Selection heuristics to sweep (replaces the default wmc; the argmin keeps the cheapest)',
+    )
+    parser.add_argument(
+        '--deadline',
+        type=float,
+        default=None,
+        help='Per-CMVM-solve wall-clock budget in seconds; a hung solve raises SolveTimeout instead of stalling',
+    )
+    parser.add_argument(
+        '--fallback',
+        type=str,
+        default=None,
+        help="Backend degradation: 'on' (default; jax -> native-threads -> pure-python), 'off', "
+        "or an explicit comma-separated chain (e.g. 'native-threads,pure-python')",
+    )
+    parser.add_argument(
+        '--resume',
+        type=Path,
+        default=None,
+        help='Checkpoint file for per-kernel CMVM results: a killed conversion resumes here '
+        'instead of re-solving finished layers (host solver paths)',
     )

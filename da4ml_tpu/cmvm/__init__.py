@@ -5,7 +5,12 @@ Public surface mirrors the reference's ``da4ml.cmvm`` (solver_options_t,
 (native solver), 'jax' (TPU batched search — the performance path).
 """
 
-from typing import Callable, NotRequired, TypedDict
+from typing import Callable, TypedDict
+
+try:  # typing.NotRequired is 3.11+; 3.10 ships it in typing_extensions
+    from typing import NotRequired
+except ImportError:  # pragma: no cover - version-dependent
+    from typing_extensions import NotRequired
 
 from .api import _solve, minimal_latency, solve
 from .core import cmvm, solve_single, to_solution
@@ -27,6 +32,11 @@ class solver_options_t(TypedDict):
     backend: NotRequired[str]
     method0_candidates: NotRequired[list[str] | None]
     n_restarts: NotRequired[int]
+    # reliability layer (docs/reliability.md): per-solve wall-clock budget,
+    # backend fallback chain override, and campaign checkpoint path/store
+    deadline: NotRequired[float | None]
+    fallback: NotRequired[bool | list[str] | str | None]
+    checkpoint: NotRequired[object | None]
 
 
 __all__ = [

@@ -133,7 +133,7 @@ def _pipeline_cost(p: Pipeline) -> float:
     return float(sum(op.cost for sol in p.stages for op in sol.ops))
 
 
-def solve(
+def _solve_dispatch(
     kernel: NDArray,
     method0: str = 'wmc',
     method1: str = 'auto',
@@ -149,16 +149,10 @@ def solve(
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
 ) -> Pipeline:
-    """Full CMVM solve with optional sweep over all decompose depths.
+    """Direct (un-orchestrated) backend dispatch — the body of :func:`solve`.
 
-    backend: 'cpu' (this module, host threads over dc candidates),
-    'cpp' (native C++ solver if built), 'jax' (TPU batched search).
-
-    ``method0_candidates`` widens the sweep with extra selection heuristics
-    (argmin keeps the cheapest solution); on the jax backend the extra
-    candidates batch into the same device call, on cpu/cpp they solve
-    sequentially. ``n_restarts`` adds random tie-break restarts as extra
-    device lanes (jax backend only; ignored on cpu/cpp).
+    The reliability layer calls this per chain backend; everything below is
+    the pre-orchestration solve semantics, unchanged.
     """
     kernel = np.asarray(kernel, dtype=np.float64)
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
@@ -194,7 +188,7 @@ def solve(
     if method0_candidates:
         cands = list(dict.fromkeys(method0_candidates))
         sols = [
-            solve(
+            _solve_dispatch(
                 kernel,
                 method0=mc,
                 method1=method1,
@@ -253,3 +247,112 @@ def solve(
 
     costs = [_pipeline_cost(c) for c in candidates]
     return candidates[int(np.argmin(costs))]
+
+
+def solve(
+    kernel: NDArray,
+    method0: str = 'wmc',
+    method1: str = 'auto',
+    hard_dc: int = -1,
+    decompose_dc: int = -2,
+    qintervals: list[QInterval] | None = None,
+    latencies: list[float] | None = None,
+    adder_size: int = -1,
+    carry_size: int = -1,
+    search_all_decompose_dc: bool = True,
+    backend: str = 'cpu',
+    n_workers: int = 0,
+    method0_candidates: list[str] | None = None,
+    n_restarts: int = 1,
+    *,
+    deadline: float | None = None,
+    fallback=None,
+    report=None,
+    checkpoint=None,
+) -> Pipeline:
+    """Full CMVM solve with optional sweep over all decompose depths.
+
+    backend: 'cpu' (this module, host threads over dc candidates),
+    'cpp' (native C++ solver if built), 'jax' (TPU batched search).
+
+    ``method0_candidates`` widens the sweep with extra selection heuristics
+    (argmin keeps the cheapest solution); on the jax backend the extra
+    candidates batch into the same device call, on cpu/cpp they solve
+    sequentially. ``n_restarts`` adds random tie-break restarts as extra
+    device lanes (jax backend only; ignored on cpu/cpp).
+
+    Reliability (docs/reliability.md): by default a failed backend degrades
+    along the bit-exact chain ``jax → native-threads → pure-python``
+    instead of raising. ``fallback`` overrides (False = requested backend
+    only, or an explicit chain); ``deadline`` bounds the wall clock of the
+    whole solve (:class:`~da4ml_tpu.reliability.SolveTimeout` on overrun);
+    ``report`` (a :class:`~da4ml_tpu.reliability.SolveReport`) receives the
+    attempt-by-attempt record; ``checkpoint`` (path or
+    :class:`~da4ml_tpu.reliability.CheckpointStore`) persists/reuses the
+    result keyed by kernel + options. ``DA4ML_SOLVE_FALLBACK=0`` restores
+    the raise-on-failure behavior globally.
+    """
+    kernel = np.asarray(kernel, dtype=np.float64)
+    if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
+        raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
+
+    from ..reliability.orchestrator import fallback_enabled_default, solve_orchestrated
+
+    want_orchestration = (
+        deadline is not None
+        or report is not None
+        or checkpoint is not None
+        or fallback not in (None, False)
+        or (fallback is None and fallback_enabled_default())
+    )
+    if not want_orchestration:
+        # direct path: exactly the pre-orchestration behavior (also the
+        # per-backend entry point the orchestrator itself uses)
+        return _solve_dispatch(
+            kernel,
+            method0=method0,
+            method1=method1,
+            hard_dc=hard_dc,
+            decompose_dc=decompose_dc,
+            qintervals=qintervals,
+            latencies=latencies,
+            adder_size=adder_size,
+            carry_size=carry_size,
+            search_all_decompose_dc=search_all_decompose_dc,
+            backend=backend,
+            n_workers=n_workers,
+            method0_candidates=method0_candidates,
+            n_restarts=n_restarts,
+        )
+
+    if backend == 'auto':  # resolve before the chain walk: the chain starts
+        try:  # at the backend this host would really use
+            from ..native import has_solver
+
+            backend = 'cpp' if has_solver() else 'cpu'
+        except Exception:
+            backend = 'cpu'
+
+    solve_kwargs = dict(
+        method0=method0,
+        method1=method1,
+        hard_dc=hard_dc,
+        decompose_dc=decompose_dc,
+        qintervals=qintervals,
+        latencies=latencies,
+        adder_size=adder_size,
+        carry_size=carry_size,
+        search_all_decompose_dc=search_all_decompose_dc,
+        method0_candidates=method0_candidates,
+        n_restarts=n_restarts,
+        n_workers=n_workers,
+    )
+    return solve_orchestrated(
+        kernel,
+        solve_kwargs,
+        backend=backend,
+        fallback=fallback,
+        deadline=deadline,
+        report=report,
+        checkpoint=checkpoint,
+    )

@@ -1436,10 +1436,11 @@ def prewarm_for_kernels(
     qintervals/latencies in the probes are exact. A drifted estimate wastes
     one background compile and can never change results.
 
-    Returns the number of background jobs queued (0 when prewarming is
-    disabled on this platform; force with ``DA4ML_JAX_PREWARM=1``). Unknown
-    solver options are ignored so callers can forward ``solver_options``
-    wholesale.
+    Returns 1 when the (single) background prewarm job was queued, 0 when
+    prewarming is disabled on this platform (force with
+    ``DA4ML_JAX_PREWARM=1``) or every group was empty/degenerate — all the
+    per-class compiles run inside that one queued job. Unknown solver
+    options are ignored so callers can forward ``solver_options`` wholesale.
     """
     if not _prewarm_enabled():
         return 0
@@ -1673,7 +1674,12 @@ def solve_jax_many(
       adders better or worse; with the host lane in the portfolio the
       result is never worse than the reference solver per matrix, at the
       price of one serial host solve each."""
+    from ..reliability.faults import fault_check
     from .decompose import kernel_decompose
+
+    # orchestration drill point: lets tests/chaos runs fail the whole device
+    # search deterministically (DA4ML_FAULT_INJECT=cmvm.jax=...)
+    fault_check('cmvm.jax')
 
     kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
     n_mat = len(kernels)

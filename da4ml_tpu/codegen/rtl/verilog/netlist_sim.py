@@ -60,10 +60,16 @@ class VerilogNetlistSim:
                 entries.append(None if 'x' in line else int(line, 16))
             self.mem[fname] = entries
 
+        # a regex miss here would silently mask all I/O to zero width —
+        # refuse to simulate unparsed ports, like every other construct
         m = re.search(r'input\s+\[(\d+):0\]\s+inp', text)
-        self.in_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed module ports: no `input [hi:0] inp` declaration found')
+        self.in_width = int(m.group(1)) + 1
         m = re.search(r'output\s+\[(\d+):0\]\s+out', text)
-        self.out_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed module ports: no `output [hi:0] out` declaration found')
+        self.out_width = int(m.group(1)) + 1
 
         body = text[text.index(');') + 2 :]
         for raw in body.splitlines():
@@ -381,10 +387,16 @@ class VerilogPipelineSim(PipelineNetlistSim):
 
         self.aliases, self.insts, self.regs = [], [], {}
         self.out_src = ''
+        # a miss here used to fall back to width 0, masking all I/O to zero;
+        # unparsed ports must fail loudly like unparsed body lines
         m = re.search(r'input\s+\[(\d+):0\]\s+inp', top_text)
-        self.in_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed pipelined top ports: no `input [hi:0] inp` declaration found')
+        self.in_width = int(m.group(1)) + 1
         m = re.search(r'output\s+\[(\d+):0\]\s+out', top_text)
-        self.out_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed pipelined top ports: no `output [hi:0] out` declaration found')
+        self.out_width = int(m.group(1)) + 1
 
         body = top_text[top_text.index(');') + 2 :]
         for raw in body.splitlines():
@@ -421,6 +433,8 @@ def run_pipeline_netlist(em_in, em_out, sim, pipeline, data: NDArray) -> NDArray
 
 def simulate_pipeline(pipeline, name: str = 'sim', data: NDArray | None = None, register_layers: int = 1) -> NDArray[np.float64]:
     """Emit `pipeline` to Verilog and stream `data` through the clocked top."""
+    if data is None:  # would otherwise crash deep inside pack_inputs on np.asarray(None)
+        raise ValueError('simulate_pipeline requires a (n_samples, n_in) data batch, got None')
     from .comb import VerilogCombEmitter
     from .pipeline import emit_pipeline
 
@@ -433,6 +447,8 @@ def simulate_pipeline(pipeline, name: str = 'sim', data: NDArray | None = None, 
 
 def simulate_comb(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:
     """Emit `comb` to Verilog, simulate the netlist over `data`, return floats."""
+    if data is None:  # would otherwise crash deep inside pack_inputs on np.asarray(None)
+        raise ValueError('simulate_comb requires a (n_samples, n_in) data batch, got None')
     from .comb import VerilogCombEmitter
 
     em = VerilogCombEmitter(comb, name)

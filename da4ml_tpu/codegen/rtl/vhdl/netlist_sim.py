@@ -39,10 +39,16 @@ class VHDLNetlistSim(VerilogNetlistSim):
                 entries.append(None if 'x' in line else int(line, 16))
             self.mem[fname] = entries
 
+        # a regex miss here would silently mask all I/O to zero width —
+        # refuse to simulate unparsed ports, like every other construct
         m = re.search(r'inp : in std_logic_vector\((\d+) downto 0\)', text)
-        self.in_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed entity ports: no `inp : in std_logic_vector(hi downto 0)` found')
+        self.in_width = int(m.group(1)) + 1
         m = re.search(r'out_port : out std_logic_vector\((\d+) downto 0\)', text)
-        self.out_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed entity ports: no `out_port : out std_logic_vector(hi downto 0)` found')
+        self.out_width = int(m.group(1)) + 1
 
         body = text[text.index('architecture') :]
         for raw in body.splitlines():
@@ -112,6 +118,8 @@ class VHDLNetlistSim(VerilogNetlistSim):
 
 def simulate_comb_vhdl(comb, name: str = 'sim', data: NDArray | None = None) -> NDArray[np.float64]:
     """Emit `comb` to VHDL, simulate the netlist over `data`, return floats."""
+    if data is None:  # would otherwise crash deep inside pack_inputs on np.asarray(None)
+        raise ValueError('simulate_comb_vhdl requires a (n_samples, n_in) data batch, got None')
     from ..verilog.netlist_sim import run_netlist
     from .comb import VHDLCombEmitter
 
@@ -137,10 +145,16 @@ class VHDLPipelineSim(PipelineNetlistSim):
 
         self.aliases, self.insts, self.regs = [], [], {}
         self.out_src = ''
+        # a miss here used to fall back to width 0, masking all I/O to zero;
+        # unparsed ports must fail loudly like unparsed body lines
         m = re.search(r'inp : in std_logic_vector\((\d+) downto 0\)', top_text)
-        self.in_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed VHDL top ports: no `inp : in std_logic_vector(hi downto 0)` found')
+        self.in_width = int(m.group(1)) + 1
         m = re.search(r'out_port : out std_logic_vector\((\d+) downto 0\)', top_text)
-        self.out_width = int(m.group(1)) + 1 if m else 0
+        if not m:
+            raise ValueError('Unparsed VHDL top ports: no `out_port : out std_logic_vector(hi downto 0)` found')
+        self.out_width = int(m.group(1)) + 1
 
         body = top_text[top_text.index('architecture') :]
         for raw in body.splitlines():
@@ -163,6 +177,8 @@ class VHDLPipelineSim(PipelineNetlistSim):
 
 def simulate_pipeline_vhdl(pipeline, name: str = 'sim', data: NDArray | None = None, register_layers: int = 1) -> NDArray[np.float64]:
     """Emit `pipeline` to VHDL and stream `data` through the clocked top."""
+    if data is None:  # would otherwise crash deep inside pack_inputs on np.asarray(None)
+        raise ValueError('simulate_pipeline_vhdl requires a (n_samples, n_in) data batch, got None')
     from ..verilog.netlist_sim import run_pipeline_netlist
     from .comb import VHDLCombEmitter
     from .pipeline import emit_pipeline_vhdl

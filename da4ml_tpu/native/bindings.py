@@ -27,6 +27,20 @@ _ERR_LEN = 4096
 def load_lib() -> ctypes.CDLL | None:
     """Load (building on demand) the native library; None if unavailable."""
     global _lib, _lib_failed
+    try:
+        from ..reliability.faults import fault_check
+
+        # orchestration drill point (DA4ML_FAULT_INJECT=native.load_lib=...):
+        # simulates a missing toolchain / failed build WITHOUT poisoning the
+        # _lib/_lib_failed cache, so the library loads again once the fault
+        # budget is spent
+        fault_check('native.load_lib')
+    except Exception as e:
+        from ..reliability.errors import ReliabilityError
+
+        if not isinstance(e, ReliabilityError):
+            raise
+        return None
     if _lib is not None:
         return _lib
     if _lib_failed is not None:

@@ -71,12 +71,32 @@ def initialize(
             return False
         return jax.process_count() > 1
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+    # Explicitly configured rendezvous: the coordinator may not be listening
+    # yet (worker raced ahead of rank 0, pod still scheduling) — a transient,
+    # not a config error. Retry with backoff + jitter before surfacing;
+    # DA4ML_DIST_CONNECT_RETRIES overrides the budget (0 disables).
+    from ..reliability.faults import fault_check
+    from ..reliability.retry import retry_call
+
+    def _connect():
+        fault_check('distributed.init')
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+
+    def _is_connect_flake(exc: BaseException) -> bool:
+        from ..reliability.errors import TransientError
+
+        if isinstance(exc, (ConnectionError, TransientError)):
+            return True
+        msg = str(exc).lower()  # gRPC surfaces as RuntimeError; match the
+        return any(m in msg for m in ('connect', 'deadline', 'unavailable', 'timed out'))  # rendezvous flakes only
+
+    retries = int(os.environ.get('DA4ML_DIST_CONNECT_RETRIES', '3') or 0)
+    retry_call(_connect, retries=retries, base_delay=0.5, max_delay=10.0, retry_on=_is_connect_flake)
     return jax.process_count() > 1
 
 

@@ -1,0 +1,75 @@
+"""Fault-tolerant orchestration for expensive entry points.
+
+The production story (``docs/reliability.md``): a hung XLA compile, a
+missing TPU runtime, a failed native build, or a mid-campaign kill must
+degrade a solve — never stall it unboundedly or lose finished work. The
+pieces:
+
+- :mod:`.errors`      — the retryable / fallback / fatal taxonomy
+- :mod:`.deadline`    — supervised wall-clock budgets (:class:`SolveTimeout`)
+- :mod:`.retry`       — exponential backoff + full jitter
+- :mod:`.breaker`     — per-backend circuit breakers
+- :mod:`.orchestrator`— the ``jax → native-threads → pure-python`` chain,
+  :class:`SolveReport`, checkpointed ``solve_many``, runtime ``run_program``
+- :mod:`.checkpoint`  — atomic-write JSON campaign checkpoints
+- :mod:`.faults`      — ``DA4ML_FAULT_INJECT`` + :class:`fault_injection`
+
+``cmvm.api.solve`` routes through this layer by default (disable with
+``DA4ML_SOLVE_FALLBACK=0`` or ``fallback=False``); everything here is also
+usable standalone.
+"""
+
+from .breaker import CircuitBreaker, breaker_for, reset_all_breakers
+from .checkpoint import CheckpointStore, kernel_key, reset_store_cache, store_for
+from .deadline import run_with_deadline
+from .errors import (
+    BackendUnavailable,
+    CheckpointCorrupt,
+    ReliabilityError,
+    SolveTimeout,
+    TransientError,
+    classify,
+)
+from .faults import fault_active, fault_check, fault_injection, parse_spec
+from .orchestrator import (
+    DEFAULT_CHAIN,
+    canonical_backend,
+    fallback_enabled_default,
+    resolve_chain,
+    run_program,
+    solve_many,
+    solve_orchestrated,
+)
+from .report import Attempt, SolveReport
+from .retry import retry_call
+
+__all__ = [
+    'ReliabilityError',
+    'SolveTimeout',
+    'BackendUnavailable',
+    'TransientError',
+    'CheckpointCorrupt',
+    'classify',
+    'run_with_deadline',
+    'retry_call',
+    'CircuitBreaker',
+    'breaker_for',
+    'reset_all_breakers',
+    'CheckpointStore',
+    'kernel_key',
+    'store_for',
+    'reset_store_cache',
+    'fault_check',
+    'fault_active',
+    'fault_injection',
+    'parse_spec',
+    'DEFAULT_CHAIN',
+    'canonical_backend',
+    'resolve_chain',
+    'fallback_enabled_default',
+    'solve_orchestrated',
+    'solve_many',
+    'run_program',
+    'SolveReport',
+    'Attempt',
+]

@@ -1,0 +1,82 @@
+"""Per-backend circuit breakers.
+
+A backend that keeps failing (dead TPU tunnel, broken native build) should
+not charge every subsequent solve its full failure latency — a timeout per
+call across a thousand-kernel sweep is hours of wasted wall clock. After
+``fail_threshold`` consecutive failures the breaker *opens*: the
+orchestrator skips the backend outright (recording the skip in the
+``SolveReport``) until ``reset_after`` seconds pass, then lets exactly one
+probe call through (*half-open*). A probe success closes the breaker; a
+probe failure re-opens it for another cooldown.
+
+Breakers are process-global per backend name — a degradation discovered by
+one solve benefits every later solve in the process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class CircuitBreaker:
+    def __init__(self, name: str, fail_threshold: int = 3, reset_after: float = 30.0):
+        self.name = name
+        self.fail_threshold = fail_threshold
+        self.reset_after = reset_after
+        self._failures = 0
+        self._opened_at: float | None = None
+        self._probing = False
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return 'closed'
+            if time.monotonic() - self._opened_at >= self.reset_after:
+                return 'half-open'
+            return 'open'
+
+    def allow(self) -> bool:
+        """True if a call may proceed (claims the probe slot when half-open)."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self.reset_after:
+                return False
+            if self._probing:  # another caller already holds the probe
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self.fail_threshold or self._opened_at is not None:
+                self._opened_at = time.monotonic()
+            self._probing = False
+
+
+_registry: dict[str, CircuitBreaker] = {}
+_registry_lock = threading.Lock()
+
+
+def breaker_for(name: str, fail_threshold: int = 3, reset_after: float = 30.0) -> CircuitBreaker:
+    with _registry_lock:
+        br = _registry.get(name)
+        if br is None:
+            _registry[name] = br = CircuitBreaker(name, fail_threshold, reset_after)
+        return br
+
+
+def reset_all_breakers() -> None:
+    """Forget all breaker state (test isolation)."""
+    with _registry_lock:
+        _registry.clear()

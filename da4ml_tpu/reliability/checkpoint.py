@@ -1,0 +1,144 @@
+"""Crash-safe JSON checkpoints for long solve campaigns.
+
+A multi-hour CMVM sweep (``solve_many``, ``bench.py`` quality sections,
+model conversion) must survive a process kill without losing finished
+kernels. The store keeps one JSON document::
+
+    {"version": 1, "meta": {...}, "records": {"<key>": <value>, ...}}
+
+and flushes it with the classic atomic-write sequence — write to a
+temporary file in the same directory, ``fsync`` the file, ``os.replace``
+over the target, ``fsync`` the directory — so a kill at any instant leaves
+either the previous complete checkpoint or the new complete checkpoint,
+never a torn file. (A torn file can still come from outside — that case is
+quarantined to ``<path>.corrupt`` on load, or raised in ``strict`` mode.)
+
+Keys are content hashes (:func:`kernel_key`), so resuming is robust against
+reordering and against campaign-definition edits: only kernels whose bytes
+and solver options both match are skipped.
+
+This generalizes the ad-hoc resume loop of ``tests_tpu/quality_1000_resume.py``
+into a library feature; the TVM AutoTVM tuning-log pattern (arxiv 1802.04799)
+is the direct precedent — the search is a restartable job with persisted state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from .errors import CheckpointCorrupt
+from .faults import fault_active, fault_check
+
+_VERSION = 1
+
+
+def kernel_key(kernel, opts: dict | None = None) -> str:
+    """Content hash of a kernel matrix + the solver options that shape its
+    solution. Two campaigns agree on a key iff the solve would be identical."""
+    k = np.ascontiguousarray(kernel, dtype=np.float64)
+    h = hashlib.sha256()
+    h.update(str(k.shape).encode())
+    h.update(k.tobytes())
+    if opts:
+        h.update(json.dumps(opts, sort_keys=True, default=str).encode())
+    return h.hexdigest()[:32]
+
+
+class CheckpointStore:
+    """Dict-like persisted record store with atomic flush per ``put``.
+
+    ``strict=True`` raises :class:`CheckpointCorrupt` on an unparseable
+    file; the default quarantines it to ``<path>.corrupt`` and starts fresh
+    (a campaign should degrade to "recompute" rather than refuse to run).
+    """
+
+    def __init__(self, path: str | os.PathLike, meta: dict | None = None, strict: bool = False):
+        self.path = Path(path)
+        self.strict = strict
+        self.meta: dict = dict(meta or {})
+        self.records: dict[str, object] = {}
+        self.recovered_corrupt = False
+        self._load()
+
+    def _load(self) -> None:
+        if not self.path.exists():
+            return
+        try:
+            blob = json.loads(self.path.read_text())
+            if not isinstance(blob, dict) or 'records' not in blob:
+                raise ValueError('not a checkpoint document')
+        except (ValueError, OSError) as e:
+            if self.strict:
+                raise CheckpointCorrupt(f'checkpoint {self.path} is corrupt: {e}') from e
+            quarantine = self.path.with_suffix(self.path.suffix + '.corrupt')
+            try:
+                os.replace(self.path, quarantine)
+            except OSError:
+                pass
+            self.recovered_corrupt = True
+            return
+        self.records = dict(blob['records'])
+        saved_meta = blob.get('meta') or {}
+        self.meta = {**saved_meta, **self.meta}
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.records
+
+    def get(self, key: str, default=None):
+        return self.records.get(key, default)
+
+    def put(self, key: str, value) -> None:
+        """Record one result and flush the checkpoint atomically."""
+        self.records[key] = value
+        self.flush()
+        # kill-after-durable-save drill point: everything written above is
+        # already safe on disk when this fires
+        fault_check('checkpoint.post_save')
+
+    def flush(self) -> None:
+        doc = {'version': _VERSION, 'meta': self.meta, 'records': self.records}
+        payload = json.dumps(doc)
+        if fault_active('checkpoint.write', 'corrupt'):
+            payload = payload[: max(1, len(payload) // 2)]  # torn write
+        tmp = self.path.with_suffix(self.path.suffix + f'.tmp{os.getpid()}')
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(tmp, 'w') as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        try:  # make the rename itself durable
+            dfd = os.open(self.path.parent, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # pragma: no cover - platform-dependent
+            pass
+
+
+_store_cache: dict[str, CheckpointStore] = {}
+
+
+def store_for(path: str | os.PathLike, meta: dict | None = None, strict: bool = False) -> CheckpointStore:
+    """Process-wide store per absolute path, so every solve in a campaign
+    (CLI convert, tracer, explicit loops) shares one in-memory view instead
+    of re-reading the JSON per call."""
+    key = str(Path(path).resolve())
+    store = _store_cache.get(key)
+    if store is None:
+        _store_cache[key] = store = CheckpointStore(path, meta=meta, strict=strict)
+    return store
+
+
+def reset_store_cache() -> None:
+    """Drop cached stores (test isolation)."""
+    _store_cache.clear()

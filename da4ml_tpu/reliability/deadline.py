@@ -1,0 +1,51 @@
+"""Wall-clock deadline supervision for calls that can hang, not just fail.
+
+An XLA compile against a wedged device tunnel blocks indefinitely inside
+native code — no Python-level exception ever surfaces. ``run_with_deadline``
+runs the callable in a supervised daemon worker thread and re-raises its
+outcome; if the budget elapses first, the caller gets :class:`SolveTimeout`
+and control back. The worker cannot be cancelled (CPython offers no safe
+kill for a thread stuck in native code) so it is left to finish detached;
+its eventual result is discarded.
+
+A thread — not a process — is deliberate: fork with a live XLA runtime is
+unsafe, spawn would lose the compile caches that make the solve fast, and
+the supervised calls release the GIL inside XLA anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from .errors import SolveTimeout
+
+
+def run_with_deadline(fn: Callable[..., Any], deadline_s: float | None, *args, name: str = 'solve', **kwargs) -> Any:
+    """Call ``fn(*args, **kwargs)``, raising SolveTimeout after `deadline_s`.
+
+    ``deadline_s`` of None or <= 0 means unbounded: the call runs inline with
+    zero supervision overhead.
+    """
+    if deadline_s is None or deadline_s <= 0:
+        return fn(*args, **kwargs)
+
+    outcome: list[Any] = []  # [('ok', result)] or [('err', exception)]
+    done = threading.Event()
+
+    def _worker() -> None:
+        try:
+            outcome.append(('ok', fn(*args, **kwargs)))
+        except BaseException as e:  # noqa: BLE001 - relayed to the caller
+            outcome.append(('err', e))
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_worker, name=f'da4ml-deadline-{name}', daemon=True)
+    t.start()
+    if not done.wait(deadline_s):
+        raise SolveTimeout(f'{name} exceeded its {deadline_s:.3g}s deadline (worker left running detached)')
+    kind, value = outcome[0]
+    if kind == 'err':
+        raise value
+    return value

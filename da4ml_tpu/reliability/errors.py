@@ -1,0 +1,95 @@
+"""Error taxonomy for fault-tolerant solve orchestration.
+
+Every failure seen by the orchestrator is classified into exactly one of
+three kinds, which determine the degradation path:
+
+- ``retryable`` — transient; the same backend is retried with exponential
+  backoff + jitter (coordinator connect resets, device busy, compile-cache
+  races).
+- ``fallback``  — this backend cannot serve (missing runtime, failed native
+  build, OOM, deadline blown); the next backend in the chain is tried. All
+  chain backends are bit-exact implementations of the same contract, so the
+  answer does not change — only the wall clock and the ``SolveReport`` do.
+- ``fatal``     — the *request* is wrong (bad kernel shape, invalid option);
+  every backend would fail identically, so the error propagates immediately.
+"""
+
+from __future__ import annotations
+
+
+class ReliabilityError(RuntimeError):
+    """Base class for orchestration-layer errors."""
+
+
+class SolveTimeout(ReliabilityError):
+    """A supervised call exceeded its wall-clock deadline.
+
+    The worker may still be running detached (a hung XLA compile cannot be
+    cancelled from Python); the caller regains control regardless.
+    """
+
+
+class BackendUnavailable(ReliabilityError):
+    """A backend cannot serve at all: missing runtime, failed build, fault
+    injection. Classified ``fallback``."""
+
+
+class TransientError(ReliabilityError):
+    """A failure expected to clear on retry: connect reset, device busy,
+    cache race. Classified ``retryable``."""
+
+
+class CheckpointCorrupt(ReliabilityError):
+    """A checkpoint file exists but cannot be parsed (torn write, injected
+    corruption). Non-strict stores quarantine and restart; strict stores
+    raise this."""
+
+
+#: substrings of third-party error messages that indicate a transient
+#: condition worth retrying on the SAME backend
+_TRANSIENT_MARKERS = (
+    'connection refused',
+    'connection reset',
+    'temporarily unavailable',
+    'resource temporarily',
+    'deadline_exceeded',
+    'device or resource busy',
+    'cache race',
+    'already exists',  # compile-cache rename races
+    'try again',
+)
+
+#: substrings indicating the current backend is out of service but another
+#: bit-exact backend can still answer
+_FALLBACK_MARKERS = (
+    'unavailable',
+    'out of memory',
+    'resource_exhausted',
+    'failed to build',
+    'no module named',
+    'not built',
+    'failed precondition',
+    'initialization failed',
+)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to ``'retryable'``, ``'fallback'``, or ``'fatal'``."""
+    if isinstance(exc, TransientError):
+        return 'retryable'
+    if isinstance(exc, (SolveTimeout, BackendUnavailable)):
+        return 'fallback'
+    if isinstance(exc, (ValueError, TypeError, KeyError, AssertionError)):
+        return 'fatal'  # malformed request: identical on every backend
+    if isinstance(exc, (ConnectionError, BrokenPipeError)):
+        return 'retryable'
+    if isinstance(exc, (ImportError, ModuleNotFoundError, OSError, MemoryError)):
+        return 'fallback'
+    msg = str(exc).lower()
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return 'retryable'
+    if any(m in msg for m in _FALLBACK_MARKERS):
+        return 'fallback'
+    # unknown RuntimeError and friends: assume the backend (not the request)
+    # is at fault, so a bit-exact sibling still has a chance
+    return 'fallback'

@@ -1,0 +1,171 @@
+"""Deterministic fault injection for tests and chaos drills.
+
+A fault plan maps a *site* (a dotted string named at each instrumented call
+point) to a failure mode. Plans come from the ``DA4ML_FAULT_INJECT``
+environment variable or the :func:`fault_injection` context manager
+(the context manager wins while active).
+
+Spec grammar (comma-separated entries)::
+
+    site=mode[:count[:arg]]
+
+    cmvm.jax=unavailable          every solve_jax_many call raises
+    cmvm.jax=transient:2          first 2 calls raise TransientError, then pass
+    cmvm.jax=sleep:1:5            first call sleeps 5s (deadline tests)
+    native.load_lib=unavailable   native library reports "not built"
+    runtime.jax=unavailable       XLA executor construction fails
+    distributed.init=transient:3  coordinator connect flakes 3 times
+    checkpoint.write=corrupt:1    next checkpoint flush writes torn JSON
+    checkpoint.post_save=kill:1   hard-exit (os._exit) after first durable save
+
+``count`` bounds how many matching calls fault (empty/omitted = unlimited).
+``arg`` is mode-specific (sleep seconds; kill exit code).
+
+Instrumented sites (kept in docs/reliability.md): ``cmvm.solve``,
+``cmvm.jax``, ``cmvm.native``, ``cmvm.cpu``, ``native.load_lib``,
+``runtime.jax``, ``distributed.init``, ``checkpoint.write``,
+``checkpoint.post_save``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .errors import BackendUnavailable, TransientError
+
+_ENV_VAR = 'DA4ML_FAULT_INJECT'
+
+_MODES = ('unavailable', 'transient', 'error', 'sleep', 'corrupt', 'kill')
+
+
+class _Fault:
+    __slots__ = ('mode', 'remaining', 'arg')
+
+    def __init__(self, mode: str, remaining: int | None, arg: float | None):
+        if mode not in _MODES:
+            raise ValueError(f'unknown fault mode {mode!r} (expected one of {_MODES})')
+        self.mode = mode
+        self.remaining = remaining  # None = unlimited
+        self.arg = arg
+
+
+def parse_spec(text: str) -> dict[str, _Fault]:
+    """Parse a ``site=mode[:count[:arg]]`` spec string into a fault plan."""
+    plan: dict[str, _Fault] = {}
+    for entry in text.split(','):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if '=' not in entry:
+            raise ValueError(f'bad fault entry {entry!r}: expected site=mode[:count[:arg]]')
+        site, rhs = entry.split('=', 1)
+        parts = rhs.split(':')
+        mode = parts[0].strip()
+        count = int(parts[1]) if len(parts) > 1 and parts[1].strip() else None
+        arg = float(parts[2]) if len(parts) > 2 and parts[2].strip() else None
+        plan[site.strip()] = _Fault(mode, count, arg)
+    return plan
+
+
+_lock = threading.Lock()
+_env_plan: dict[str, _Fault] | None = None  # parsed lazily from the env var
+_env_raw: str | None = None  # the raw value _env_plan was parsed from
+_override_plan: dict[str, _Fault] | None = None  # fault_injection() override
+
+
+def _active_plan() -> dict[str, _Fault] | None:
+    global _env_plan, _env_raw
+    if _override_plan is not None:
+        return _override_plan
+    raw = os.environ.get(_ENV_VAR)
+    if not raw:
+        return None
+    if raw != _env_raw:  # env changed (tests set it per-subprocess)
+        with _lock:
+            if raw != _env_raw:
+                _env_plan = parse_spec(raw)
+                _env_raw = raw
+    return _env_plan
+
+
+def _take(site: str) -> _Fault | None:
+    """Claim one firing of the fault at `site`, decrementing its budget."""
+    plan = _active_plan()
+    if not plan:
+        return None
+    fault = plan.get(site)
+    if fault is None:
+        return None
+    with _lock:
+        if fault.remaining is not None:
+            if fault.remaining <= 0:
+                return None
+            fault.remaining -= 1
+    return fault
+
+
+def fault_check(site: str) -> None:
+    """Raise/act if an error-type fault is planned at `site` (no-op otherwise).
+
+    Called at every instrumented site; the fast path (no plan) is one dict
+    lookup of the env var.
+    """
+    fault = _take(site)
+    if fault is None:
+        return
+    if fault.mode == 'unavailable':
+        raise BackendUnavailable(f'injected fault: {site} unavailable')
+    if fault.mode == 'transient':
+        raise TransientError(f'injected fault: {site} transient failure')
+    if fault.mode == 'error':
+        raise RuntimeError(f'injected fault: {site} error')
+    if fault.mode == 'sleep':
+        time.sleep(fault.arg if fault.arg is not None else 3600.0)
+        return
+    if fault.mode == 'kill':
+        os._exit(int(fault.arg) if fault.arg is not None else 137)
+    # 'corrupt' is a data-plane fault consumed via fault_active() by the
+    # checkpoint writer; hitting it through fault_check is a spec error
+    raise ValueError(f'fault mode {fault.mode!r} at {site} must be consumed with fault_active()')
+
+
+def fault_active(site: str, mode: str) -> bool:
+    """True (consuming one firing) if a fault of `mode` is planned at `site`.
+
+    Used by call points that must *act differently* rather than raise — e.g.
+    the checkpoint writer producing a torn file for ``corrupt``.
+    """
+    plan = _active_plan()
+    if not plan:
+        return False
+    fault = plan.get(site)
+    if fault is None or fault.mode != mode:
+        return False
+    return _take(site) is not None
+
+
+class fault_injection:
+    """Context manager installing a fault plan for the current process.
+
+    >>> with fault_injection('cmvm.jax=unavailable'):
+    ...     solve(kernel, backend='jax')  # degrades to native/cpu
+
+    Overrides (does not merge with) any ``DA4ML_FAULT_INJECT`` plan while
+    active. Not reentrant across threads: the plan is process-global.
+    """
+
+    def __init__(self, spec: str):
+        self._plan = parse_spec(spec)
+        self._prev: dict[str, _Fault] | None = None
+
+    def __enter__(self) -> 'fault_injection':
+        global _override_plan
+        self._prev = _override_plan
+        _override_plan = self._plan
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _override_plan
+        _override_plan = self._prev

@@ -1,0 +1,363 @@
+"""Fault-tolerant solve orchestration: the backend fallback chain.
+
+Every chain backend is a bit-exact implementation of the CMVM solve
+contract, ordered fastest-first::
+
+    jax  →  native-threads  →  pure-python
+
+- ``jax``            — the TPU/XLA batched search (``cmvm.jax_search``)
+- ``native-threads`` — the C++/OpenMP host solver (``native.solve_native``)
+- ``pure-python``    — the reference host sweep (``cmvm.api``), which has no
+  dependencies and cannot be unavailable
+
+One solve walks the chain from its requested backend downward. Per attempt:
+a circuit breaker decides whether the backend is worth trying at all,
+transient errors retry with backoff + jitter, and a wall-clock deadline
+bounds the *whole walk* (a hung XLA compile surfaces as
+:class:`SolveTimeout`, not an unbounded stall). Outcomes land in a
+structured :class:`~.report.SolveReport`. An optional
+:class:`~.checkpoint.CheckpointStore` short-circuits kernels already solved
+by a previous (possibly killed) run of the same campaign.
+
+Deliberate asymmetry with the quality portfolio (``include_host``): the
+chain changes *where* the answer is computed only when a backend is broken;
+it never mixes backends for quality. Degradation can therefore change
+greedy tie-breaks vs a healthy run (jax and host searches differ there) —
+but within any one backend the result is deterministic, and the report
+records exactly which backend answered.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .breaker import breaker_for
+from .checkpoint import CheckpointStore, kernel_key, store_for
+from .deadline import run_with_deadline
+from .errors import BackendUnavailable, SolveTimeout, classify
+from .faults import fault_check
+from .report import SolveReport
+from .retry import retry_call
+
+#: full degradation order; a requested backend starts its walk at its own
+#: position (requesting 'cpu' never silently upgrades to the device)
+DEFAULT_CHAIN = ('jax', 'native-threads', 'pure-python')
+
+#: cmvm.api backend names → canonical chain names
+_CANON = {
+    'jax': 'jax',
+    'cpp': 'native-threads',
+    'native': 'native-threads',
+    'native-threads': 'native-threads',
+    'cpu': 'pure-python',
+    'python': 'pure-python',
+    'pure-python': 'pure-python',
+}
+
+
+def canonical_backend(name: str) -> str:
+    if name == 'auto':  # the fastest host path, as cmvm.api resolves it
+        try:
+            from ..native import has_solver
+
+            return 'native-threads' if has_solver() else 'pure-python'
+        except Exception:
+            return 'pure-python'
+    try:
+        return _CANON[name]
+    except KeyError:
+        raise ValueError(f'unknown backend {name!r} (expected one of {sorted(set(_CANON))})') from None
+
+
+def resolve_chain(requested: str, fallback: bool | list[str] | tuple[str, ...] | str | None) -> tuple[str, ...]:
+    """The backends this solve may use, in order.
+
+    ``fallback`` may be: None/True (degrade along DEFAULT_CHAIN from the
+    requested backend), False (the requested backend only), or an explicit
+    chain (list/tuple or comma-separated string of backend names).
+    """
+    if isinstance(fallback, str):
+        fallback = [p.strip() for p in fallback.split(',') if p.strip()]
+    if isinstance(fallback, (list, tuple)):
+        return tuple(canonical_backend(b) for b in fallback)
+    req = canonical_backend(requested)
+    if fallback is False:
+        return (req,)
+    start = DEFAULT_CHAIN.index(req)
+    return DEFAULT_CHAIN[start:]
+
+
+def fallback_enabled_default() -> bool:
+    """Chain degradation is on unless ``DA4ML_SOLVE_FALLBACK=0``."""
+    return os.environ.get('DA4ML_SOLVE_FALLBACK', '1') not in ('0', 'false', 'off')
+
+
+_SOLVE_KW = (
+    'method0',
+    'method1',
+    'hard_dc',
+    'decompose_dc',
+    'qintervals',
+    'latencies',
+    'adder_size',
+    'carry_size',
+    'search_all_decompose_dc',
+    'method0_candidates',
+    'n_restarts',
+)
+
+
+def _call_backend(backend: str, kernel, kw: dict):
+    """Dispatch one backend attempt (fault-injection sites per backend)."""
+    args = {k: kw[k] for k in _SOLVE_KW if k in kw}
+    if backend == 'jax':
+        from ..cmvm.jax_search import solve_jax
+
+        return solve_jax(kernel, **args)
+    from ..cmvm import api
+
+    # _solve_dispatch handles the method0_candidates sweep for host backends
+    if backend == 'native-threads':
+        fault_check('cmvm.native')
+        return api._solve_dispatch(kernel, backend='cpp', n_workers=kw.get('n_workers', 0), **args)
+    if backend == 'pure-python':
+        fault_check('cmvm.cpu')
+        return api._solve_dispatch(kernel, backend='cpu', n_workers=kw.get('n_workers', 0), **args)
+    raise ValueError(f'unknown chain backend {backend!r}')
+
+
+def _checkpoint_opts(kw: dict) -> dict:
+    """The solver options that shape the solution — the checkpoint key must
+    miss whenever any of these change."""
+    opts = {k: kw.get(k) for k in _SOLVE_KW}
+    q = opts.get('qintervals')
+    if q:
+        opts['qintervals'] = [list(t) for t in q]
+    return opts
+
+
+def solve_orchestrated(
+    kernel,
+    solve_kwargs: dict,
+    backend: str = 'jax',
+    fallback: bool | list[str] | tuple[str, ...] | str | None = None,
+    deadline: float | None = None,
+    report: SolveReport | None = None,
+    checkpoint: 'CheckpointStore | str | os.PathLike | None' = None,
+    retries: int = 2,
+    retry_base_delay: float = 0.05,
+):
+    """Solve one kernel through the fallback chain. Returns an ``ir.Pipeline``.
+
+    Raises :class:`SolveTimeout` when the deadline elapses, the ``fatal``
+    error unchanged when the request itself is bad, and
+    :class:`BackendUnavailable` when every chain backend failed.
+    """
+    fault_check('cmvm.solve')
+    report = report if report is not None else SolveReport()
+    chain = resolve_chain(backend, fallback)
+    report.requested_backend = backend
+    report.chain = chain
+    report.deadline_s = deadline
+
+    store: CheckpointStore | None = None
+    key: str | None = None
+    if checkpoint is not None:
+        store = checkpoint if isinstance(checkpoint, CheckpointStore) else store_for(checkpoint)
+        key = kernel_key(kernel, _checkpoint_opts(solve_kwargs))
+        hit = store.get(key)
+        if hit is not None:
+            from ..ir.comb import Pipeline
+
+            report.checkpoint_hits += 1
+            report.backend_used = hit.get('backend', 'checkpoint')
+            return Pipeline.from_dict(hit['pipeline'])
+        report.checkpoint_misses += 1
+
+    t_start = time.monotonic()
+    last_exc: BaseException | None = None
+    for bk in chain:
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - (time.monotonic() - t_start)
+            if remaining <= 0:
+                report.total_duration_s = time.monotonic() - t_start
+                raise SolveTimeout(
+                    f'solve deadline {deadline:.3g}s exhausted before backend {bk!r} ({report.summary()})'
+                ) from last_exc
+        br = breaker_for(bk)
+        if not br.allow():
+            report.skip(bk, f'circuit breaker open ({br.state})')
+            continue
+        att = report.start_attempt(bk)
+        t_att = time.monotonic()
+
+        def _on_retry(attempt: int, exc: BaseException, delay: float, att=att) -> None:
+            att.retries = attempt + 1
+
+        def _attempt(bk=bk):
+            # re-read the remaining budget per try: retries must not extend
+            # the overall deadline
+            rem = None
+            if deadline is not None:
+                rem = deadline - (time.monotonic() - t_start)
+                if rem <= 0:
+                    raise SolveTimeout(f'solve deadline {deadline:.3g}s exhausted retrying backend {bk!r}')
+            return run_with_deadline(_call_backend, rem, bk, kernel, solve_kwargs, name=f'solve[{bk}]')
+
+        try:
+            result = retry_call(_attempt, retries=retries, base_delay=retry_base_delay, on_retry=_on_retry)
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            att.duration_s = time.monotonic() - t_att
+            kind = classify(exc)
+            att.error, att.error_kind = f'{type(exc).__name__}: {exc}'[:300], kind
+            br.record_failure()
+            report.total_duration_s = time.monotonic() - t_start
+            if kind == 'fatal':
+                raise
+            if isinstance(exc, SolveTimeout) and deadline is not None and time.monotonic() - t_start >= deadline:
+                raise  # the overall budget is gone: surface the timeout, not chain exhaustion
+            last_exc = exc
+            continue
+        att.ok = True
+        att.duration_s = time.monotonic() - t_att
+        br.record_success()
+        report.backend_used = bk
+        report.total_duration_s = time.monotonic() - t_start
+        if store is not None and key is not None:
+            store.put(key, {'pipeline': result.to_dict(), 'cost': float(result.cost), 'backend': bk})
+        return result
+
+    report.total_duration_s = time.monotonic() - t_start
+    if isinstance(last_exc, SolveTimeout):
+        raise last_exc
+    raise BackendUnavailable(f'all backends failed: {report.summary()}') from last_exc
+
+
+def solve_many(
+    kernels,
+    solver_options: dict | None = None,
+    backend: str = 'jax',
+    fallback=None,
+    deadline_per_solve: float | None = None,
+    checkpoint: 'CheckpointStore | str | os.PathLike | None' = None,
+    report: SolveReport | None = None,
+):
+    """Checkpointed batch campaign: solve each kernel through the chain,
+    persisting every finished result so a killed run resumes where it left
+    off. Returns ``(pipelines, report)``.
+
+    One shared report accumulates attempts across the campaign;
+    ``report.checkpoint_hits`` counts kernels restored instead of re-solved.
+    """
+    solver_options = dict(solver_options or {})
+    report = report if report is not None else SolveReport()
+    store = None
+    if checkpoint is not None:
+        store = checkpoint if isinstance(checkpoint, CheckpointStore) else store_for(checkpoint)
+    results = []
+    for kern in kernels:
+        results.append(
+            solve_orchestrated(
+                np.asarray(kern, dtype=np.float64),
+                solver_options,
+                backend=backend,
+                fallback=fallback,
+                deadline=deadline_per_solve,
+                report=report,
+                checkpoint=store,
+            )
+        )
+    return results, report
+
+
+def run_program(
+    binary,
+    data,
+    chain: tuple[str, ...] = ('jax', 'cpp', 'numpy'),
+    deadline: float | None = None,
+    report: SolveReport | None = None,
+    retries: int = 1,
+):
+    """Execute a DAIS program with runtime-backend degradation.
+
+    The inference analog of the solve chain: all three runtimes are bit-exact
+    (``docs/backends.md``), so a dead device or missing native build costs
+    throughput, never correctness. Returns the output batch; the report
+    records which runtime answered.
+    """
+    report = report if report is not None else SolveReport()
+    report.requested_backend = chain[0] if chain else None
+    report.chain = tuple(chain)
+    report.deadline_s = deadline
+
+    def _call(bk: str):
+        if bk == 'jax':
+            fault_check('runtime.jax')
+            from ..runtime.jax_backend import run_binary
+
+            return run_binary(binary, data)
+        if bk == 'cpp':
+            from ..native import run_binary
+
+            return run_binary(binary, data)
+        if bk == 'numpy':
+            from ..runtime.numpy_backend import run_binary
+
+            return run_binary(binary, data)
+        raise ValueError(f'unknown runtime backend {bk!r}')
+
+    t_start = time.monotonic()
+    last_exc: BaseException | None = None
+    for bk in chain:
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - (time.monotonic() - t_start)
+            if remaining <= 0:
+                raise SolveTimeout(f'run_program deadline {deadline:.3g}s exhausted ({report.summary()})') from last_exc
+        br = breaker_for(f'runtime.{bk}')
+        if not br.allow():
+            report.skip(bk, f'circuit breaker open ({br.state})')
+            continue
+        att = report.start_attempt(bk)
+        t_att = time.monotonic()
+
+        def _on_retry(attempt: int, exc: BaseException, delay: float, att=att) -> None:
+            att.retries = attempt + 1
+
+        def _attempt(bk=bk):
+            rem = None
+            if deadline is not None:
+                rem = deadline - (time.monotonic() - t_start)
+                if rem <= 0:
+                    raise SolveTimeout(f'run_program deadline {deadline:.3g}s exhausted retrying {bk!r}')
+            return run_with_deadline(_call, rem, bk, name=f'run[{bk}]')
+
+        try:
+            result = retry_call(_attempt, retries=retries, on_retry=_on_retry)
+        except BaseException as exc:  # noqa: BLE001
+            att.duration_s = time.monotonic() - t_att
+            kind = classify(exc)
+            att.error, att.error_kind = f'{type(exc).__name__}: {exc}'[:300], kind
+            br.record_failure()
+            report.total_duration_s = time.monotonic() - t_start
+            if kind == 'fatal':
+                raise
+            if isinstance(exc, SolveTimeout) and deadline is not None and time.monotonic() - t_start >= deadline:
+                raise  # the overall budget is gone: surface the timeout, not chain exhaustion
+            last_exc = exc
+            continue
+        att.ok = True
+        att.duration_s = time.monotonic() - t_att
+        br.record_success()
+        report.backend_used = bk
+        report.total_duration_s = time.monotonic() - t_start
+        return result
+
+    report.total_duration_s = time.monotonic() - t_start
+    if isinstance(last_exc, SolveTimeout):
+        raise last_exc
+    raise BackendUnavailable(f'all runtimes failed: {report.summary()}') from last_exc

@@ -1,0 +1,87 @@
+"""Structured outcome record for orchestrated solves.
+
+A ``SolveReport`` answers "what actually happened" after a call returns:
+which backend produced the result, which ones were tried and why they were
+passed over, how many retries each attempt burned, and whether the result
+came from a checkpoint instead of a fresh solve. The report never changes
+the result — all chain backends are bit-exact — it records the path taken.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Attempt:
+    """One backend attempt (possibly several retries) inside a chain walk."""
+
+    backend: str
+    ok: bool = False
+    error: str | None = None
+    error_kind: str | None = None  # 'retryable' | 'fallback' | 'fatal' | 'skipped'
+    duration_s: float = 0.0
+    retries: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            'backend': self.backend,
+            'ok': self.ok,
+            'error': self.error,
+            'error_kind': self.error_kind,
+            'duration_s': round(self.duration_s, 4),
+            'retries': self.retries,
+        }
+
+
+@dataclass
+class SolveReport:
+    """Filled in-place by the orchestrator (pass one into ``solve(report=...)``)."""
+
+    requested_backend: str | None = None
+    chain: tuple[str, ...] = ()
+    deadline_s: float | None = None
+    attempts: list[Attempt] = field(default_factory=list)
+    backend_used: str | None = None
+    checkpoint_hits: int = 0
+    checkpoint_misses: int = 0
+    started_at: float = field(default_factory=time.time)
+    total_duration_s: float = 0.0
+
+    @property
+    def degraded(self) -> bool:
+        """True when the result did not come from the first chain backend."""
+        return self.backend_used is not None and bool(self.chain) and self.backend_used != self.chain[0]
+
+    def start_attempt(self, backend: str) -> Attempt:
+        att = Attempt(backend=backend)
+        self.attempts.append(att)
+        return att
+
+    def skip(self, backend: str, reason: str) -> None:
+        self.attempts.append(Attempt(backend=backend, ok=False, error=reason, error_kind='skipped'))
+
+    def to_dict(self) -> dict:
+        return {
+            'requested_backend': self.requested_backend,
+            'chain': list(self.chain),
+            'deadline_s': self.deadline_s,
+            'backend_used': self.backend_used,
+            'degraded': self.degraded,
+            'attempts': [a.to_dict() for a in self.attempts],
+            'checkpoint_hits': self.checkpoint_hits,
+            'checkpoint_misses': self.checkpoint_misses,
+            'total_duration_s': round(self.total_duration_s, 4),
+        }
+
+    def summary(self) -> str:
+        """One human line: ``jax✗(unavailable) → cpp✓ in 0.12s``."""
+        parts = []
+        for a in self.attempts:
+            if a.ok:
+                parts.append(f'{a.backend}✓')
+            else:
+                parts.append(f'{a.backend}✗({a.error_kind or "error"})')
+        path = ' → '.join(parts) or '(no attempts)'
+        return f'{path} in {self.total_duration_s:.2f}s'

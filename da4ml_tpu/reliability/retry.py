@@ -1,0 +1,50 @@
+"""Retry with exponential backoff + full jitter for transient failures.
+
+Only errors classified ``retryable`` (see :mod:`.errors`) are retried —
+a missing backend or a malformed request fails fast. Jitter is full-range
+(AWS architecture-blog style): sleep uniform in [0, base * 2**attempt],
+capped, so synchronized clients (a distributed campaign restarting after a
+coordinator blip) do not stampede.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable
+
+from .errors import classify
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    retries: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 5.0,
+    jitter: bool = True,
+    retry_on: Callable[[BaseException], bool] | None = None,
+    on_retry: Callable[[int, BaseException, float], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Call `fn` with up to `retries` retries on retryable errors.
+
+    ``retry_on`` overrides the default classifier (retry iff
+    ``classify(exc) == 'retryable'``). ``on_retry(attempt, exc, delay)`` is
+    invoked before each sleep — the orchestrator uses it to count retries in
+    the :class:`~.report.SolveReport`. ``sleep`` is injectable for tests.
+    """
+    should_retry = retry_on or (lambda exc: classify(exc) == 'retryable')
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            if attempt >= retries or not should_retry(exc):
+                raise
+            delay = min(max_delay, base_delay * (2.0**attempt))
+            if jitter:
+                delay *= random.random()
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+            attempt += 1

@@ -1,0 +1,411 @@
+"""Fault-injection smoke suite for the reliability subsystem.
+
+Fast, CPU-only (conftest pins JAX_PLATFORMS=cpu): the fallback chain's
+bit-exactness, deadline firing, retry/backoff on transients, circuit
+breakers, checkpointed kill/resume, and regression tests for the netlist
+port-width / prewarm-return / simulate-data satellites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm import solve
+from da4ml_tpu.reliability import (
+    BackendUnavailable,
+    CheckpointCorrupt,
+    CheckpointStore,
+    SolveReport,
+    SolveTimeout,
+    TransientError,
+    breaker_for,
+    classify,
+    fault_injection,
+    kernel_key,
+    reset_all_breakers,
+    reset_store_cache,
+    retry_call,
+    run_program,
+    run_with_deadline,
+    solve_many,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _isolate_reliability_state():
+    reset_all_breakers()
+    reset_store_cache()
+    yield
+    reset_all_breakers()
+    reset_store_cache()
+
+
+def _kernel(rng, n=8, bits=3):
+    return (rng.integers(0, 2**bits, (n, n)) * rng.choice([-1.0, 1.0], (n, n))).astype(np.float64)
+
+
+def _ops_sig(p):
+    return [[(o.id0, o.id1, o.opcode, o.data) for o in st.ops] for st in p.stages]
+
+
+# --------------------------------------------------------------- fallback
+
+
+def test_fallback_chain_bit_exact_vs_native(rng):
+    """JAX disabled by fault injection: solve degrades and the result is
+    bit-identical (ops, cost, outputs) to the direct native/host path."""
+    k = _kernel(rng)
+    rep = SolveReport()
+    with fault_injection('cmvm.jax=unavailable'):
+        degraded = solve(k, backend='jax', report=rep)
+    direct = solve(k, backend='auto', fallback=False)
+
+    assert rep.backend_used in ('native-threads', 'pure-python')
+    assert rep.degraded
+    assert rep.chain == ('jax', 'native-threads', 'pure-python')
+    assert rep.attempts[0].backend == 'jax' and not rep.attempts[0].ok
+    assert rep.attempts[0].error_kind == 'fallback'
+    assert rep.attempts[1].ok
+
+    assert float(degraded.cost) == float(direct.cost)
+    assert _ops_sig(degraded) == _ops_sig(direct)
+    data = rng.uniform(-8, 8, (64, k.shape[0]))
+    np.testing.assert_array_equal(degraded.predict(data, backend='numpy'), direct.predict(data, backend='numpy'))
+
+
+def test_fallback_walks_to_pure_python(rng):
+    """Both device and native backends down: the pure-python reference
+    answers, and the report shows the whole walk."""
+    k = _kernel(rng)
+    rep = SolveReport()
+    with fault_injection('cmvm.jax=unavailable,cmvm.native=unavailable'):
+        degraded = solve(k, backend='jax', report=rep)
+    direct = solve(k, backend='cpu', fallback=False)
+    assert rep.backend_used == 'pure-python'
+    assert [a.backend for a in rep.attempts] == ['jax', 'native-threads', 'pure-python']
+    assert _ops_sig(degraded) == _ops_sig(direct)
+
+
+def test_fault_inject_env_var(rng, monkeypatch):
+    """The DA4ML_FAULT_INJECT env var (not just the context manager) drives
+    the chain — the form subprocess campaigns use."""
+    monkeypatch.setenv('DA4ML_FAULT_INJECT', 'cmvm.jax=unavailable')
+    rep = SolveReport()
+    solve(_kernel(rng), backend='jax', report=rep)
+    assert rep.degraded and rep.backend_used != 'jax'
+
+
+def test_chain_exhaustion_raises(rng):
+    with fault_injection('cmvm.cpu=unavailable'):
+        with pytest.raises(BackendUnavailable, match='all backends failed'):
+            solve(_kernel(rng), backend='cpu', report=SolveReport())
+
+
+def test_fatal_errors_do_not_fall_back():
+    with pytest.raises(ValueError, match='non-empty 2D matrix'):
+        solve(np.zeros((0, 4)), backend='jax')
+
+
+def test_fallback_disabled_raises(rng, monkeypatch):
+    """DA4ML_SOLVE_FALLBACK=0 restores raise-on-failure: the injected
+    device error propagates raw, with no orchestration in the stack."""
+    monkeypatch.setenv('DA4ML_SOLVE_FALLBACK', '0')
+    with fault_injection('cmvm.jax=unavailable'):
+        with pytest.raises(BackendUnavailable, match='injected fault'):
+            solve(_kernel(rng), backend='jax')
+
+
+# --------------------------------------------------------------- deadline
+
+
+def test_deadline_fires_within_2x_budget():
+    t0 = time.monotonic()
+    with pytest.raises(SolveTimeout):
+        run_with_deadline(time.sleep, 0.15, 5.0)
+    assert time.monotonic() - t0 < 0.3
+
+
+def test_solve_deadline_raises_instead_of_hanging(rng):
+    """A (simulated) hung backend with a 0.3s budget raises SolveTimeout
+    within 2x the budget instead of blocking for the full hang."""
+    k = _kernel(rng)
+    t0 = time.monotonic()
+    with fault_injection('cmvm.cpu=sleep:1:3'):
+        with pytest.raises(SolveTimeout):
+            solve(k, backend='cpu', deadline=0.3)
+    assert time.monotonic() - t0 < 0.6
+
+
+def test_deadline_untriggered_returns_result(rng):
+    k = _kernel(rng)
+    rep = SolveReport()
+    sol = solve(k, backend='cpu', deadline=60.0, report=rep)
+    assert rep.backend_used == 'pure-python' and float(sol.cost) > 0
+
+
+# ----------------------------------------------------------------- retry
+
+
+def test_retry_call_backoff_and_jitter():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError('flake')
+        return 'ok'
+
+    assert retry_call(flaky, retries=4, base_delay=0.05, on_retry=lambda a, e, d: delays.append(d), sleep=lambda s: None) == 'ok'
+    assert len(calls) == 3 and len(delays) == 2
+    # full jitter: every delay within the exponential envelope
+    assert 0 <= delays[0] <= 0.05 and 0 <= delays[1] <= 0.1
+
+
+def test_retry_does_not_retry_fatal():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError('malformed request')
+
+    with pytest.raises(ValueError):
+        retry_call(bad, retries=5, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_transient_fault_retried_same_backend(rng):
+    """Two injected transient failures: the solve stays on the requested
+    backend, recording the retries — no degradation."""
+    k = _kernel(rng)
+    rep = SolveReport()
+    with fault_injection('cmvm.cpu=transient:2'):
+        solve(k, backend='cpu', report=rep)
+    assert rep.backend_used == 'pure-python'
+    assert not rep.degraded
+    assert rep.attempts[0].ok and rep.attempts[0].retries == 2
+
+
+def test_classify_taxonomy():
+    assert classify(TransientError('x')) == 'retryable'
+    assert classify(ConnectionError('refused')) == 'retryable'
+    assert classify(RuntimeError('connection reset by peer')) == 'retryable'
+    assert classify(SolveTimeout('x')) == 'fallback'
+    assert classify(BackendUnavailable('x')) == 'fallback'
+    assert classify(RuntimeError('RESOURCE_EXHAUSTED: out of memory')) == 'fallback'
+    assert classify(ImportError('no module named jax')) == 'fallback'
+    assert classify(ValueError('bad shape')) == 'fatal'
+
+
+# --------------------------------------------------------------- breaker
+
+
+def test_circuit_breaker_opens_and_skips(rng):
+    k = _kernel(rng)
+    with fault_injection('cmvm.jax=unavailable:100'):
+        for _ in range(3):  # default fail_threshold
+            solve(k, backend='jax')
+        rep = SolveReport()
+        solve(k, backend='jax', report=rep)
+    assert breaker_for('jax').state == 'open'
+    assert rep.attempts[0].backend == 'jax' and rep.attempts[0].error_kind == 'skipped'
+    assert rep.backend_used in ('native-threads', 'pure-python')
+
+
+def test_circuit_breaker_half_open_probe_recovers():
+    br = breaker_for('probe-test', fail_threshold=2, reset_after=0.05)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == 'open' and not br.allow()
+    time.sleep(0.06)
+    assert br.state == 'half-open'
+    assert br.allow()  # the probe slot
+    assert not br.allow()  # only one probe at a time
+    br.record_success()
+    assert br.state == 'closed' and br.allow()
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def test_checkpoint_resume_after_kill(rng, tmp_path):
+    """Kill a campaign child right after its first durable record; the
+    resumed run must produce results identical to an uninterrupted one
+    (the tests/multiproc_worker.py child pattern)."""
+    ckpt = tmp_path / 'campaign.json'
+    child = tmp_path / 'child.py'
+    child.write_text(
+        'import json, sys\n'
+        f'sys.path.insert(0, {str(REPO_ROOT)!r})\n'
+        'import numpy as np\n'
+        'from da4ml_tpu.reliability import solve_many\n'
+        'rng = np.random.default_rng(7)\n'
+        'ks = [(rng.integers(0, 8, (6, 6)) * rng.choice([-1.0, 1.0], (6, 6))) for _ in range(3)]\n'
+        f'res, rep = solve_many(ks, backend="cpu", checkpoint={str(ckpt)!r})\n'
+        'print(json.dumps({"n": len(res), "hits": rep.checkpoint_hits}))\n'
+    )
+    env = dict(os.environ, DA4ML_FAULT_INJECT='checkpoint.post_save=kill:1')
+    r1 = subprocess.run([sys.executable, str(child)], capture_output=True, text=True, timeout=120, env=env)
+    assert r1.returncode != 0, 'child should have been hard-killed'
+    assert len(CheckpointStore(ckpt).records) == 1, 'exactly the first result should be durable'
+
+    env2 = {k: v for k, v in os.environ.items() if k != 'DA4ML_FAULT_INJECT'}
+    r2 = subprocess.run([sys.executable, str(child)], capture_output=True, text=True, timeout=120, env=env2)
+    assert r2.returncode == 0, r2.stderr[-1000:]
+    out = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out == {'n': 3, 'hits': 1}
+
+    # uninterrupted reference run, same campaign definition
+    rng7 = np.random.default_rng(7)
+    ks = [(rng7.integers(0, 8, (6, 6)) * rng7.choice([-1.0, 1.0], (6, 6))) for _ in range(3)]
+    fresh, _ = solve_many(ks, backend='cpu')
+    store = CheckpointStore(ckpt)
+    resumed = sorted(json.dumps(rec['pipeline'], sort_keys=True) for rec in store.records.values())
+    expect = sorted(json.dumps(p.to_dict(), sort_keys=True) for p in fresh)
+    assert resumed == expect
+
+
+def test_checkpoint_atomic_and_keyed(rng, tmp_path):
+    ckpt = tmp_path / 'ck.json'
+    k = _kernel(rng)
+    rep1 = SolveReport()
+    sol1 = solve(k, backend='cpu', checkpoint=ckpt, report=rep1)
+    assert rep1.checkpoint_misses == 1 and rep1.checkpoint_hits == 0
+    reset_store_cache()  # force a re-read from disk
+    rep2 = SolveReport()
+    sol2 = solve(k, backend='cpu', checkpoint=ckpt, report=rep2)
+    assert rep2.checkpoint_hits == 1 and rep2.checkpoint_misses == 0
+    assert _ops_sig(sol1) == _ops_sig(sol2)
+    # a different option set must miss (key covers kernel AND options)
+    rep3 = SolveReport()
+    solve(k, backend='cpu', hard_dc=2, checkpoint=ckpt, report=rep3)
+    assert rep3.checkpoint_misses == 1
+    assert kernel_key(k, {'a': 1}) != kernel_key(k, {'a': 2})
+
+
+def test_checkpoint_corrupt_quarantine_and_strict(tmp_path):
+    ckpt = tmp_path / 'bad.json'
+    ckpt.write_text('{"version": 1, "records": {tr')  # torn write
+    with pytest.raises(CheckpointCorrupt):
+        CheckpointStore(ckpt, strict=True)
+    store = CheckpointStore(ckpt)  # non-strict: quarantine + fresh start
+    assert store.recovered_corrupt and len(store.records) == 0
+    assert (tmp_path / 'bad.json.corrupt').exists()
+
+
+def test_checkpoint_injected_corrupt_write_recovers(tmp_path):
+    ckpt = tmp_path / 'c.json'
+    store = CheckpointStore(ckpt)
+    with fault_injection('checkpoint.write=corrupt:1'):
+        store.put('k1', {'v': 1})  # this flush writes torn JSON
+    reset_store_cache()
+    reread = CheckpointStore(ckpt)
+    assert reread.recovered_corrupt and 'k1' not in reread
+    store2 = CheckpointStore(ckpt)
+    store2.put('k2', {'v': 2})
+    assert CheckpointStore(ckpt).get('k2') == {'v': 2}
+
+
+# ------------------------------------------------------- runtime chain
+
+
+def test_run_program_degrades_bit_exact(rng):
+    k = _kernel(rng, n=6)
+    comb = solve(k, backend='cpu', fallback=False).stages[0]
+    binary = comb.to_binary()
+    data = rng.uniform(-8, 8, (32, 6))
+    from da4ml_tpu.runtime.numpy_backend import run_binary as run_np
+
+    golden = run_np(binary, data)
+    rep = SolveReport()
+    with fault_injection('runtime.jax=unavailable'):
+        out = run_program(binary, data, report=rep)
+    assert rep.backend_used in ('cpp', 'numpy') and rep.degraded
+    np.testing.assert_array_equal(out, golden)
+
+
+# ------------------------------------------------- satellite regressions
+
+
+def test_netlist_sim_rejects_unparsed_ports():
+    from da4ml_tpu.codegen.rtl.verilog.netlist_sim import VerilogNetlistSim, VerilogPipelineSim
+    from da4ml_tpu.codegen.rtl.vhdl.netlist_sim import VHDLNetlistSim, VHDLPipelineSim
+
+    with pytest.raises(ValueError, match='Unparsed module ports'):
+        VerilogNetlistSim('module m(inp, out);\nendmodule', {})
+    with pytest.raises(ValueError, match='Unparsed pipelined top ports'):
+        VerilogPipelineSim('module top(clk, inp, out);\nendmodule', [], {})
+    with pytest.raises(ValueError, match='Unparsed entity ports'):
+        VHDLNetlistSim('entity e is end entity;\narchitecture rtl of e is\nbegin\nend architecture;', {})
+    with pytest.raises(ValueError, match='Unparsed VHDL top ports'):
+        VHDLPipelineSim('entity t is end entity;\narchitecture rtl of t is\nbegin\nend architecture;', [], {})
+
+
+def test_prewarm_returns_queued_flag(monkeypatch, rng):
+    import da4ml_tpu.cmvm.jax_search as js
+
+    submitted = []
+    monkeypatch.setattr(js, '_prewarm_enabled', lambda: True)
+    monkeypatch.setattr(js, '_prewarm_submit', lambda job: submitted.append(job))
+    assert js.prewarm_for_kernels([[_kernel(rng)]]) == 1
+    assert len(submitted) == 1
+    assert js.prewarm_for_kernels([]) == 0
+    assert js.prewarm_for_kernels([[]]) == 0
+    monkeypatch.setattr(js, '_prewarm_enabled', lambda: False)
+    assert js.prewarm_for_kernels([[_kernel(rng)]]) == 0
+
+
+def test_simulate_requires_data():
+    from da4ml_tpu.codegen.rtl.verilog.netlist_sim import simulate_comb, simulate_pipeline
+    from da4ml_tpu.codegen.rtl.vhdl.netlist_sim import simulate_comb_vhdl, simulate_pipeline_vhdl
+
+    for fn in (simulate_comb, simulate_pipeline, simulate_comb_vhdl, simulate_pipeline_vhdl):
+        with pytest.raises(ValueError, match='data batch, got None'):
+            fn(None, data=None)
+
+
+# ---------------------------------------------------------- CLI surface
+
+
+def test_convert_cli_accepts_reliability_flags():
+    from da4ml_tpu._cli.convert import add_convert_args
+    import argparse
+
+    p = argparse.ArgumentParser()
+    add_convert_args(p)
+    args = p.parse_args(['m.json', 'out', '--deadline', '2.5', '--fallback', 'off', '--resume', 'ck.json'])
+    assert args.deadline == 2.5 and args.fallback == 'off' and args.resume == Path('ck.json')
+
+
+def test_tracer_batched_jax_degrades(rng):
+    """A device failure inside the tracer's batched matmul path degrades to
+    the host chain instead of losing the trace."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    w = rng.integers(-4, 4, (4, 3)).astype(np.float64)
+
+    def _trace():
+        inp = FixedVariableArrayInput((2, 4), hwconf=HWConfig(1, -1, -1), solver_options={'backend': 'jax'})
+        # distinct per-row precisions -> >1 unique metadata group -> the
+        # batched solve_jax_many path (the one _solve_jax_many_guarded wraps)
+        f = np.stack([np.full(4, 2), np.full(4, 3)])
+        x = inp.quantize(np.ones((2, 4)), np.full((2, 4), 3), f)
+        return comb_trace(inp, x @ w)
+
+    golden = _trace()  # healthy device path (cpu-XLA here)
+    with fault_injection('cmvm.jax=unavailable:100'):
+        with pytest.warns(RuntimeWarning, match='degrading'):
+            degraded = _trace()
+    data = rng.uniform(-4, 4, (16, 8))
+    np.testing.assert_array_equal(
+        degraded.predict(data, backend='numpy'), golden.predict(data, backend='numpy')
+    )
