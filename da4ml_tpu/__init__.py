@@ -20,6 +20,7 @@ __all__ = [
     'minimal_kif',
     'solve',
     'trace_model',
+    'verify',
     '__version__',
 ]
 
@@ -34,4 +35,8 @@ def __getattr__(name):
         from .converter import trace_model
 
         return trace_model
+    if name == 'verify':
+        from .analysis import verify
+
+        return verify
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

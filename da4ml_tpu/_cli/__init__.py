@@ -7,7 +7,10 @@ src/da4ml/_cli/__init__.py:8-27):
   CombLogic/Pipeline .json) → RTL/HLS project with optional bit-exact
   validation;
 - ``report`` — parse vendor synthesis reports from project directories into
-  a summary table.
+  a summary table;
+- ``verify`` — run the DAIS static-analysis verifier over saved programs or
+  generated project directories (docs/analysis.md);
+- ``warmup`` — pre-compile the device-search shape classes.
 """
 
 from __future__ import annotations
@@ -36,6 +39,12 @@ def main(argv: list[str] | None = None) -> int:
     p_warm = sub.add_parser('warmup', help='Pre-compile the device-search shape classes into the XLA cache')
     add_warmup_args(p_warm)
     p_warm.set_defaults(func=warmup_main)
+
+    from .verify import add_verify_args, verify_main
+
+    p_verify = sub.add_parser('verify', help='Statically verify saved DAIS programs (well-formedness, intervals, lint)')
+    add_verify_args(p_verify)
+    p_verify.set_defaults(func=verify_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

@@ -1,0 +1,81 @@
+"""``da4ml-tpu verify`` — static analysis of saved DAIS programs.
+
+Runs the verifier passes (docs/analysis.md) over one or more saved programs:
+a ``CombLogic``/``Pipeline`` ``.json`` file, or a generated project directory
+(the embedded ``model/comb.json`` / ``model/pipeline.json`` is used). Exits
+non-zero when any program has errors (or warnings, with ``--strict``), so it
+slots directly into CI::
+
+    da4ml-tpu verify examples/kernels/*.json
+    da4ml-tpu verify build/my_project --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def add_verify_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument('paths', nargs='+', type=Path, help='saved program .json files or project directories')
+    parser.add_argument('--json', action='store_true', dest='as_json', help='emit machine-readable JSON diagnostics')
+    parser.add_argument('--strict', action='store_true', help='exit non-zero on warnings as well as errors')
+    parser.add_argument('--no-warnings', action='store_true', help='hide warnings from the text output')
+    parser.add_argument(
+        '--passes',
+        default=None,
+        help='comma-separated pass subset to run (default: all); available: wellformed,qinterval,deadcode',
+    )
+
+
+def _resolve_program_file(path: Path) -> Path:
+    if path.is_dir():
+        for candidate in (path / 'model' / 'pipeline.json', path / 'model' / 'comb.json'):
+            if candidate.is_file():
+                return candidate
+        raise FileNotFoundError(f'{path} contains no model/pipeline.json or model/comb.json')
+    return path
+
+
+def _load_program(path: Path):
+    """Load without the on-load verification — the point is to report
+    structured diagnostics, not to crash in ``from_dict``."""
+    from ..ir import CombLogic, Pipeline
+
+    blob = json.loads(path.read_text())
+    if isinstance(blob, dict) and 'stages' in blob:
+        return Pipeline.from_dict(blob, verify=False)
+    return CombLogic.from_dict(blob, verify=False)
+
+
+def verify_main(args: argparse.Namespace) -> int:
+    from ..analysis import verify
+
+    passes = None
+    if args.passes:
+        passes = tuple(p.strip() for p in args.passes.split(',') if p.strip())
+
+    results = []
+    rc = 0
+    for raw_path in args.paths:
+        try:
+            path = _resolve_program_file(raw_path)
+            program = _load_program(path)
+        except Exception as e:  # unreadable/corrupt beyond parsing
+            results.append({'target': str(raw_path), 'ok': False, 'load_error': f'{type(e).__name__}: {e}'})
+            rc = max(rc, 2)
+            if not args.as_json:
+                print(f'{raw_path}: LOAD FAILED ({type(e).__name__}: {e})')
+            continue
+
+        result = verify(program, passes=passes, target=str(raw_path))
+        results.append(result.to_dict())
+        if not result.ok or (args.strict and result.warnings):
+            rc = max(rc, 1)
+        if not args.as_json:
+            print(result.format_text(show_warnings=not args.no_warnings))
+
+    if args.as_json:
+        print(json.dumps(results if len(results) > 1 else results[0], indent=2))
+    return rc

@@ -1,0 +1,66 @@
+"""Static analysis of DAIS programs: IR verifier & lint framework.
+
+Three passes over ``CombLogic`` / ``Pipeline`` (docs/analysis.md):
+
+- **wellformed** — SSA causality, opcode table membership, payload ranges,
+  io-binding consistency, pipeline stage interfaces;
+- **qinterval** — abstract interpretation recomputing every op's value
+  interval and flagging unsound annotations (overflow hazards), bad steps,
+  and precision loss;
+- **deadcode** — unreachable ops, negative/NaN latency or cost, latency
+  monotonicity.
+
+Entry points: :func:`verify` (full diagnostics), :func:`verify_or_raise`
+(fail-fast, used by codegen preconditions and the ``DA4ML_VERIFY=1``
+post-solve hook), the ``da4ml-tpu verify`` CLI subcommand, and the
+:mod:`.mutation` corruption harness for self-tests.
+"""
+
+from .deadcode import check_deadcode, live_ops
+from .diagnostics import ERROR, INFO, RULES, WARNING, Diagnostic, VerificationError, VerifyResult
+from .interval import check_intervals, is_pow2, representable
+from .mutation import (
+    COMB_CORRUPTIONS,
+    PIPELINE_CORRUPTIONS,
+    Corruption,
+    apply_planned_corruptions,
+    corruption_by_name,
+)
+from .runner import (
+    PASSES,
+    codegen_verify_enabled,
+    post_solve_verify_enabled,
+    verify,
+    verify_comb,
+    verify_or_raise,
+)
+from .wellformed import DAIS_V1_OPCODES, check_pipeline_interfaces, check_wellformed
+
+__all__ = [
+    'Diagnostic',
+    'VerifyResult',
+    'VerificationError',
+    'RULES',
+    'ERROR',
+    'WARNING',
+    'INFO',
+    'PASSES',
+    'verify',
+    'verify_comb',
+    'verify_or_raise',
+    'post_solve_verify_enabled',
+    'codegen_verify_enabled',
+    'check_wellformed',
+    'check_pipeline_interfaces',
+    'check_intervals',
+    'check_deadcode',
+    'live_ops',
+    'is_pow2',
+    'representable',
+    'DAIS_V1_OPCODES',
+    'COMB_CORRUPTIONS',
+    'PIPELINE_CORRUPTIONS',
+    'Corruption',
+    'apply_planned_corruptions',
+    'corruption_by_name',
+]

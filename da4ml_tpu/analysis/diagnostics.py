@@ -1,0 +1,172 @@
+"""Structured diagnostics for the DAIS static-analysis framework.
+
+Every finding a pass emits is a :class:`Diagnostic`: a stable rule id from
+the catalog below, a severity, the op index it anchors to (when applicable)
+and a human-readable message. Diagnostics are plain data — JSON-serializable
+via :meth:`Diagnostic.to_dict` — so the CLI, the post-solve hook and CI can
+all consume the same objects.
+
+Rule catalog (docs/analysis.md keeps the user-facing copy):
+
+======  ==================  ========  =============================================
+id      name                severity  meaning
+======  ==================  ========  =============================================
+W101    shape-mismatch      error     io binding arrays inconsistent with ``shape``
+W102    unknown-opcode      error     opcode not in the DAIS v1 table
+W103    operand-violation   error     operand slot out of range or not earlier (SSA)
+W104    input-lane          error     copy op reads a non-existent input lane
+W105    output-binding      error     output bound to a non-existent op slot
+W106    shift-range         error     implausible power-of-two shift magnitude
+W110    lut-binding         error     lookup references a missing/invalid table
+W111    bitwise-subop       error     unknown bitwise sub-opcode
+W120    stage-interface     error     pipeline stage widths do not chain
+Q201    step-not-pow2       error     ``QInterval.step`` not a positive power of two
+Q202    interval-bounds     error     NaN/inf interval bound, or min > max
+Q210    interval-unsound    error     annotation cannot hold the computed interval
+Q220    precision-loss      warning   quantize op drops bits vs its operand
+Q221    lut-interval        warning   lookup annotation disagrees with its table
+D301    dead-op             warning   op result never reaches an output
+D302    cost-model          error     negative/NaN latency or cost
+D303    latency-monotone    warning   op latency below an operand's latency
+======  ==================  ========  =============================================
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+ERROR = 'error'
+WARNING = 'warning'
+INFO = 'info'
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+#: rule id -> (short name, default severity)
+RULES: dict[str, tuple[str, str]] = {
+    'W101': ('shape-mismatch', ERROR),
+    'W102': ('unknown-opcode', ERROR),
+    'W103': ('operand-violation', ERROR),
+    'W104': ('input-lane', ERROR),
+    'W105': ('output-binding', ERROR),
+    'W106': ('shift-range', ERROR),
+    'W110': ('lut-binding', ERROR),
+    'W111': ('bitwise-subop', ERROR),
+    'W120': ('stage-interface', ERROR),
+    'Q201': ('step-not-pow2', ERROR),
+    'Q202': ('interval-bounds', ERROR),
+    'Q210': ('interval-unsound', ERROR),
+    'Q220': ('precision-loss', WARNING),
+    'Q221': ('lut-interval', WARNING),
+    'D301': ('dead-op', WARNING),
+    'D302': ('cost-model', ERROR),
+    'D303': ('latency-monotone', WARNING),
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding of a verifier pass."""
+
+    rule: str
+    message: str
+    op_index: int | None = None
+    stage: int | None = None
+    severity: str = field(default='')
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f'unknown rule id {self.rule!r}')
+        if not self.severity:
+            object.__setattr__(self, 'severity', RULES[self.rule][1])
+        elif self.severity not in _SEVERITY_ORDER:
+            raise ValueError(f'unknown severity {self.severity!r}')
+
+    @property
+    def name(self) -> str:
+        return RULES[self.rule][0]
+
+    def to_dict(self) -> dict:
+        return {
+            'rule': self.rule,
+            'name': self.name,
+            'severity': self.severity,
+            'stage': self.stage,
+            'op': self.op_index,
+            'message': self.message,
+        }
+
+    def __str__(self) -> str:
+        where = ''
+        if self.stage is not None:
+            where += f'stage {self.stage} '
+        if self.op_index is not None:
+            where += f'op {self.op_index} '
+        return f'{self.severity.upper()} {self.rule} [{self.name}] {where.strip()}: {self.message}'.replace(' :', ':')
+
+
+class VerifyResult:
+    """Outcome of running the verifier: an ordered list of diagnostics."""
+
+    def __init__(self, diagnostics: list[Diagnostic], target: str = 'program'):
+        self.diagnostics = list(diagnostics)
+        self.target = target
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    @property
+    def ok(self) -> bool:
+        """No errors (warnings/info allowed)."""
+        return not self.errors
+
+    def by_rule(self, rule: str) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    def sorted(self) -> list[Diagnostic]:
+        return sorted(
+            self.diagnostics,
+            key=lambda d: (_SEVERITY_ORDER[d.severity], d.stage or 0, d.op_index if d.op_index is not None else -1),
+        )
+
+    def summary(self) -> str:
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        verdict = 'FAILED' if n_err else 'ok'
+        return f'{self.target}: {verdict} ({n_err} error(s), {n_warn} warning(s))'
+
+    def format_text(self, show_warnings: bool = True) -> str:
+        lines = [self.summary()]
+        for d in self.sorted():
+            if d.severity != ERROR and not show_warnings:
+                continue
+            lines.append(f'  {d}')
+        return '\n'.join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            'target': self.target,
+            'ok': self.ok,
+            'n_errors': len(self.errors),
+            'n_warnings': len(self.warnings),
+            'diagnostics': [d.to_dict() for d in self.sorted()],
+        }
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def __repr__(self) -> str:
+        return f'VerifyResult({self.summary()})'
+
+
+class VerificationError(ValueError):
+    """A DAIS program failed verification. Carries the full result."""
+
+    def __init__(self, result: VerifyResult, context: str = ''):
+        self.result = result
+        prefix = f'{context}: ' if context else ''
+        super().__init__(prefix + result.format_text(show_warnings=False))

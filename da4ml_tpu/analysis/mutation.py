@@ -1,0 +1,198 @@
+"""Deterministic IR corruption harness for verifier self-tests.
+
+Each :class:`Corruption` damages one field of one op of a given DAIS opcode
+family and names the verifier rule that must catch it. Corruptions are wired
+into the fault-injection plan machinery (reliability/faults.py): site
+``ir.mutate.<name>`` with mode ``corrupt`` arms one corruption, so a chaos
+drill can corrupt programs exactly the way it degrades backends::
+
+    with fault_injection('ir.mutate.add.forward_ref=corrupt:1'):
+        prog = apply_planned_corruptions(prog)   # mutates iff armed
+
+    verify(prog)   # -> W103 operand-violation
+
+The mutation self-test (tests/test_verifier.py) asserts every catalog entry
+is caught with a structured diagnostic; the catalog covers every opcode
+family of the DAIS v1 table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import nan
+from typing import Callable
+
+from ..ir.comb import CombLogic, Pipeline
+from ..ir.types import QInterval
+from ..reliability.faults import fault_active
+
+FAULT_SITE_PREFIX = 'ir.mutate.'
+
+
+def _find(comb: CombLogic, opcodes: tuple[int, ...]) -> int:
+    for i, op in enumerate(comb.ops):
+        if op.opcode in opcodes:
+            return i
+    raise ValueError(f'program has no op with opcode in {opcodes}; cannot apply corruption')
+
+
+def _mutate_op(comb: CombLogic, opcodes: tuple[int, ...], **fields) -> CombLogic:
+    i = _find(comb, opcodes)
+    ops = list(comb.ops)
+    ops[i] = ops[i]._replace(**fields)
+    return comb._replace(ops=ops)
+
+
+def _mutate_qint(comb: CombLogic, opcodes: tuple[int, ...], fn: Callable[[QInterval], QInterval]) -> CombLogic:
+    i = _find(comb, opcodes)
+    ops = list(comb.ops)
+    ops[i] = ops[i]._replace(qint=fn(ops[i].qint))
+    return comb._replace(ops=ops)
+
+
+def _self_reference(comb: CombLogic, opcodes: tuple[int, ...], field: str) -> CombLogic:
+    i = _find(comb, opcodes)
+    ops = list(comb.ops)
+    ops[i] = ops[i]._replace(**{field: i})
+    return comb._replace(ops=ops)
+
+
+def _corrupt_mux_cond(comb: CombLogic) -> CombLogic:
+    i = _find(comb, (6, -6))
+    ops = list(comb.ops)
+    data = int(ops[i].data)
+    shift = data >> 32  # keep the shift word, repoint the condition at self
+    ops[i] = ops[i]._replace(data=(shift << 32) | i)
+    return comb._replace(ops=ops)
+
+
+def _corrupt_bitbin_subop(comb: CombLogic) -> CombLogic:
+    i = _find(comb, (10,))
+    ops = list(comb.ops)
+    data = int(ops[i].data)
+    ops[i] = ops[i]._replace(data=(9 << 56) | (data & ((1 << 56) - 1)))
+    return comb._replace(ops=ops)
+
+
+def _corrupt_outputs_dead(comb: CombLogic) -> CombLogic:
+    copy = _find(comb, (-1,))
+    return comb._replace(out_idxs=[copy] * len(comb.out_idxs))
+
+
+def _corrupt_out_binding(comb: CombLogic) -> CombLogic:
+    out = list(comb.out_idxs)
+    out[0] = len(comb.ops) + 5
+    return comb._replace(out_idxs=out)
+
+
+def _corrupt_inp_shifts(comb: CombLogic) -> CombLogic:
+    return comb._replace(inp_shifts=list(comb.inp_shifts)[:-1])
+
+
+def _corrupt_stage_interface(pipe: Pipeline) -> Pipeline:
+    s0 = pipe.stages[0]
+    s0 = s0._replace(
+        shape=(s0.shape[0], s0.shape[1] - 1),
+        out_idxs=list(s0.out_idxs)[:-1],
+        out_shifts=list(s0.out_shifts)[:-1],
+        out_negs=list(s0.out_negs)[:-1],
+    )
+    return Pipeline(stages=(s0,) + pipe.stages[1:])
+
+
+@dataclass(frozen=True)
+class Corruption:
+    """One catalogued IR corruption: what it damages and who must catch it."""
+
+    name: str  # fault site suffix, e.g. 'add.forward_ref'
+    family: str  # DAIS opcode family it targets
+    expect_rule: str  # verifier rule id that must flag it
+    apply: Callable  # CombLogic -> CombLogic (or Pipeline -> Pipeline)
+
+
+COMB_CORRUPTIONS: tuple[Corruption, ...] = (
+    Corruption('copy.bad_lane', 'copy', 'W104', lambda c: _mutate_op(c, (-1,), id0=c.shape[0] + 7)),
+    Corruption('add.forward_ref', 'add/sub', 'W103', lambda c: _self_reference(c, (0, 1), 'id1')),
+    Corruption('add.bad_shift', 'add/sub', 'W106', lambda c: _mutate_op(c, (0, 1), data=3000)),
+    Corruption(
+        'relu.step_not_pow2',
+        'relu-quantize',
+        'Q201',
+        lambda c: _mutate_qint(c, (2, -2), lambda q: QInterval(q.min, q.max, q.step * 0.75)),
+    ),
+    Corruption(
+        'quantize.inverted_bounds',
+        'quantize',
+        'Q202',
+        lambda c: _mutate_qint(c, (3, -3), lambda q: QInterval(q.max + 1.0, q.min, q.step)),
+    ),
+    Corruption(
+        'cadd.bias_drift',
+        'const-add',
+        'Q210',
+        lambda c: _mutate_op(c, (4,), data=int(c.ops[_find(c, (4,))].data) + (1 << 16)),
+    ),
+    Corruption(
+        'const.value_drift',
+        'const',
+        'Q210',
+        lambda c: _mutate_op(c, (5,), data=int(c.ops[_find(c, (5,))].data) + (1 << 16) + 1),
+    ),
+    Corruption('mux.cond_forward', 'msb-mux', 'W103', _corrupt_mux_cond),
+    Corruption(
+        'mul.narrowed_interval',
+        'mul',
+        'Q210',
+        lambda c: _mutate_qint(c, (7,), lambda q: QInterval(q.min / 64.0, q.max / 64.0, q.step)),
+    ),
+    Corruption('lut.bad_table', 'lut', 'W110', lambda c: _mutate_op(c, (8,), data=99)),
+    Corruption('bit_unary.bad_subop', 'unary-bitwise', 'W111', lambda c: _mutate_op(c, (9, -9), data=7)),
+    Corruption('bit_binary.bad_subop', 'binary-bitwise', 'W111', _corrupt_bitbin_subop),
+    Corruption('any.unknown_opcode', 'any', 'W102', lambda c: _mutate_op(c, (0, 1), opcode=42)),
+    Corruption('any.nan_latency', 'any', 'D302', lambda c: _mutate_op(c, (0, 1), latency=nan)),
+    Corruption('any.negative_cost', 'any', 'D302', lambda c: _mutate_op(c, (2, -2, 3, -3), cost=-1.0)),
+    Corruption('io.out_of_range_output', 'io', 'W105', _corrupt_out_binding),
+    Corruption('io.truncated_inp_shifts', 'io', 'W101', _corrupt_inp_shifts),
+    Corruption('io.dead_subgraph', 'io', 'D301', _corrupt_outputs_dead),
+)
+
+PIPELINE_CORRUPTIONS: tuple[Corruption, ...] = (
+    Corruption('pipeline.stage_interface', 'pipeline', 'W120', _corrupt_stage_interface),
+)
+
+
+def corruption_by_name(name: str) -> Corruption:
+    for c in COMB_CORRUPTIONS + PIPELINE_CORRUPTIONS:
+        if c.name == name:
+            return c
+    raise KeyError(f'unknown corruption {name!r}')
+
+
+def apply_planned_corruptions(program: CombLogic | Pipeline):
+    """Apply every corruption armed through the active fault plan.
+
+    Consults ``fault_active('ir.mutate.<name>', 'corrupt')`` for each catalog
+    entry — the reliability fault plan (env var or :class:`fault_injection`)
+    decides which corruptions fire, and their firing budgets.
+    """
+    catalog = PIPELINE_CORRUPTIONS if isinstance(program, Pipeline) else COMB_CORRUPTIONS
+    for c in catalog:
+        if fault_active(FAULT_SITE_PREFIX + c.name, 'corrupt'):
+            program = c.apply(program)
+    if isinstance(program, Pipeline):
+        stages = list(program.stages)
+        for c in COMB_CORRUPTIONS:
+            if fault_active(FAULT_SITE_PREFIX + c.name, 'corrupt'):
+                stages[0] = c.apply(stages[0])
+        program = Pipeline(stages=tuple(stages))
+    return program
+
+
+__all__ = [
+    'COMB_CORRUPTIONS',
+    'PIPELINE_CORRUPTIONS',
+    'FAULT_SITE_PREFIX',
+    'Corruption',
+    'apply_planned_corruptions',
+    'corruption_by_name',
+]

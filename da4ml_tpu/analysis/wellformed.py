@@ -1,0 +1,153 @@
+"""Well-formedness pass: structural SSA validity of a DAIS program.
+
+Checks that the program is executable at all — every operand reference names
+an earlier buffer slot (SSA causality), every opcode is in the DAIS v1 table
+(ir/types.py), packed payloads (mux condition/shift, bitwise sub-opcodes,
+lookup table indices) are in range, and the io binding arrays are consistent
+with ``shape``. Runs in O(n_ops); the other passes assume a program that
+passed this one (the runner feeds them the set of structurally-bad ops to
+skip).
+"""
+
+from __future__ import annotations
+
+from ..ir.comb import CombLogic, Pipeline, _i32
+from ..ir.types import Op
+from .diagnostics import Diagnostic
+
+#: every opcode of the DAIS v1 table (docs/dais.md)
+DAIS_V1_OPCODES = frozenset((-1, 0, 1, 2, -2, 3, -3, 4, 5, 6, -6, 7, 8, 9, -9, 10))
+
+#: opcodes whose id1 names a second operand slot
+_BINARY_OPCODES = frozenset((0, 1, 6, -6, 7, 10))
+
+#: largest plausible power-of-two shift in an op payload (DAIS values are
+#: fixed-point with at most a few hundred bits; anything beyond is corruption
+#: and would overflow float replay)
+SHIFT_LIMIT = 256
+
+_UNARY_BIT_SUBOPS = (0, 1, 2)  # NOT, OR-reduce, AND-reduce
+_BINARY_BIT_SUBOPS = (0, 1, 2)  # AND, OR, XOR
+
+
+def op_shift(op: Op) -> int | None:
+    """The power-of-two shift an op applies to its second operand, if any."""
+    if op.opcode in (0, 1):
+        return int(op.data)
+    if op.opcode in (6, -6):
+        return _i32(int(op.data) >> 32)
+    if op.opcode == 10:
+        return _i32(int(op.data))
+    return None
+
+
+def op_operands(op: Op) -> list[int]:
+    """Buffer slots an op reads (input lanes of copy ops are *not* slots)."""
+    reads = []
+    if op.opcode == -1 or op.opcode == 5:
+        return reads
+    reads.append(int(op.id0))
+    if op.opcode in _BINARY_OPCODES:
+        reads.append(int(op.id1))
+    if op.opcode in (6, -6):
+        reads.append(int(op.data) & 0xFFFFFFFF)
+    return reads
+
+
+def check_wellformed(comb: CombLogic, stage: int | None = None) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+
+    def emit(rule: str, message: str, op_index: int | None = None):
+        diags.append(Diagnostic(rule, message, op_index=op_index, stage=stage))
+
+    # ---- container-level consistency
+    n_in, n_out = (int(v) for v in comb.shape)
+    if n_in <= 0 or n_out <= 0:
+        emit('W101', f'shape must be positive, got ({n_in}, {n_out})')
+    if len(comb.inp_shifts) != n_in:
+        emit('W101', f'inp_shifts has {len(comb.inp_shifts)} entries for {n_in} inputs')
+    if not (len(comb.out_idxs) == len(comb.out_shifts) == len(comb.out_negs) == n_out):
+        emit(
+            'W101',
+            f'output bindings have {len(comb.out_idxs)}/{len(comb.out_shifts)}/{len(comb.out_negs)} '
+            f'entries for {n_out} outputs',
+        )
+
+    n_ops = len(comb.ops)
+    n_tables = len(comb.lookup_tables) if comb.lookup_tables is not None else 0
+
+    # ---- per-op checks
+    for i, op in enumerate(comb.ops):
+        if op.opcode not in DAIS_V1_OPCODES:
+            emit('W102', f'opcode {op.opcode} is not in the DAIS v1 table', i)
+            continue
+
+        if op.opcode == -1:
+            lane = int(op.id0)
+            if not 0 <= lane < n_in:
+                emit('W104', f'copy op reads input lane {lane}, program has {n_in} inputs', i)
+        else:
+            for slot in op_operands(op):
+                if not 0 <= slot < i:
+                    which = 'condition' if op.opcode in (6, -6) and slot not in (op.id0, op.id1) else 'operand'
+                    emit('W103', f'{which} slot {slot} is not an earlier SSA slot (op is at slot {i})', i)
+
+        shift = op_shift(op)
+        if shift is not None and abs(shift) > SHIFT_LIMIT:
+            emit('W106', f'shift {shift} exceeds the plausible range +-{SHIFT_LIMIT}', i)
+
+        if op.opcode == 8:
+            tbl = int(op.data)
+            if comb.lookup_tables is None:
+                emit('W110', f'lookup op references table {tbl} but the program carries no tables', i)
+            elif not 0 <= tbl < n_tables:
+                emit('W110', f'lookup op references table {tbl}, program has {n_tables} tables', i)
+        elif op.opcode in (9, -9) and int(op.data) not in _UNARY_BIT_SUBOPS:
+            emit('W111', f'unary bitwise sub-opcode {int(op.data)} (valid: 0=NOT, 1=OR-reduce, 2=AND-reduce)', i)
+        elif op.opcode == 10:
+            subop = (int(op.data) >> 56) & 0xFF
+            if subop not in _BINARY_BIT_SUBOPS:
+                emit('W111', f'binary bitwise sub-opcode {subop} (valid: 0=AND, 1=OR, 2=XOR)', i)
+
+    # ---- output bindings (out_idx == -1 marks an intentionally dead lane)
+    for j, idx in enumerate(comb.out_idxs):
+        idx = int(idx)
+        if idx != -1 and not 0 <= idx < n_ops:
+            emit('W105', f'output {j} bound to slot {idx}, program has {n_ops} ops')
+
+    return diags
+
+
+def check_pipeline_interfaces(pipeline: Pipeline) -> list[Diagnostic]:
+    """Stage-to-stage interface consistency of a Pipeline."""
+    diags: list[Diagnostic] = []
+    if not pipeline.stages:
+        return [Diagnostic('W101', 'pipeline has no stages')]
+    for si in range(len(pipeline.stages) - 1):
+        n_out = int(pipeline.stages[si].shape[1])
+        n_in = int(pipeline.stages[si + 1].shape[0])
+        if n_out != n_in:
+            diags.append(
+                Diagnostic(
+                    'W120',
+                    f'stage {si} produces {n_out} outputs but stage {si + 1} expects {n_in} inputs',
+                    stage=si,
+                )
+            )
+    return diags
+
+
+def bad_op_indices(diags: list[Diagnostic]) -> frozenset[int]:
+    """Op slots with structural errors — downstream passes skip these."""
+    return frozenset(d.op_index for d in diags if d.op_index is not None and d.severity == 'error')
+
+
+__all__ = [
+    'DAIS_V1_OPCODES',
+    'SHIFT_LIMIT',
+    'check_wellformed',
+    'check_pipeline_interfaces',
+    'bad_op_indices',
+    'op_operands',
+    'op_shift',
+]

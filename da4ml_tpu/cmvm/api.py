@@ -291,6 +291,12 @@ def solve(
     :class:`~da4ml_tpu.reliability.CheckpointStore`) persists/reuses the
     result keyed by kernel + options. ``DA4ML_SOLVE_FALLBACK=0`` restores
     the raise-on-failure behavior globally.
+
+    With ``DA4ML_VERIFY=1`` every solve result additionally runs the full
+    static-analysis verifier (docs/analysis.md) before being returned and
+    raises :class:`~da4ml_tpu.analysis.VerificationError` on any error —
+    an opt-in guard for campaigns where a corrupted program must never
+    reach codegen or a checkpoint file.
     """
     kernel = np.asarray(kernel, dtype=np.float64)
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
@@ -308,7 +314,7 @@ def solve(
     if not want_orchestration:
         # direct path: exactly the pre-orchestration behavior (also the
         # per-backend entry point the orchestrator itself uses)
-        return _solve_dispatch(
+        result = _solve_dispatch(
             kernel,
             method0=method0,
             method1=method1,
@@ -324,6 +330,7 @@ def solve(
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
         )
+        return _post_solve_verify(result)
 
     if backend == 'auto':  # resolve before the chain walk: the chain starts
         try:  # at the backend this host would really use
@@ -347,7 +354,7 @@ def solve(
         n_restarts=n_restarts,
         n_workers=n_workers,
     )
-    return solve_orchestrated(
+    result = solve_orchestrated(
         kernel,
         solve_kwargs,
         backend=backend,
@@ -356,3 +363,13 @@ def solve(
         report=report,
         checkpoint=checkpoint,
     )
+    return _post_solve_verify(result)
+
+
+def _post_solve_verify(result: Pipeline) -> Pipeline:
+    """Opt-in ``DA4ML_VERIFY=1`` hook: verify every program ``solve`` emits."""
+    from ..analysis import post_solve_verify_enabled, verify_or_raise
+
+    if post_solve_verify_enabled():
+        verify_or_raise(result, context='post-solve verify (DA4ML_VERIFY=1)')
+    return result

@@ -12,7 +12,6 @@ from __future__ import annotations
 import numpy as np
 from numpy.typing import NDArray
 
-from ..ir.lut import lsb_loc
 
 
 def int_arr_to_csd(x: NDArray) -> NDArray[np.int8]:

@@ -95,6 +95,12 @@ class HLSModel:
     # ------------------------------------------------------------ emission
 
     def write(self) -> 'HLSModel':
+        # fail-fast precondition mirroring RTLModel.write: a malformed or
+        # interval-unsound program must not become a C++ kernel
+        from ...analysis import codegen_verify_enabled, verify_or_raise
+
+        if codegen_verify_enabled():
+            verify_or_raise(self.solution, context=f'HLSModel.write({self.name!r}) precondition')
         src = self.path / 'src'
         src.mkdir(parents=True, exist_ok=True)
         (src / f'{self.name}.hh').write_text(emit_hls_kernel(self.solution, self.name, self.print_latency, self.flavor))

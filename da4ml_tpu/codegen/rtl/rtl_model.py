@@ -170,6 +170,12 @@ class RTLModel:
         return files, metadata
 
     def write(self) -> 'RTLModel':
+        # fail-fast precondition: refuse to emit HDL for a malformed or
+        # interval-unsound program (set DA4ML_VERIFY=0 to bypass)
+        from ...analysis import codegen_verify_enabled, verify_or_raise
+
+        if codegen_verify_enabled():
+            verify_or_raise(self.solution, context=f'{type(self).__name__}.write({self.name!r}) precondition')
         files, metadata = self._emit()
         src = self.path / 'src'
         src.mkdir(parents=True, exist_ok=True)

@@ -12,7 +12,7 @@ Behavioral parity targets (reference, /root/reference):
 
 from __future__ import annotations
 
-from math import ceil, floor, log2
+from math import ceil, floor, isfinite, log2
 from typing import NamedTuple
 
 import numpy as np
@@ -76,7 +76,15 @@ def minimal_kif(qi: QInterval, symmetric: bool = False) -> Precision:
     if qi.min == qi.max == 0:
         return Precision(False, 0, 0)
     keep_negative = qi.min < 0
-    fractional = int(-log2(qi.step))
+    step = float(qi.step)
+    # a silent int(log2(...)) here would truncate a corrupt step into a wrong
+    # format; every non-zero interval must carry a positive power-of-two step
+    if not (step > 0.0 and isfinite(step)):
+        raise ValueError(f'QInterval.step must be a positive power of two, got {step!r} in {qi}')
+    f_exact = -log2(step)
+    fractional = int(round(f_exact))
+    if f_exact != fractional:
+        raise ValueError(f'QInterval.step must be a positive power of two, got {step!r} in {qi}')
     int_min, int_max = round(qi.min / qi.step), round(qi.max / qi.step)
     if symmetric:
         bits = int(ceil(log2(max(abs(int_min), int_max) + 1)))

@@ -24,7 +24,8 @@ Spec grammar (comma-separated entries)::
 Instrumented sites (kept in docs/reliability.md): ``cmvm.solve``,
 ``cmvm.jax``, ``cmvm.native``, ``cmvm.cpu``, ``native.load_lib``,
 ``runtime.jax``, ``distributed.init``, ``checkpoint.write``,
-``checkpoint.post_save``.
+``checkpoint.post_save``, and ``ir.mutate.<corruption>`` (mode ``corrupt``;
+arms one entry of the IR verifier's mutation catalog, analysis/mutation.py).
 """
 
 from __future__ import annotations

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 from collections import OrderedDict
-from functools import partial
 
 import jax
 import jax.numpy as jnp

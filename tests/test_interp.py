@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from da4ml_tpu.cmvm import solve
-from da4ml_tpu.ir import CombLogic, QInterval
+from da4ml_tpu.ir import QInterval
 
 
 def random_case(rng, n_in=6, n_out=5, bits=4):

@@ -1,0 +1,259 @@
+"""DAIS verifier tests: clean programs verify clean, corrupted ones are caught.
+
+Covers the acceptance contract of the analysis framework:
+
+- every solver-produced program in this suite verifies with zero errors;
+- for every DAIS opcode family, at least one ``reliability.faults``-driven
+  corruption is detected with a structured diagnostic;
+- the integration points (``from_dict``/``load``, the ``DA4ML_VERIFY=1``
+  post-solve hook, codegen preconditions, the ``verify`` CLI) all fail fast.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.analysis import (
+    COMB_CORRUPTIONS,
+    PIPELINE_CORRUPTIONS,
+    RULES,
+    VerificationError,
+    apply_planned_corruptions,
+    corruption_by_name,
+    verify,
+)
+from da4ml_tpu.cmvm import solve
+from da4ml_tpu.ir import CombLogic, Pipeline, QInterval, minimal_kif
+from da4ml_tpu.reliability import fault_injection
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+
+@pytest.fixture(scope='module')
+def rich_comb() -> CombLogic:
+    """One traced program containing every DAIS opcode family."""
+    rng = np.random.default_rng(7)
+    inp = FixedVariableArrayInput((8,), hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(8), np.full(8, 3), np.full(8, 2))
+    w = rng.integers(-4, 4, (8, 3)).astype(np.float64)
+    outs = [
+        np.sin(x[:4]).quantize(np.ones(4), np.ones(4), np.full(4, 4)),  # lookup (8)
+        x[:4] * x[4:],  # mul (7)
+        np.where(x[:2] > 0, x[2:4], 1.25),  # msb-mux (6) + const (5)
+        x[:4] & x[4:],  # binary bitwise (10)
+        ~x[:2],  # unary bitwise (9)
+        (x @ w).relu(),  # adds (0/1) + relu-quantize (2)
+        x[1:3] + 1.5,  # const-add (4)
+    ]
+    out = np.concatenate([np.atleast_1d(v) for v in outs])
+    return comb_trace(inp, out)
+
+
+@pytest.fixture(scope='module')
+def solved_pipeline() -> Pipeline:
+    rng = np.random.default_rng(3)
+    kernel = rng.integers(-8, 8, (6, 5)).astype(np.float64)
+    return solve(kernel, qintervals=[QInterval(-8.0, 7.0, 1.0)] * 6)
+
+
+def test_rich_comb_covers_all_families(rich_comb):
+    # every opcode family of the DAIS v1 table appears at least once
+    present = {op.opcode for op in rich_comb.ops}
+    assert {-1, 4, 5, 7, 8, 10}.issubset(present)
+    assert present & {0, 1} and present & {2, -2} and present & {3, -3}
+    assert present & {6, -6} and present & {9, -9}
+
+
+def test_clean_traced_program(rich_comb):
+    result = verify(rich_comb)
+    assert result.ok, result.format_text()
+
+
+def test_clean_solver_programs(solved_pipeline):
+    assert verify(solved_pipeline).ok
+    # a couple more shapes/precisions, exercising the dc sweep
+    for seed, shape, qb in ((0, (4, 7), 3), (1, (9, 2), 5)):
+        rng = np.random.default_rng(seed)
+        kernel = rng.integers(-16, 16, shape).astype(np.float64)
+        qints = [QInterval(-(2.0 ** (qb - 1)), 2.0 ** (qb - 1) - 1, 1.0)] * shape[0]
+        result = verify(solve(kernel, qintervals=qints))
+        assert result.ok, result.format_text()
+
+
+# ---------------------------------------------------------------------------
+# mutation self-test: every catalogued corruption is caught
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('name', [c.name for c in COMB_CORRUPTIONS])
+def test_mutation_is_caught(rich_comb, name):
+    corruption = corruption_by_name(name)
+    with fault_injection(f'ir.mutate.{name}=corrupt:1'):
+        mutated = apply_planned_corruptions(rich_comb)
+        # budget of 1: a second sweep must not fire again
+        assert apply_planned_corruptions(rich_comb) is rich_comb
+
+    assert mutated is not rich_comb, 'armed corruption did not mutate the program'
+    result = verify(mutated)
+    hits = result.by_rule(corruption.expect_rule)
+    assert hits, f'{name}: expected {corruption.expect_rule}, got {result.format_text()}'
+    severity = RULES[corruption.expect_rule][1]
+    assert all(d.severity == severity for d in hits)
+    if severity == 'error':
+        assert not result.ok
+    # diagnostics are structured & serializable
+    blob = json.loads(result.to_json())
+    assert blob['diagnostics'][0]['rule']
+
+
+@pytest.mark.parametrize('name', [c.name for c in PIPELINE_CORRUPTIONS])
+def test_pipeline_mutation_is_caught(solved_pipeline, name):
+    corruption = corruption_by_name(name)
+    with fault_injection(f'ir.mutate.{name}=corrupt:1'):
+        mutated = apply_planned_corruptions(solved_pipeline)
+    result = verify(mutated)
+    assert result.by_rule(corruption.expect_rule), result.format_text()
+    assert not result.ok
+
+
+def test_unarmed_plan_is_identity(rich_comb):
+    assert apply_planned_corruptions(rich_comb) is rich_comb
+
+
+def test_env_var_plan_arms_corruption(rich_comb, monkeypatch):
+    monkeypatch.setenv('DA4ML_FAULT_INJECT', 'ir.mutate.add.forward_ref=corrupt:1')
+    mutated = apply_planned_corruptions(rich_comb)
+    assert not verify(mutated).ok
+
+
+# ---------------------------------------------------------------------------
+# satellite: QInterval.step validation in minimal_kif
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('step', [0.75, 0.0, -1.0, float('nan'), float('inf')])
+def test_minimal_kif_rejects_bad_step(step):
+    with pytest.raises(ValueError, match='positive power of two'):
+        minimal_kif(QInterval(-2.0, 1.75, step))
+
+
+def test_minimal_kif_zero_interval_keeps_any_step():
+    assert tuple(minimal_kif(QInterval(0.0, 0.0, 0.75))) == (False, 0, 0)
+
+
+# ---------------------------------------------------------------------------
+# integration: load-time verification, post-solve hook, codegen precondition
+# ---------------------------------------------------------------------------
+
+
+def test_from_dict_rejects_corrupt_program(rich_comb):
+    blob = rich_comb.to_dict()
+    blob['ops'][5][0] = len(blob['ops']) + 3  # id0 forward reference
+    blob['ops'][5][2] = 0  # on an add op
+    with pytest.raises(VerificationError):
+        CombLogic.from_dict(blob)
+    assert CombLogic.from_dict(blob, verify=False) is not None
+
+
+def test_load_rejects_corrupt_file(tmp_path, solved_pipeline):
+    blob = solved_pipeline.to_dict()
+    blob['stages'][0]['out_idxs'][0] = 10**6
+    path = tmp_path / 'pipeline.json'
+    path.write_text(json.dumps(blob))
+    with pytest.raises(VerificationError):
+        Pipeline.load(path)
+    assert Pipeline.load(path, verify=False) is not None
+
+
+def test_roundtrip_still_clean(tmp_path, rich_comb):
+    path = tmp_path / 'comb.json'
+    rich_comb.save(path)
+    assert CombLogic.load(path) == rich_comb
+
+
+def test_post_solve_hook(monkeypatch, solved_pipeline):
+    monkeypatch.setenv('DA4ML_VERIFY', '1')
+    kernel = np.arange(-3.0, 3.0).reshape(2, 3)
+    assert solve(kernel) is not None  # clean program passes the hook
+
+    from da4ml_tpu.cmvm import api
+
+    bad = corruption_by_name('pipeline.stage_interface').apply(solved_pipeline)
+    monkeypatch.setattr(api, '_solve_dispatch', lambda *a, **k: bad)
+    with pytest.raises(VerificationError, match='DA4ML_VERIFY'):
+        api.solve(kernel, fallback=False)
+    # hook is opt-in: without the env var the corrupt result passes through
+    monkeypatch.delenv('DA4ML_VERIFY')
+    assert api.solve(kernel, fallback=False) is bad
+
+
+def test_codegen_precondition(tmp_path, rich_comb, monkeypatch):
+    from da4ml_tpu.codegen import VerilogModel
+
+    bad = corruption_by_name('mul.narrowed_interval').apply(rich_comb)
+    with pytest.raises(VerificationError, match='precondition'):
+        VerilogModel(bad, 'bad_model', tmp_path / 'proj').write()
+    assert not (tmp_path / 'proj' / 'src').exists()
+    monkeypatch.setenv('DA4ML_VERIFY', '0')  # explicit bypass
+    VerilogModel(bad, 'bad_model', tmp_path / 'proj').write()
+    assert (tmp_path / 'proj' / 'src').exists()
+
+
+def test_hls_precondition(tmp_path, rich_comb):
+    pytest.importorskip('da4ml_tpu.codegen.hls.hls_model')
+    from da4ml_tpu.codegen import HLSModel
+
+    bad = corruption_by_name('copy.bad_lane').apply(rich_comb)
+    with pytest.raises(VerificationError, match='precondition'):
+        HLSModel(bad, 'bad_model', tmp_path / 'hproj').write()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_verify(tmp_path, rich_comb, solved_pipeline, capsys):
+    from da4ml_tpu._cli import main
+
+    good = tmp_path / 'good.json'
+    rich_comb.save(good)
+    bad_blob = solved_pipeline.to_dict()
+    bad_blob['stages'][0]['ops'][0][2] = 42  # unknown opcode
+    bad = tmp_path / 'bad.json'
+    bad.write_text(json.dumps(bad_blob))
+
+    assert main(['verify', str(good)]) == 0
+    out = capsys.readouterr().out
+    assert 'ok' in out
+
+    assert main(['verify', str(good), str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert 'W102' in out
+
+    assert main(['verify', str(bad), '--json']) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['ok'] is False
+    assert any(d['rule'] == 'W102' for d in payload['diagnostics'])
+
+    garbage = tmp_path / 'garbage.json'
+    garbage.write_text('{not json')
+    assert main(['verify', str(garbage)]) == 2
+
+
+def test_cli_verify_project_dir(tmp_path, rich_comb):
+    from da4ml_tpu._cli import main
+
+    (tmp_path / 'proj' / 'model').mkdir(parents=True)
+    rich_comb.save(tmp_path / 'proj' / 'model' / 'comb.json')
+    assert main(['verify', str(tmp_path / 'proj')]) == 0
+
+
+def test_cli_verify_pass_subset(tmp_path, rich_comb):
+    from da4ml_tpu._cli import main
+
+    good = tmp_path / 'good.json'
+    rich_comb.save(good)
+    assert main(['verify', str(good), '--passes', 'wellformed,deadcode']) == 0
+    with pytest.raises(ValueError, match='unknown analysis pass'):
+        main(['verify', str(good), '--passes', 'nope'])
