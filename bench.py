@@ -323,7 +323,24 @@ def run_section(name: str, n1: int, limited: bool) -> dict:
     Called in a child subprocess (``--section``) so a device hang or worker
     crash in one section cannot take down the whole bench (round-1 failure
     mode: a wedged axon tunnel blocks forever, not errors).
+
+    The telemetry metrics registry is armed for the section, and its
+    snapshot (jit compile/execute splits, CSE round counters, solve
+    histograms — docs/telemetry.md) rides along in the section entry under
+    ``'metrics'``.
     """
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot
+
+    enable_metrics()
+    entry = _run_section_impl(name, n1, limited)
+    if isinstance(entry, dict):
+        snap = metrics_snapshot()
+        if snap:
+            entry.setdefault('metrics', snap)
+    return entry
+
+
+def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
     import jax
 
     if os.environ.get('DA4ML_BENCH_PLATFORM') == 'cpu':

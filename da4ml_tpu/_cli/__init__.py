@@ -10,7 +10,9 @@ src/da4ml/_cli/__init__.py:8-27):
   a summary table;
 - ``verify`` — run the DAIS static-analysis verifier over saved programs or
   generated project directories (docs/analysis.md);
-- ``warmup`` — pre-compile the device-search shape classes.
+- ``warmup`` — pre-compile the device-search shape classes;
+- ``stats`` — summarize a telemetry trace captured with ``--trace`` /
+  ``DA4ML_TRACE`` (docs/telemetry.md).
 """
 
 from __future__ import annotations
@@ -45,6 +47,12 @@ def main(argv: list[str] | None = None) -> int:
     p_verify = sub.add_parser('verify', help='Statically verify saved DAIS programs (well-formedness, intervals, lint)')
     add_verify_args(p_verify)
     p_verify.set_defaults(func=verify_main)
+
+    from .stats import add_stats_args, stats_main
+
+    p_stats = sub.add_parser('stats', help='Summarize a telemetry trace captured with --trace / DA4ML_TRACE')
+    add_stats_args(p_stats)
+    p_stats.set_defaults(func=stats_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

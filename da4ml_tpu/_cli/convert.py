@@ -257,6 +257,21 @@ def convert(
 
 
 def convert_main(args: argparse.Namespace) -> int:
+    from .. import telemetry
+
+    if getattr(args, 'trace', None):
+        # one sink for the whole conversion; closed (and flushed to disk)
+        # before the command returns so the file is complete even when a
+        # later CLI step in the same process runs more solves
+        telemetry.enable(args.trace)
+    try:
+        return _convert_main(args)
+    finally:
+        if getattr(args, 'trace', None):
+            telemetry.disable()
+
+
+def _convert_main(args: argparse.Namespace) -> int:
     if getattr(args, 'warmup', False) and args.solver_backend == 'jax':
         # overlap the dominant-shape-class compile ladder with model load +
         # host-side tracing (CSD/decompose): by the time the first device
@@ -273,28 +288,31 @@ def convert_main(args: argparse.Namespace) -> int:
         threading.Thread(target=warmup_main, args=(wargs,), daemon=True, name='da4ml-warmup').start()
     elif getattr(args, 'warmup', False) and args.verbose:
         print('[INFO] --warmup skipped: only applies with --solver-backend jax')
-    convert(
-        args.model,
-        args.outdir,
-        n_test_sample=args.n_test_sample,
-        clock_period=args.clock_period,
-        clock_uncertainty=args.clock_uncertainty,
-        flavor=args.flavor,
-        latency_cutoff=args.latency_cutoff,
-        part_name=args.part_name,
-        verbose=args.verbose,
-        validate_rtl=args.validate_rtl,
-        hwconf=tuple(args.hw_config),
-        hard_dc=args.delay_constraint,
-        n_threads=args.n_threads,
-        inputs_kif=tuple(args.inputs_kif) if args.inputs_kif else None,
-        solver_backend=args.solver_backend,
-        n_restarts=args.n_restarts,
-        method0_candidates=args.methods,
-        deadline=args.deadline,
-        fallback=args.fallback,
-        resume=args.resume,
-    )
+    from .. import telemetry
+
+    with telemetry.span('cli.convert', model=str(args.model), flavor=args.flavor):
+        convert(
+            args.model,
+            args.outdir,
+            n_test_sample=args.n_test_sample,
+            clock_period=args.clock_period,
+            clock_uncertainty=args.clock_uncertainty,
+            flavor=args.flavor,
+            latency_cutoff=args.latency_cutoff,
+            part_name=args.part_name,
+            verbose=args.verbose,
+            validate_rtl=args.validate_rtl,
+            hwconf=tuple(args.hw_config),
+            hard_dc=args.delay_constraint,
+            n_threads=args.n_threads,
+            inputs_kif=tuple(args.inputs_kif) if args.inputs_kif else None,
+            solver_backend=args.solver_backend,
+            n_restarts=args.n_restarts,
+            method0_candidates=args.methods,
+            deadline=args.deadline,
+            fallback=args.fallback,
+            resume=args.resume,
+        )
     return 0
 
 
@@ -359,4 +377,12 @@ def add_convert_args(parser: argparse.ArgumentParser):
         default=None,
         help='Checkpoint file for per-kernel CMVM results: a killed conversion resumes here '
         'instead of re-solving finished layers (host solver paths)',
+    )
+    parser.add_argument(
+        '--trace',
+        type=Path,
+        default=None,
+        help='Capture a telemetry trace of the conversion to this path: Chrome trace-event JSON '
+        '(open in Perfetto / chrome://tracing), or a streaming JSONL event log when the path '
+        'ends in .jsonl. Summarize with `da4ml-tpu stats <path>`. Equivalent to DA4ML_TRACE=<path>.',
     )

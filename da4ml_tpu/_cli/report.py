@@ -302,18 +302,21 @@ def write_output(vals: list[dict[str, Any]], arr: list[list], output: str):
 
 
 def report_main(args: argparse.Namespace) -> int:
+    from ..telemetry import get_logger
+
+    logger = get_logger('cli.report')
     vals: list[dict[str, Any]] = []
     for p in args.paths:
         try:
             d = load_project(p)
         except Exception as e:
-            print(f'[WARNING] skipping {p}: {e}')
+            logger.warning(f'skipping {p}: {e}')
             continue
         for k, v in extra_info_from_fname(Path(p).name).items():
             d.setdefault(k, v)
         vals.append(d)
     if not vals:
-        print('No readable projects.')
+        logger.warning('No readable projects.')
         return 1
 
     key = args.sort_by

@@ -1,0 +1,117 @@
+"""``da4ml-tpu stats`` — summarize a captured telemetry trace.
+
+Reads a trace produced by ``DA4ML_TRACE=<path>`` / ``--trace <path>``
+(either format: Chrome trace-event JSON or JSONL event log) and renders:
+
+- a per-span-name aggregate table (count, total/mean/max wall clock) sorted
+  by total time — where the conversion actually went;
+- the metrics snapshot embedded in the trace (counters, gauges, histogram
+  summaries).
+
+``--json`` emits the same summary as one machine-readable JSON document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Aggregate Chrome trace events: span stats by name + instant counts."""
+    spans: dict[str, dict] = {}
+    instants: dict[str, int] = {}
+    for ev in events:
+        ph = ev.get('ph')
+        name = ev.get('name', '?')
+        if ph == 'X':
+            dur_s = float(ev.get('dur', 0.0)) / 1e6
+            s = spans.setdefault(name, {'count': 0, 'total_s': 0.0, 'max_s': 0.0})
+            s['count'] += 1
+            s['total_s'] += dur_s
+            if dur_s > s['max_s']:
+                s['max_s'] = dur_s
+        elif ph == 'i':
+            instants[name] = instants.get(name, 0) + 1
+    for s in spans.values():
+        s['mean_s'] = s['total_s'] / s['count']
+    return {'spans': spans, 'instants': instants}
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f'{v:.2f}s'
+    if v >= 1e-3:
+        return f'{v * 1e3:.1f}ms'
+    return f'{v * 1e6:.0f}µs'
+
+
+def render_summary(summary: dict, metrics: dict, top: int = 0) -> str:
+    lines: list[str] = []
+    spans = sorted(summary['spans'].items(), key=lambda kv: -kv[1]['total_s'])
+    if top:
+        spans = spans[:top]
+    if spans:
+        name_w = max(len('span'), *(len(n) for n, _ in spans))
+        lines.append(f'{"span":<{name_w}}  {"count":>6}  {"total":>9}  {"mean":>9}  {"max":>9}')
+        lines.append('-' * (name_w + 40))
+        for name, s in spans:
+            lines.append(
+                f'{name:<{name_w}}  {s["count"]:>6}  {_fmt_s(s["total_s"]):>9}  '
+                f'{_fmt_s(s["mean_s"]):>9}  {_fmt_s(s["max_s"]):>9}'
+            )
+    else:
+        lines.append('(no spans recorded)')
+    if summary['instants']:
+        lines.append('')
+        lines.append('instant events:')
+        for name, n in sorted(summary['instants'].items()):
+            lines.append(f'  {name}: {n}')
+    if metrics:
+        lines.append('')
+        lines.append('metrics:')
+        for name, m in sorted(metrics.items()):
+            kind = m.get('type')
+            if kind == 'histogram':
+                # the `_s` suffix convention marks seconds-valued histograms
+                fmt = _fmt_s if name.endswith('_s') else (lambda v: f'{v:g}')
+                if m.get('count'):
+                    lines.append(
+                        f'  {name}: n={m["count"]} mean={fmt(m["mean"])} min={fmt(m["min"])} max={fmt(m["max"])}'
+                    )
+                else:
+                    lines.append(f'  {name}: n=0')
+            else:
+                lines.append(f'  {name}: {m.get("value"):g}')
+    return '\n'.join(lines)
+
+
+def stats_main(args: argparse.Namespace) -> int:
+    from ..telemetry import load_trace, validate_trace
+
+    path = Path(args.trace)
+    if not path.is_file():
+        from ..telemetry import get_logger
+
+        get_logger('cli.stats').warning(f'no such trace file: {path}')
+        return 1
+    events, metrics = load_trace(path)
+    if args.validate:
+        validate_trace(events)
+    summary = summarize_events(events)
+    if args.json:
+        print(json.dumps({'file': str(path), 'n_events': len(events), **summary, 'metrics': metrics}, indent=2))
+    else:
+        print(f'{path}: {len(events)} events')
+        print(render_summary(summary, metrics, top=args.top))
+    return 0
+
+
+def add_stats_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument('trace', type=Path, help='Trace file captured with --trace / DA4ML_TRACE (.json or .jsonl)')
+    parser.add_argument('--json', action='store_true', help='Emit the summary as JSON instead of a table')
+    parser.add_argument('--top', type=int, default=0, help='Show only the N span names with the largest total time')
+    parser.add_argument(
+        '--validate', action='store_true', help='Additionally check every event against the Chrome trace-event schema'
+    )

@@ -41,7 +41,14 @@ def warmup_main(args) -> int:
 
     import numpy as np
 
+    from .. import telemetry
     from ..cmvm.jax_search import solve_jax_many
+    from ..telemetry.metrics import enable_metrics
+
+    # each ladder's compile wall clock lands in the warmup.compile_s
+    # histogram (visible via `da4ml-tpu stats` / bench metrics snapshots)
+    # alongside the human-readable lines below
+    enable_metrics()
 
     rng = np.random.default_rng(0)
     dims = [d for d in (4, 8, 16, 32, 64, 128, 256) if d <= args.max_dim]
@@ -51,8 +58,10 @@ def warmup_main(args) -> int:
         t0 = time.perf_counter()
         sol = solve_jax_many([kern])[0]
         assert np.array_equal(np.asarray(sol.kernel, np.float64), kern)
+        dt = time.perf_counter() - t0
+        telemetry.histogram('warmup.compile_s').observe(dt)
         if args.verbose:
-            print(f'  {d}x{d}: {time.perf_counter() - t0:.1f}s')
+            print(f'  {d}x{d}: {dt:.1f}s')
     if not getattr(args, 'quiet', False):
         print(f'warmup: {len(dims)} shape-class ladders compiled/cached in {time.perf_counter() - t_all:.1f}s')
     return 0

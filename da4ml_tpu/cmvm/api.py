@@ -12,12 +12,14 @@ Behavioral parity: reference src/da4ml/_binary/cmvm/api.cc.
 from __future__ import annotations
 
 import os
+import time
 from concurrent.futures import ProcessPoolExecutor
 from math import ceil, inf, log2
 
 import numpy as np
 from numpy.typing import NDArray
 
+from .. import telemetry
 from ..ir.comb import CombLogic, Pipeline
 from ..ir.types import QInterval
 from .core import solve_single, to_solution
@@ -126,7 +128,9 @@ def _solve(
 
 
 def _solve_task(args) -> Pipeline:
-    return _solve(*args)
+    # args[4] is the decompose depth of this sweep candidate (see tasks below)
+    with telemetry.span('cmvm.solve_dc', dc=args[4], method0=args[1]):
+        return _solve(*args)
 
 
 def _pipeline_cost(p: Pipeline) -> float:
@@ -155,6 +159,41 @@ def _solve_dispatch(
     the pre-orchestration solve semantics, unchanged.
     """
     kernel = np.asarray(kernel, dtype=np.float64)
+    with telemetry.span('cmvm.dispatch', backend=backend, shape='x'.join(map(str, kernel.shape))):
+        return _solve_dispatch_impl(
+            kernel,
+            method0=method0,
+            method1=method1,
+            hard_dc=hard_dc,
+            decompose_dc=decompose_dc,
+            qintervals=qintervals,
+            latencies=latencies,
+            adder_size=adder_size,
+            carry_size=carry_size,
+            search_all_decompose_dc=search_all_decompose_dc,
+            backend=backend,
+            n_workers=n_workers,
+            method0_candidates=method0_candidates,
+            n_restarts=n_restarts,
+        )
+
+
+def _solve_dispatch_impl(
+    kernel: NDArray,
+    method0: str = 'wmc',
+    method1: str = 'auto',
+    hard_dc: int = -1,
+    decompose_dc: int = -2,
+    qintervals: list[QInterval] | None = None,
+    latencies: list[float] | None = None,
+    adder_size: int = -1,
+    carry_size: int = -1,
+    search_all_decompose_dc: bool = True,
+    backend: str = 'cpu',
+    n_workers: int = 0,
+    method0_candidates: list[str] | None = None,
+    n_restarts: int = 1,
+) -> Pipeline:
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
         raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
     qintervals, latencies = _default_qint_lat(kernel, qintervals, latencies)
@@ -297,11 +336,55 @@ def solve(
     raises :class:`~da4ml_tpu.analysis.VerificationError` on any error —
     an opt-in guard for campaigns where a corrupted program must never
     reach codegen or a checkpoint file.
+
+    Telemetry (docs/telemetry.md): each call is one ``cmvm.solve`` span and
+    one ``solve.duration_s`` / ``solve.adders`` sample when telemetry is
+    enabled (``DA4ML_TRACE`` or ``telemetry.enable()``); disabled, the
+    instrumentation is a no-op flag check.
     """
     kernel = np.asarray(kernel, dtype=np.float64)
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
         raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
 
+    _metrics = telemetry.metrics_on()
+    _t0 = time.perf_counter() if _metrics else 0.0
+    with telemetry.span('cmvm.solve', backend=backend, shape=f'{kernel.shape[0]}x{kernel.shape[1]}') as _sp:
+        result = _solve_entry(
+            kernel, method0, method1, hard_dc, decompose_dc, qintervals, latencies, adder_size,
+            carry_size, search_all_decompose_dc, backend, n_workers, method0_candidates, n_restarts,
+            deadline=deadline, fallback=fallback, report=report, checkpoint=checkpoint,
+        )  # fmt: skip
+        if _metrics:
+            telemetry.counter('solve.calls').inc()
+            telemetry.histogram('solve.duration_s').observe(time.perf_counter() - _t0)
+            telemetry.histogram('solve.adders').observe(float(result.cost))
+        if _sp:
+            _sp.set(cost=float(result.cost))
+        return result
+
+
+def _solve_entry(
+    kernel: NDArray,
+    method0: str,
+    method1: str,
+    hard_dc: int,
+    decompose_dc: int,
+    qintervals: list[QInterval] | None,
+    latencies: list[float] | None,
+    adder_size: int,
+    carry_size: int,
+    search_all_decompose_dc: bool,
+    backend: str,
+    n_workers: int,
+    method0_candidates: list[str] | None,
+    n_restarts: int,
+    *,
+    deadline: float | None,
+    fallback,
+    report,
+    checkpoint,
+) -> Pipeline:
+    """Orchestration decision + dispatch — the body of :func:`solve`."""
     from ..reliability.orchestrator import fallback_enabled_default, solve_orchestrated
 
     want_orchestration = (

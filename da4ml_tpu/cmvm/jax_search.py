@@ -45,12 +45,15 @@ import jax.numpy as jnp
 import numpy as np
 from numpy.typing import NDArray
 
+from .. import telemetry
 from ..ir.comb import CombLogic, Pipeline
 from ..ir.types import QInterval
 from .core import to_solution
 from .csd import csd_decompose
 from .state import DAState, Op, encode_digit
 from . import api as _host_api
+
+_logger = telemetry.get_logger('cmvm.jax')
 
 _METHOD_CODES = {'mc': 0, 'mc-dc': 1, 'mc-pdc': 2, 'wmc': 3, 'wmc-dc': 4, 'wmc-pdc': 5, 'dummy': 6}
 
@@ -70,6 +73,12 @@ except ValueError:
 #: 'pmax_host_fallbacks' counts lanes/matrices rerouted to the host solver
 #: because their slot demand exceeded DA4ML_JAX_PMAX
 search_stats = {'over_budget_accepts': 0, 'pmax_host_fallbacks': 0}
+
+#: (spec, lane bucket) classes whose device function has already been called
+#: once in this process — the first call of a class pays the XLA compile (or
+#: persistent-cache load), so its wall clock lands in ``jit.first_call_s``
+#: and increments ``jit.cache_miss``; later calls land in ``jit.execute_s``
+_SEEN_CLASSES: set = set()
 
 
 def _next_pow2(x: int) -> int:
@@ -892,9 +901,10 @@ def solve_single_lanes(
     active — stragglers pay for large candidate tensors, finished lanes drop
     out (compaction).
     """
-    for lane in lanes:
-        if lane.csd is None:
-            _prepare_lane(lane)
+    with telemetry.span('cmvm.jax.csd', n_lanes=len(lanes)):
+        for lane in lanes:
+            if lane.csd is None:
+                _prepare_lane(lane)
 
     dummy_idx = [k for k, ln in enumerate(lanes) if ln.method == 'dummy']
     results: dict[int, CombLogic] = {}
@@ -1153,7 +1163,11 @@ def solve_single_lanes(
                     cE_send = cE
                 args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm))
 
-                if debug:
+                # time the device round only when someone consumes it (the
+                # compile-vs-execute split below or the debug line): the
+                # disabled path must not pay even the clock reads
+                _timed = debug or telemetry.metrics_on()
+                if _timed:
                     import time as _time
 
                     _t0 = _time.perf_counter()
@@ -1185,22 +1199,37 @@ def solve_single_lanes(
                     oE, oq, ol, o_rec, ocur = fn(*args)
                     h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
                 cur_f = np.asarray(h_cur)[:n_chunk]
-                if debug:
-                    print(
-                        f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
-                        f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_time.perf_counter() - _t0:.2f}s',
-                        flush=True,
-                    )
+                if _timed:
+                    _dt = _time.perf_counter() - _t0
+                    if telemetry.metrics_on():
+                        # first-call timing per compile class approximates the
+                        # XLA compile cost; later calls of the same class are
+                        # pure device-execute + transfer
+                        _cls = (spec, bucket)
+                        if _cls not in _SEEN_CLASSES:
+                            _SEEN_CLASSES.add(_cls)
+                            telemetry.counter('jit.cache_miss').inc()
+                            telemetry.histogram('jit.first_call_s').observe(_dt)
+                        else:
+                            telemetry.histogram('jit.execute_s').observe(_dt)
+                        telemetry.counter('cse.device_rounds').inc()
+                    if debug:
+                        _logger.info(
+                            f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
+                            f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_dt:.2f}s'
+                        )
                 if bool((cur_f >= P).any()):
                     q_all, l_all = _fetch((oq, ol))
                     q_all, l_all = np.asarray(q_all)[:n_chunk], np.asarray(l_all)[:n_chunk]
                 op_rec = np.asarray(h_rec)[:n_chunk]
                 E_all = _unpack_digits(np.asarray(hEp), O, B)[:n_chunk]
 
+                _n_subst = 0
                 for x, a in enumerate(chunk):
                     c0, c1 = int(st_cur[a]), int(cur_f[x])
                     if c1 > c0:
                         recs[a].append(op_rec[x, : c1 - c0].copy())
+                        _n_subst += c1 - c0
                     st_cur[a] = c1
                     # .copy(): a bare slice would be a view pinning the whole
                     # bucket-sized fetch buffer until emission
@@ -1209,6 +1238,9 @@ def solve_single_lanes(
                         hE[a], hq[a], hl[a] = E_all[x].copy(), q_all[x].copy(), l_all[x].copy()
                     else:
                         st_E[a] = E_all[x].copy()
+                if _n_subst:
+                    # greedy CSE substitutions materialized this device round
+                    telemetry.counter('cse.substitutions').inc(_n_subst)
             pend = next_pend
 
         emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)
@@ -1245,22 +1277,23 @@ def solve_single_lanes(
                     rec[:, c] = np.where(v < ni, perm[np.minimum(v, ni - 1)], v)
             emit_jobs.append((k, E_lane, rec, shift0))
 
-        if _native_emit_available():
-            from ..native.bindings import emit_batch
+        with telemetry.span('cmvm.jax.emit', n_jobs=len(emit_jobs)):
+            if _native_emit_available():
+                from ..native.bindings import emit_batch
 
-            lane_tuples = []
-            for k, E_lane, rec, shift0 in emit_jobs:
-                ln = lanes[k]
-                qints = np.asarray([(q.min, q.max, q.step) for q in ln.qintervals], np.float64).reshape(-1, 3)
-                lats = np.asarray(ln.latencies, np.float64)
-                lane_tuples.append((shift0, ln.shift1, qints, lats, E_lane, rec))
-            for (k, _, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
-                results[k] = sol
-        else:
-            for k, E_lane, rec, shift0 in emit_jobs:
-                ln = lanes[k]
-                state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size, shift0=shift0)
-                results[k] = to_solution(state, adder_size, carry_size)
+                lane_tuples = []
+                for k, E_lane, rec, shift0 in emit_jobs:
+                    ln = lanes[k]
+                    qints = np.asarray([(q.min, q.max, q.step) for q in ln.qintervals], np.float64).reshape(-1, 3)
+                    lats = np.asarray(ln.latencies, np.float64)
+                    lane_tuples.append((shift0, ln.shift1, qints, lats, E_lane, rec))
+                for (k, _, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
+                    results[k] = sol
+            else:
+                for k, E_lane, rec, shift0 in emit_jobs:
+                    ln = lanes[k]
+                    state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size, shift0=shift0)
+                    results[k] = to_solution(state, adder_size, carry_size)
 
     return [results[k] for k in range(len(lanes))]
 
@@ -1639,6 +1672,17 @@ def solve_jax(
 
 def solve_jax_many(
     kernels: list[NDArray],
+    *args,
+    **kwargs,
+) -> list[Pipeline]:
+    """Batched device solve — see :func:`_solve_jax_many_impl` for the full
+    contract; this wrapper only adds the ``cmvm.jax.solve_many`` span."""
+    with telemetry.span('cmvm.jax.solve_many', n_matrices=len(kernels)):
+        return _solve_jax_many_impl(kernels, *args, **kwargs)
+
+
+def _solve_jax_many_impl(
+    kernels: list[NDArray],
     method0: str = 'wmc',
     method1: str = 'auto',
     hard_dc: int = -1,
@@ -1757,7 +1801,8 @@ def solve_jax_many(
     uniq_md: dict[tuple[int, int], int] = {}
     for mi, dc, _, _ in jobs:
         uniq_md.setdefault((mi, dc), len(uniq_md))
-    splits_u = _decompose(list(uniq_md))
+    with telemetry.span('cmvm.jax.decompose', n_unique=len(uniq_md)):
+        splits_u = _decompose(list(uniq_md))
     splits = [splits_u[uniq_md[(mi, dc)]] for mi, dc, _, _ in jobs]
 
     lanes0: list[_Lane] = []
@@ -1793,14 +1838,16 @@ def solve_jax_many(
                 _prewarm_class(*got)
 
         _prewarm_submit(_warm_stage1)
-    sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
+    with telemetry.span('cmvm.jax.stage0', n_lanes=len(lanes0)):
+        sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
     lanes1: list[_Lane] = []
     for (mi, dc, mp, r), sol0, mat1 in zip(jobs, sols0, mats1):
         qints1, lats1 = sol0.out_qint, sol0.out_latency
         lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(mpairs[mp][1], dc, _hard_eff)))
-    sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
+    with telemetry.span('cmvm.jax.stage1', n_lanes=len(lanes1)):
+        sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
 
     # per-matrix latency budget, computed once
     allowed = [inf] * n_mat

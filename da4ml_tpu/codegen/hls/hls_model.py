@@ -27,6 +27,7 @@ from pathlib import Path
 import numpy as np
 from numpy.typing import NDArray
 
+from ... import telemetry
 from ...ir.comb import CombLogic, Pipeline
 from ...ir.types import minimal_kif
 from .hls_codegen import emit_hls_kernel
@@ -95,6 +96,10 @@ class HLSModel:
     # ------------------------------------------------------------ emission
 
     def write(self) -> 'HLSModel':
+        with telemetry.span('codegen.hls.write', name=self.name, flavor=self.flavor):
+            return self._write()
+
+    def _write(self) -> 'HLSModel':
         # fail-fast precondition mirroring RTLModel.write: a malformed or
         # interval-unsound program must not become a C++ kernel
         from ...analysis import codegen_verify_enabled, verify_or_raise
@@ -272,7 +277,7 @@ extern "C" int inference(const double* in, double* out, long n_samples, int n_th
         self._lib_path = out
         self._lib = None
         if verbose:
-            print(f'built {out}')
+            telemetry.get_logger('codegen.hls').info(f'built {out}')
         return self
 
     def _load_lib(self) -> ctypes.CDLL:

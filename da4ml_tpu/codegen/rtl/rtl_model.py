@@ -32,6 +32,7 @@ from pathlib import Path
 import numpy as np
 from numpy.typing import NDArray
 
+from ... import telemetry
 from ...ir.comb import CombLogic, Pipeline
 from ...ir.types import minimal_kif
 from ..rtl.verilog.comb import VerilogCombEmitter
@@ -170,6 +171,10 @@ class RTLModel:
         return files, metadata
 
     def write(self) -> 'RTLModel':
+        with telemetry.span('codegen.rtl.write', name=self.name, flavor=self.flavor):
+            return self._write()
+
+    def _write(self) -> 'RTLModel':
         # fail-fast precondition: refuse to emit HDL for a malformed or
         # interval-unsound program (set DA4ML_VERIFY=0 to bypass)
         from ...analysis import codegen_verify_enabled, verify_or_raise
@@ -360,7 +365,7 @@ clean:
         self._lib_path = stamped
         self._lib = None
         if verbose:
-            print(f'built {stamped}')
+            telemetry.get_logger('codegen.rtl').info(f'built {stamped}')
         return self
 
     def _load_lib(self) -> ctypes.CDLL:

@@ -103,8 +103,13 @@ def trace_model(
     else:
         cls = _resolve(spec)
 
+    from .. import telemetry
+
     if verbose:
-        print(f'Tracing with plugin {cls.__module__}.{cls.__qualname__} (framework={framework})')
+        telemetry.get_logger('converter').info(
+            f'Tracing with plugin {cls.__module__}.{cls.__qualname__} (framework={framework})'
+        )
 
     tracer = cls(model, hwconf, solver_options, **kwargs)
-    return tracer.trace(verbose=verbose, inputs=inputs, inputs_kif=inputs_kif, dump=dump)
+    with telemetry.span('trace.model', framework=framework):
+        return tracer.trace(verbose=verbose, inputs=inputs, inputs_kif=inputs_kif, dump=dump)

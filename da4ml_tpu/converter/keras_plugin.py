@@ -22,6 +22,7 @@ from typing import Any
 
 import numpy as np
 
+from ..telemetry import get_logger
 from ..trace import FixedVariableArray
 from ..trace.ops import (
     avg_pool1d,
@@ -39,6 +40,8 @@ from ..trace.ops import (
     zero_pad,
 )
 from .plugin import TracerPluginBase
+
+_logger = get_logger('converter.keras')
 
 _SUPPORTED_ACTIVATIONS = ('linear', 'relu', 'relu6', 'leaky_relu')
 
@@ -525,7 +528,7 @@ class KerasTracer(TracerPluginBase):
                 x = self._trace_layer(layer, (x,), {})
                 traces[layer.name] = x
                 if verbose:
-                    print(f'  {layer.name}: {getattr(x, "shape", None)}')
+                    _logger.info(f'  {layer.name}: {getattr(x, "shape", None)}')
             out_name = model.layers[-1].name if model.layers else 'out'
             return traces, [out_name]
 
@@ -536,7 +539,7 @@ class KerasTracer(TracerPluginBase):
                 out = self._trace_layer(op, args, kwargs)
                 traces[op.name] = out
                 if verbose:
-                    print(f'  {op.name}: {getattr(out, "shape", None)}')
+                    _logger.info(f'  {op.name}: {getattr(out, "shape", None)}')
                 return out
 
             return apply

@@ -21,6 +21,7 @@ from typing import Any
 
 import numpy as np
 
+from ..telemetry import get_logger
 from ..trace import FixedVariableArray
 from ..trace.ops import (
     avg_pool1d,
@@ -38,6 +39,8 @@ from ..trace.ops import (
     zero_pad,
 )
 from .plugin import TracerPluginBase
+
+_logger = get_logger('converter.torch')
 
 
 def _one(v) -> int:
@@ -353,7 +356,7 @@ class TorchTracer(TracerPluginBase):
                 raise NotImplementedError(f'fx op {node.op!r} unsupported')
             if verbose and node.op not in ('output',):
                 v = env.get(node.name)
-                print(f'  {node.name}: {getattr(v, "shape", None)}')
+                _logger.info(f'  {node.name}: {getattr(v, "shape", None)}')
             if node.op != 'output':
                 traces[node.name] = env[node.name]
         return traces, out_names

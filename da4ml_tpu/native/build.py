@@ -61,7 +61,9 @@ def build(force: bool = False, verbose: bool = False) -> Path:
         str(LIB_PATH),
     ]
     if verbose:
-        print(' '.join(cmd), file=sys.stderr)
+        from ..telemetry import get_logger
+
+        get_logger('native.build').info(' '.join(cmd))
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f'native build failed:\n{proc.stderr}')
@@ -72,4 +74,6 @@ def build(force: bool = False, verbose: bool = False) -> Path:
 if __name__ == '__main__':
     force = '--force' in sys.argv
     path = build(force=force, verbose=True)
-    print(f'built {path}')
+    from da4ml_tpu.telemetry import get_logger
+
+    get_logger('native.build').info(f'built {path}')

@@ -18,6 +18,11 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import telemetry
+
+#: numeric encoding of breaker states for the ``breaker.state.<name>`` gauge
+_STATE_CODE = {'closed': 0.0, 'half-open': 0.5, 'open': 1.0}
+
 
 class CircuitBreaker:
     def __init__(self, name: str, fail_threshold: int = 3, reset_after: float = 30.0):
@@ -28,6 +33,14 @@ class CircuitBreaker:
         self._opened_at: float | None = None
         self._probing = False
         self._lock = threading.Lock()
+
+    def _note_transition(self, old: str, new: str) -> None:
+        """Record a state change (called outside the lock)."""
+        if old == new:
+            return
+        telemetry.gauge(f'breaker.state.{self.name}').set(_STATE_CODE.get(new, -1.0))
+        telemetry.counter('breaker.transitions').inc()
+        telemetry.instant('breaker.transition', breaker=self.name, frm=old, to=new)
 
     @property
     def state(self) -> str:
@@ -52,16 +65,23 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was_open = self._opened_at is not None
             self._failures = 0
             self._opened_at = None
             self._probing = False
+        if was_open:
+            self._note_transition('open', 'closed')
 
     def record_failure(self) -> None:
         with self._lock:
+            was_open = self._opened_at is not None
             self._failures += 1
-            if self._failures >= self.fail_threshold or self._opened_at is not None:
+            opens = self._failures >= self.fail_threshold or self._opened_at is not None
+            if opens:
                 self._opened_at = time.monotonic()
             self._probing = False
+        if opens and not was_open:
+            self._note_transition('closed', 'open')
 
 
 _registry: dict[str, CircuitBreaker] = {}

@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from .. import telemetry
 from .breaker import breaker_for
 from .checkpoint import CheckpointStore, kernel_key, store_for
 from .deadline import run_with_deadline
@@ -155,9 +156,45 @@ def solve_orchestrated(
     Raises :class:`SolveTimeout` when the deadline elapses, the ``fatal``
     error unchanged when the request itself is bad, and
     :class:`BackendUnavailable` when every chain backend failed.
+
+    When a ``report`` is passed (or a trace sink is active), the chain walk
+    runs under a telemetry phase collector: solver-phase wall clocks land in
+    ``report.phases`` and each attempt records its span id.
     """
-    fault_check('cmvm.solve')
+    # Collect phase timings only when someone will read them — a passed-in
+    # report or an active trace. The default (report=None, no sink) path
+    # keeps the span machinery fully disabled.
+    want_phases = report is not None or telemetry.tracing_active()
     report = report if report is not None else SolveReport()
+    if not want_phases:
+        with telemetry.span('reliability.solve', backend=backend) as sp:
+            report.trace_span_id = sp.span_id
+            return _solve_orchestrated_impl(
+                kernel, solve_kwargs, backend, fallback, deadline, report, checkpoint, retries, retry_base_delay
+            )
+    with telemetry.collect_phases() as phases:
+        with telemetry.span('reliability.solve', backend=backend) as sp:
+            report.trace_span_id = sp.span_id
+            try:
+                return _solve_orchestrated_impl(
+                    kernel, solve_kwargs, backend, fallback, deadline, report, checkpoint, retries, retry_base_delay
+                )
+            finally:  # phases are useful diagnostics on failure too
+                report.phases.update(phases)
+
+
+def _solve_orchestrated_impl(
+    kernel,
+    solve_kwargs: dict,
+    backend: str,
+    fallback,
+    deadline: float | None,
+    report: SolveReport,
+    checkpoint: 'CheckpointStore | str | os.PathLike | None',
+    retries: int,
+    retry_base_delay: float,
+):
+    fault_check('cmvm.solve')
     chain = resolve_chain(backend, fallback)
     report.requested_backend = backend
     report.chain = chain
@@ -174,8 +211,10 @@ def solve_orchestrated(
 
             report.checkpoint_hits += 1
             report.backend_used = hit.get('backend', 'checkpoint')
+            telemetry.counter('checkpoint.hits').inc()
             return Pipeline.from_dict(hit['pipeline'])
         report.checkpoint_misses += 1
+        telemetry.counter('checkpoint.misses').inc()
 
     t_start = time.monotonic()
     last_exc: BaseException | None = None
@@ -191,6 +230,7 @@ def solve_orchestrated(
         br = breaker_for(bk)
         if not br.allow():
             report.skip(bk, f'circuit breaker open ({br.state})')
+            telemetry.instant('reliability.breaker_skip', backend=bk, state=br.state)
             continue
         att = report.start_attempt(bk)
         t_att = time.monotonic()
@@ -208,8 +248,15 @@ def solve_orchestrated(
                     raise SolveTimeout(f'solve deadline {deadline:.3g}s exhausted retrying backend {bk!r}')
             return run_with_deadline(_call_backend, rem, bk, kernel, solve_kwargs, name=f'solve[{bk}]')
 
+        sp_att = telemetry.span(
+            'reliability.attempt',
+            backend=bk,
+            **({} if remaining is None else {'deadline_remaining_s': round(remaining, 4)}),
+        )
+        att.span_id = sp_att.span_id
         try:
-            result = retry_call(_attempt, retries=retries, base_delay=retry_base_delay, on_retry=_on_retry)
+            with sp_att:
+                result = retry_call(_attempt, retries=retries, base_delay=retry_base_delay, on_retry=_on_retry)
         except BaseException as exc:  # noqa: BLE001 - classified below
             att.duration_s = time.monotonic() - t_att
             kind = classify(exc)
@@ -220,6 +267,8 @@ def solve_orchestrated(
                 raise
             if isinstance(exc, SolveTimeout) and deadline is not None and time.monotonic() - t_start >= deadline:
                 raise  # the overall budget is gone: surface the timeout, not chain exhaustion
+            telemetry.counter('fallback.events').inc()
+            telemetry.instant('reliability.fallback', backend=bk, error=type(exc).__name__, kind=kind)
             last_exc = exc
             continue
         att.ok = True
@@ -258,19 +307,28 @@ def solve_many(
     store = None
     if checkpoint is not None:
         store = checkpoint if isinstance(checkpoint, CheckpointStore) else store_for(checkpoint)
+    kernels = list(kernels)
+    telemetry.gauge('campaign.total').set(len(kernels))
     results = []
-    for kern in kernels:
-        results.append(
-            solve_orchestrated(
-                np.asarray(kern, dtype=np.float64),
-                solver_options,
-                backend=backend,
-                fallback=fallback,
-                deadline=deadline_per_solve,
-                report=report,
-                checkpoint=store,
+    with telemetry.span('reliability.solve_many', n_kernels=len(kernels), backend=backend):
+        for i, kern in enumerate(kernels):
+            results.append(
+                solve_orchestrated(
+                    np.asarray(kern, dtype=np.float64),
+                    solver_options,
+                    backend=backend,
+                    fallback=fallback,
+                    deadline=deadline_per_solve,
+                    report=report,
+                    checkpoint=store,
+                )
             )
-        )
+            # campaign progress heartbeat: visible live in a JSONL trace tail
+            # and as a counter track in Perfetto
+            telemetry.gauge('campaign.done').set(i + 1)
+            telemetry.instant(
+                'campaign.progress', done=i + 1, total=len(kernels), checkpoint_hits=report.checkpoint_hits
+            )
     return results, report
 
 
@@ -294,6 +352,12 @@ def run_program(
     report.chain = tuple(chain)
     report.deadline_s = deadline
 
+    with telemetry.span('runtime.run_program', chain=','.join(chain)) as sp:
+        report.trace_span_id = sp.span_id
+        return _run_program_impl(binary, data, chain, deadline, report, retries)
+
+
+def _run_program_impl(binary, data, chain, deadline, report: SolveReport, retries: int):
     def _call(bk: str):
         if bk == 'jax':
             fault_check('runtime.jax')
@@ -321,6 +385,7 @@ def run_program(
         br = breaker_for(f'runtime.{bk}')
         if not br.allow():
             report.skip(bk, f'circuit breaker open ({br.state})')
+            telemetry.instant('reliability.breaker_skip', backend=f'runtime.{bk}', state=br.state)
             continue
         att = report.start_attempt(bk)
         t_att = time.monotonic()
@@ -336,8 +401,11 @@ def run_program(
                     raise SolveTimeout(f'run_program deadline {deadline:.3g}s exhausted retrying {bk!r}')
             return run_with_deadline(_call, rem, bk, name=f'run[{bk}]')
 
+        sp_att = telemetry.span('runtime.attempt', backend=bk)
+        att.span_id = sp_att.span_id
         try:
-            result = retry_call(_attempt, retries=retries, on_retry=_on_retry)
+            with sp_att:
+                result = retry_call(_attempt, retries=retries, on_retry=_on_retry)
         except BaseException as exc:  # noqa: BLE001
             att.duration_s = time.monotonic() - t_att
             kind = classify(exc)
@@ -348,6 +416,8 @@ def run_program(
                 raise
             if isinstance(exc, SolveTimeout) and deadline is not None and time.monotonic() - t_start >= deadline:
                 raise  # the overall budget is gone: surface the timeout, not chain exhaustion
+            telemetry.counter('fallback.events').inc()
+            telemetry.instant('reliability.fallback', backend=f'runtime.{bk}', error=type(exc).__name__, kind=kind)
             last_exc = exc
             continue
         att.ok = True

@@ -23,6 +23,7 @@ class Attempt:
     error_kind: str | None = None  # 'retryable' | 'fallback' | 'fatal' | 'skipped'
     duration_s: float = 0.0
     retries: int = 0
+    span_id: int | None = None  # telemetry span of this attempt (None when telemetry is off)
 
     def to_dict(self) -> dict:
         return {
@@ -32,6 +33,7 @@ class Attempt:
             'error_kind': self.error_kind,
             'duration_s': round(self.duration_s, 4),
             'retries': self.retries,
+            'span_id': self.span_id,
         }
 
 
@@ -48,6 +50,12 @@ class SolveReport:
     checkpoint_misses: int = 0
     started_at: float = field(default_factory=time.time)
     total_duration_s: float = 0.0
+    #: cumulative seconds per telemetry span name observed during this solve
+    #: (e.g. 'cmvm.jax.stage0', 'cmvm.dispatch') — filled by the orchestrator
+    #: through telemetry.collect_phases() whenever a report is requested
+    phases: dict[str, float] = field(default_factory=dict)
+    #: telemetry span id of the orchestrated solve (None when telemetry is off)
+    trace_span_id: int | None = None
 
     @property
     def degraded(self) -> bool:
@@ -73,6 +81,8 @@ class SolveReport:
             'checkpoint_hits': self.checkpoint_hits,
             'checkpoint_misses': self.checkpoint_misses,
             'total_duration_s': round(self.total_duration_s, 4),
+            'phases': {k: round(v, 6) for k, v in sorted(self.phases.items())},
+            'trace_span_id': self.trace_span_id,
         }
 
     def summary(self) -> str:

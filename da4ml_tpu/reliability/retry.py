@@ -13,6 +13,7 @@ import random
 import time
 from typing import Any, Callable
 
+from .. import telemetry
 from .errors import classify
 
 
@@ -46,5 +47,7 @@ def retry_call(
                 delay *= random.random()
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
+            telemetry.counter('retry.sleeps').inc()
+            telemetry.histogram('retry.delay_s').observe(delay)
             sleep(delay)
             attempt += 1

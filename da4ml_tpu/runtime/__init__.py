@@ -7,8 +7,12 @@
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 from numpy.typing import NDArray
+
+from .. import telemetry
 
 
 def run_comb(
@@ -31,6 +35,17 @@ def run_comb(
             backend = 'cpp' if is_available() else 'numpy'
         except Exception:
             backend = 'numpy'
+    _metrics = telemetry.metrics_on()
+    _t0 = time.perf_counter() if _metrics else 0.0
+    with telemetry.span('runtime.run_comb', backend=backend, n_samples=len(data)):
+        result = _run_comb_backend(binary, data, backend, n_threads, mesh)
+    if _metrics:
+        telemetry.histogram('runtime.run_s').observe(time.perf_counter() - _t0)
+        telemetry.counter('runtime.samples').inc(len(data))
+    return result
+
+
+def _run_comb_backend(binary, data, backend: str, n_threads: int, mesh) -> NDArray[np.float64]:
     if backend == 'numpy':
         from .numpy_backend import run_binary
 

@@ -1,0 +1,91 @@
+"""Observability layer: spans, metrics, and trace export (docs/telemetry.md).
+
+Activation (disabled by default, near-zero overhead when off):
+
+- ``DA4ML_TRACE=<path>`` in the environment — opens a trace sink at import
+  (``.jsonl`` → streaming event log, else Chrome trace-event JSON for
+  Perfetto / chrome://tracing) and enables the metrics registry;
+- programmatically: ``telemetry.enable(path)`` / ``telemetry.disable()``;
+- ``da4ml-tpu convert --trace <path>`` on the CLI, and ``da4ml-tpu stats
+  <path>`` to summarize a captured trace.
+
+Instrumentation API (all safe to call when disabled)::
+
+    from da4ml_tpu import telemetry
+
+    with telemetry.span('cmvm.solve', backend='jax') as sp:
+        ...
+        sp.set(cost=result.cost)
+
+    telemetry.counter('jit.cache_miss').inc()
+    telemetry.histogram('solve.duration_s').observe(dt)
+    telemetry.gauge('campaign.done').set(i)
+    telemetry.instant('campaign.progress', done=i, total=n)
+    log = telemetry.get_logger('cmvm.jax')
+"""
+
+from .core import (
+    Span,
+    add_sink,
+    collect_phases,
+    disable,
+    enable,
+    instant,
+    remove_sink,
+    reset,
+    span,
+    tracing_active,
+)
+from .export import (
+    REQUIRED_EVENT_KEYS,
+    ChromeTraceSink,
+    JsonlSink,
+    load_trace,
+    sink_for,
+    validate_trace,
+)
+from .log import get_logger
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    counter,
+    gauge,
+    histogram,
+    metrics_on,
+    metrics_snapshot,
+)
+
+__all__ = [
+    'Span',
+    'span',
+    'instant',
+    'collect_phases',
+    'enable',
+    'disable',
+    'reset',
+    'add_sink',
+    'remove_sink',
+    'tracing_active',
+    'sink_for',
+    'ChromeTraceSink',
+    'JsonlSink',
+    'load_trace',
+    'validate_trace',
+    'REQUIRED_EVENT_KEYS',
+    'counter',
+    'gauge',
+    'histogram',
+    'metrics_on',
+    'metrics_snapshot',
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'DEFAULT_BUCKETS',
+    'get_logger',
+]
+
+from .core import _init_from_env
+
+_init_from_env()

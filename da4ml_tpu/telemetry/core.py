@@ -1,0 +1,295 @@
+"""Telemetry core: hierarchical spans, event sinks, and phase collectors.
+
+Design constraints (docs/telemetry.md):
+
+- **Near-zero overhead when disabled.** ``span()`` checks one module-level
+  flag and returns a shared no-op singleton — no object allocation, no
+  clock read — so the hot solve path costs one function call + one
+  attribute read per instrumentation site when nothing is listening.
+- **Thread-safe.** Each thread owns its span stack (parentage never crosses
+  threads); sinks are appended under a lock but read lock-free as an
+  immutable tuple; span ids come from ``itertools.count`` (atomic under the
+  GIL).
+- **Fork-safe.** Sinks record their creating pid and drop events from
+  forked children (the host dc-sweep uses a fork pool), so a child's atexit
+  can never corrupt the parent's trace file.
+
+Spans deliver Chrome trace-event ``"X"`` (complete) events to every
+registered sink; :func:`instant` delivers ``"i"`` events. Phase collectors
+(:func:`collect_phases`) aggregate closed-span durations per name on the
+calling thread — the reliability orchestrator uses one to attach phase
+timings to a ``SolveReport`` without requiring a trace file.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+_T0 = time.perf_counter()
+_PID = os.getpid()
+_ids = itertools.count(1)
+
+
+def _now_us() -> float:
+    """Microseconds since the telemetry epoch (module import)."""
+    return (time.perf_counter() - _T0) * 1e6
+
+
+class _State:
+    __slots__ = ('sinks', 'collectors', 'active', 'lock')
+
+    def __init__(self):
+        self.sinks: tuple = ()  # immutable tuple -> lock-free reads on the hot path
+        self.collectors = 0  # process-wide count of open collect_phases() blocks
+        self.active = False  # sinks or collectors present
+        self.lock = threading.Lock()
+
+    def refresh(self) -> None:
+        self.active = bool(self.sinks) or self.collectors > 0
+
+
+_state = _State()
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, 'stack', None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _collectors() -> list:
+    pc = getattr(_tls, 'phases', None)
+    if pc is None:
+        pc = _tls.phases = []
+    return pc
+
+
+def _tid() -> int:
+    return threading.get_ident() & 0x7FFFFFFF
+
+
+def _emit(event: dict) -> None:
+    for sink in _state.sinks:
+        try:
+            sink.emit(event)
+        except Exception:
+            pass  # a broken sink must never fail the instrumented call
+
+
+class Span:
+    """One timed region. Context manager; nests via the per-thread stack."""
+
+    __slots__ = ('name', 'attrs', 'span_id', 'parent_id', 't0', 'ts_us', 'duration_s')
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(_ids)
+        self.parent_id: int | None = None
+        self.t0 = 0.0
+        self.ts_us = 0.0
+        self.duration_s = 0.0
+
+    def set(self, **attrs) -> 'Span':
+        """Attach attributes after entry (e.g. a result count known at exit)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __bool__(self) -> bool:
+        return True
+
+    def __enter__(self) -> 'Span':
+        st = _stack()
+        self.parent_id = st[-1].span_id if st else None
+        st.append(self)
+        self.t0 = time.perf_counter()
+        self.ts_us = (self.t0 - _T0) * 1e6
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration_s = time.perf_counter() - self.t0
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:  # unbalanced exit: drop self and everything above it
+            del st[st.index(self) :]
+        if exc_type is not None:
+            self.attrs['error'] = exc_type.__name__
+        if _state.sinks:
+            args = dict(self.attrs)
+            args['span_id'] = self.span_id
+            if self.parent_id is not None:
+                args['parent_id'] = self.parent_id
+            _emit(
+                {
+                    'name': self.name,
+                    'ph': 'X',
+                    'ts': round(self.ts_us, 1),
+                    'dur': round(self.duration_s * 1e6, 1),
+                    'pid': _PID,
+                    'tid': _tid(),
+                    'args': args,
+                }
+            )
+        for phases in _collectors():
+            phases[self.name] = phases.get(self.name, 0.0) + self.duration_s
+        return False
+
+
+class _NoopSpan:
+    """Shared disabled-path span: reusable, reentrant, allocation-free."""
+
+    __slots__ = ()
+    span_id = None
+    parent_id = None
+    duration_s = 0.0
+
+    def set(self, **attrs) -> '_NoopSpan':
+        return self
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __enter__(self) -> '_NoopSpan':
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def span(name: str, /, **attrs):
+    """A timed region. Returns the no-op singleton when telemetry is off.
+
+    ``name`` is positional-only so an attribute may also be called "name"
+    (e.g. ``span('codegen.rtl.write', name=model.name)``)."""
+    if not _state.active:
+        return _NOOP_SPAN
+    return Span(name, attrs)
+
+
+def instant(name: str, /, **attrs) -> None:
+    """A point-in-time event (campaign heartbeats, breaker transitions)."""
+    if not _state.sinks:
+        return
+    _emit(
+        {
+            'name': name,
+            'ph': 'i',
+            's': 't',
+            'ts': round(_now_us(), 1),
+            'pid': _PID,
+            'tid': _tid(),
+            'args': attrs,
+        }
+    )
+
+
+class _PhaseCollector:
+    """Aggregates closed-span durations by name on the entering thread."""
+
+    __slots__ = ('phases',)
+
+    def __enter__(self) -> dict:
+        self.phases: dict[str, float] = {}
+        _collectors().append(self.phases)
+        with _state.lock:
+            _state.collectors += 1
+            _state.refresh()
+        return self.phases
+
+    def __exit__(self, exc_type, exc, tb):
+        pcs = _collectors()
+        if self.phases in pcs:
+            pcs.remove(self.phases)
+        with _state.lock:
+            _state.collectors -= 1
+            _state.refresh()
+        return False
+
+
+def collect_phases() -> _PhaseCollector:
+    """Context manager yielding a ``{span name: cumulative seconds}`` dict
+    of every span closed on this thread while the block is open. Activates
+    the span machinery even without a trace sink."""
+    return _PhaseCollector()
+
+
+# ---------------------------------------------------------------------------
+# sink management / activation
+# ---------------------------------------------------------------------------
+
+
+def add_sink(sink) -> None:
+    with _state.lock:
+        _state.sinks = _state.sinks + (sink,)
+        _state.refresh()
+
+
+def remove_sink(sink) -> None:
+    with _state.lock:
+        _state.sinks = tuple(s for s in _state.sinks if s is not sink)
+        _state.refresh()
+
+
+def tracing_active() -> bool:
+    """True when at least one event sink is registered."""
+    return bool(_state.sinks)
+
+
+def enable(path: 'str | os.PathLike | None' = None, metrics: bool = True):
+    """Turn telemetry on: enable the metrics registry and (optionally) open a
+    trace sink at ``path`` (``.jsonl`` → JSONL event log, anything else →
+    Chrome trace-event JSON for Perfetto / chrome://tracing).
+
+    Returns the created sink (or None when no path was given). Equivalent to
+    setting ``DA4ML_TRACE=<path>`` in the environment before import.
+    """
+    if metrics:
+        from .metrics import enable_metrics
+
+        enable_metrics()
+    if path:
+        from .export import sink_for
+
+        sink = sink_for(path)
+        add_sink(sink)
+        return sink
+    return None
+
+
+def disable() -> None:
+    """Close and unregister every sink (flushing trace files) and freeze the
+    metrics registry. Recorded metric values are kept until :func:`reset`."""
+    with _state.lock:
+        sinks, _state.sinks = _state.sinks, ()
+        _state.refresh()
+    for sink in sinks:
+        try:
+            sink.close()
+        except Exception:
+            pass
+    from .metrics import disable_metrics
+
+    disable_metrics()
+
+
+def reset() -> None:
+    """Full teardown for test isolation: close sinks, drop all metric values."""
+    disable()
+    from .metrics import reset_metrics
+
+    reset_metrics()
+
+
+def _init_from_env() -> None:
+    path = os.environ.get('DA4ML_TRACE')
+    if path:
+        enable(path)

@@ -1,0 +1,187 @@
+"""Trace exporters: Chrome trace-event JSON and a JSONL event log.
+
+Both sinks receive the same event dicts from :mod:`.core` (Chrome
+trace-event schema: ``name``, ``ph``, ``ts``/``dur`` in microseconds,
+``pid``, ``tid``, ``args``).
+
+- :class:`ChromeTraceSink` buffers events in memory and writes one
+  ``{"traceEvents": [...], "otherData": {"metrics": ...}}`` JSON document
+  at close — load it in Perfetto (https://ui.perfetto.dev) or
+  chrome://tracing. The write is atomic (tmp + rename, same idiom as the
+  reliability checkpoints).
+- :class:`JsonlSink` streams one JSON object per line as events close, so
+  a killed process still leaves a readable prefix; the metrics snapshot is
+  appended as a final ``ph: "M"`` record at close.
+
+Both are fork-safe (events from a forked child are dropped — the child
+inherited the parent's buffer/handle and must not corrupt its file) and
+registered with ``atexit`` so an unclosed trace still flushes.
+
+:func:`load_trace` / :func:`validate_trace` are the shared readers used by
+the ``da4ml-tpu stats`` renderer, the tests, and the CI smoke check.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+#: keys every exported event must carry (the CI smoke step checks these)
+REQUIRED_EVENT_KEYS = ('name', 'ph', 'ts', 'pid', 'tid')
+
+
+def _json_default(obj):
+    return str(obj)
+
+
+class ChromeTraceSink:
+    def __init__(self, path: 'str | os.PathLike'):
+        self.path = Path(path)
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._closed = False
+        atexit.register(self.close)
+
+    def emit(self, event: dict) -> None:
+        if self._closed or os.getpid() != self._pid:
+            return
+        with self._lock:
+            self._events.append(event)
+
+    def close(self) -> None:
+        if self._closed or os.getpid() != self._pid:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            events = self._events
+        from .metrics import metrics_snapshot
+
+        payload = {
+            'traceEvents': events,
+            'displayTimeUnit': 'ms',
+            'otherData': {
+                'producer': 'da4ml_tpu.telemetry',
+                'pid': self._pid,
+                'unix_time': time.time(),
+                'metrics': metrics_snapshot(),
+            },
+        }
+        tmp = self.path.with_name(self.path.name + f'.tmp.{self._pid}')
+        tmp.parent.mkdir(parents=True, exist_ok=True)
+        with open(tmp, 'w') as fh:
+            json.dump(payload, fh, default=_json_default)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+
+
+class JsonlSink:
+    def __init__(self, path: 'str | os.PathLike'):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, 'w')
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._closed = False
+        atexit.register(self.close)
+
+    def emit(self, event: dict) -> None:
+        if self._closed or os.getpid() != self._pid:
+            return
+        line = json.dumps(event, default=_json_default)
+        with self._lock:
+            if not self._closed:
+                self._fh.write(line + '\n')
+
+    def close(self) -> None:
+        if self._closed or os.getpid() != self._pid:
+            return
+        from .core import _PID, _now_us
+        from .metrics import metrics_snapshot
+
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            snap = metrics_snapshot()
+            if snap:
+                self._fh.write(
+                    json.dumps(
+                        {
+                            'name': 'metrics',
+                            'ph': 'M',
+                            'ts': round(_now_us(), 1),
+                            'pid': _PID,
+                            'tid': 0,
+                            'args': {'metrics': snap},
+                        },
+                        default=_json_default,
+                    )
+                    + '\n'
+                )
+            self._fh.close()
+
+
+def sink_for(path: 'str | os.PathLike'):
+    """Pick the exporter from the file extension: ``.jsonl`` streams an
+    event log, anything else buffers Chrome trace-event JSON."""
+    if str(path).endswith('.jsonl'):
+        return JsonlSink(path)
+    return ChromeTraceSink(path)
+
+
+# ---------------------------------------------------------------------------
+# readers (stats CLI, tests, CI validation)
+# ---------------------------------------------------------------------------
+
+
+def load_trace(path: 'str | os.PathLike') -> tuple[list[dict], dict]:
+    """Read a trace file in either format. Returns ``(events, metrics)``."""
+    text = Path(path).read_text()
+    if not text.strip():
+        return [], {}
+    if text.lstrip()[0] == '{' and '\n{' not in text.strip():
+        doc = json.loads(text)
+        if isinstance(doc, dict) and 'traceEvents' in doc:
+            return doc['traceEvents'], doc.get('otherData', {}).get('metrics', {})
+        if isinstance(doc, list):
+            return doc, {}
+    events: list[dict] = []
+    metrics: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        ev = json.loads(line)
+        if ev.get('ph') == 'M' and ev.get('name') == 'metrics':
+            metrics = ev.get('args', {}).get('metrics', {})
+        else:
+            events.append(ev)
+    return events, metrics
+
+
+def validate_trace(events: list[dict]) -> None:
+    """Raise ``ValueError`` unless every event carries the Chrome trace-event
+    required keys with sane types (``dur`` additionally for ``X`` events)."""
+    if not events:
+        raise ValueError('trace contains no events')
+    for i, ev in enumerate(events):
+        for key in REQUIRED_EVENT_KEYS:
+            if key not in ev:
+                raise ValueError(f'event {i} missing required key {key!r}: {ev}')
+        if not isinstance(ev['name'], str) or not ev['name']:
+            raise ValueError(f'event {i} has a non-string name: {ev}')
+        if ev['ph'] not in ('X', 'B', 'E', 'i', 'C', 'M'):
+            raise ValueError(f'event {i} has unknown phase {ev["ph"]!r}')
+        for key in ('ts', 'pid', 'tid'):
+            if not isinstance(ev[key], (int, float)):
+                raise ValueError(f'event {i} key {key!r} is not numeric: {ev}')
+        if ev['ph'] == 'X' and not isinstance(ev.get('dur'), (int, float)):
+            raise ValueError(f'complete event {i} lacks a numeric dur: {ev}')

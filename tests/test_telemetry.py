@@ -1,0 +1,424 @@
+"""Telemetry subsystem: spans, metrics, exporters, and pipeline integration.
+
+Covers the observability acceptance surface (docs/telemetry.md):
+
+- disabled path: no-op singleton, zero events, <2% solve overhead;
+- span nesting and thread-safety under parallel multi-worker solves;
+- Chrome trace-event JSON schema validity (ph/ts/pid/tid/name keys);
+- metrics round-trip through ``SolveReport.to_dict()``;
+- a full trace→solve→codegen run producing spans from four subsystems;
+- CLI ``--trace`` capture and the ``stats`` renderer.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from da4ml_tpu import telemetry
+from da4ml_tpu._cli import main
+from da4ml_tpu.cmvm import solve
+from da4ml_tpu.reliability import SolveReport
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    """Telemetry is process-global state: start and leave every test clean."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _small_kernel(seed=3, n=6, m=4):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-8, 8, (n, m)).astype(np.float64)
+
+
+def _traced_comb():
+    """trace → cmvm solve (orchestrated) → CombLogic, as a conversion does."""
+    rng = np.random.default_rng(7)
+    inp = FixedVariableArrayInput(6, HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 3), np.full(6, 2))
+    w = rng.integers(-8, 8, (6, 4)).astype(np.float64)
+    return comb_trace(inp, (x @ w).relu(i=np.full(4, 6), f=np.full(4, 2)))
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_no_sink_receives_events(monkeypatch):
+    monkeypatch.delenv('DA4ML_TRACE', raising=False)
+    received = []
+
+    class Probe:
+        def emit(self, ev):
+            received.append(ev)
+
+        def close(self):
+            pass
+
+    # the probe exists but is never registered — exactly the DA4ML_TRACE-unset
+    # state: no sink, so nothing anywhere may receive events
+    Probe()
+    assert not telemetry.tracing_active()
+    assert telemetry.span('a') is telemetry.span('b')  # shared no-op singleton
+    solve(_small_kernel(), backend='cpu')
+    assert received == []
+    assert telemetry.metrics_snapshot() == {}  # metrics registry never armed
+
+
+def test_noop_span_is_reusable_and_falsy():
+    sp = telemetry.span('x', k=1)
+    assert not sp
+    with sp as inner:
+        assert inner.span_id is None
+        inner.set(more=2)  # must not raise
+    with sp:  # reentrant
+        pass
+
+
+def test_disabled_overhead_under_2pct():
+    """Acceptance: telemetry-disabled instrumentation costs <2% of a solve."""
+    kernel = _small_kernel(5, 8, 8)
+    solve(kernel, backend='cpu')  # warm caches
+    t0 = time.perf_counter()
+    solve(kernel, backend='cpu')
+    solve_s = time.perf_counter() - t0
+
+    n = 10_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with telemetry.span('bench.noop', backend='cpu'):
+            pass
+        telemetry.counter('bench.noop').inc()
+        telemetry.histogram('bench.noop_s').observe(0.0)
+    per_call = (time.perf_counter() - t0) / n
+    # one solve passes ~dozens of instrumentation sites; budget 100 of them
+    assert 100 * per_call < 0.02 * solve_s, (per_call, solve_s)
+
+
+# ---------------------------------------------------------------------------
+# spans: nesting, threads, exporters
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_chrome_schema(tmp_path):
+    path = tmp_path / 'trace.json'
+    telemetry.enable(path)
+    with telemetry.span('outer', kind='test') as so:
+        with telemetry.span('mid') as sm:
+            with telemetry.span('leaf') as sl:
+                pass
+        assert sm.parent_id == so.span_id
+        assert sl.parent_id == sm.span_id
+    telemetry.instant('tick', n=1)
+    telemetry.disable()
+
+    events, _ = telemetry.load_trace(path)
+    telemetry.validate_trace(events)
+    by_name = {e['name']: e for e in events}
+    assert by_name['leaf']['args']['parent_id'] == by_name['mid']['args']['span_id']
+    assert by_name['mid']['args']['parent_id'] == by_name['outer']['args']['span_id']
+    assert 'parent_id' not in by_name['outer']['args']
+    assert by_name['tick']['ph'] == 'i'
+    # containment: a child span lies inside its parent's [ts, ts+dur] window
+    for child, parent in (('leaf', 'mid'), ('mid', 'outer')):
+        c, p = by_name[child], by_name[parent]
+        assert c['ts'] >= p['ts'] - 1e-6
+        assert c['ts'] + c['dur'] <= p['ts'] + p['dur'] + 1e-6
+
+
+def test_jsonl_sink_streams_and_appends_metrics(tmp_path):
+    path = tmp_path / 'trace.jsonl'
+    telemetry.enable(path)
+    with telemetry.span('one'):
+        pass
+    telemetry.counter('c.x').inc(2)
+    telemetry.disable()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]['name'] == 'one' and lines[0]['ph'] == 'X'
+    assert lines[-1]['ph'] == 'M' and lines[-1]['args']['metrics']['c.x']['value'] == 2.0
+    events, metrics = telemetry.load_trace(path)
+    telemetry.validate_trace(events)
+    assert metrics['c.x']['value'] == 2.0
+
+
+def test_span_thread_safety_parallel_solves(tmp_path):
+    """Concurrent multi-worker solves: per-thread stacks must keep parentage
+    within one thread and every exported event schema-valid."""
+    path = tmp_path / 'trace.json'
+    telemetry.enable(path)
+    kernels = [_small_kernel(seed) for seed in range(8)]
+
+    def one(kern):
+        report = SolveReport()
+        solve(kern, backend='cpu', report=report)
+        return report
+
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        reports = list(ex.map(one, kernels))
+    telemetry.disable()
+
+    events, _ = telemetry.load_trace(path)
+    telemetry.validate_trace(events)
+    spans = [e for e in events if e['ph'] == 'X']
+    assert len({e['tid'] for e in spans}) > 1  # genuinely multi-threaded
+    # parent links never cross threads
+    by_id = {e['args']['span_id']: e for e in spans}
+    for e in spans:
+        parent = e['args'].get('parent_id')
+        if parent is not None and parent in by_id:
+            assert by_id[parent]['tid'] == e['tid']
+    # every solve recorded its own root + attempt spans
+    roots = [e for e in spans if e['name'] == 'reliability.solve']
+    assert len(roots) == len(kernels)
+    for rep in reports:
+        assert rep.backend_used == 'pure-python'
+        assert rep.phases  # phase collector worked on every worker thread
+
+
+def test_collect_phases_is_thread_local():
+    done = threading.Event()
+    leaked = {}
+
+    def other():
+        done.wait(5)
+        with telemetry.span('other.span'):
+            pass
+
+    t = threading.Thread(target=other)
+    with telemetry.collect_phases() as phases:
+        t.start()
+        with telemetry.span('mine.span'):
+            pass
+        done.set()
+        t.join()
+        leaked = dict(phases)
+    assert 'mine.span' in leaked
+    assert 'other.span' not in leaked
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_roundtrip():
+    telemetry.enable(metrics=True)
+    telemetry.counter('t.count').inc()
+    telemetry.counter('t.count').inc(4)
+    telemetry.gauge('t.gauge').set(2.5)
+    h = telemetry.histogram('t.hist')
+    for v in (0.0002, 0.02, 3.0):
+        h.observe(v)
+    snap = telemetry.metrics_snapshot()
+    assert snap['t.count'] == {'type': 'counter', 'value': 5.0}
+    assert snap['t.gauge']['value'] == 2.5
+    hs = snap['t.hist']
+    assert hs['count'] == 3 and hs['min'] == 0.0002 and hs['max'] == 3.0
+    assert sum(hs['buckets']) == 3
+    json.dumps(snap)  # JSON-serializable end to end
+
+
+def test_metric_type_conflict_raises():
+    telemetry.enable(metrics=True)
+    telemetry.counter('t.same').inc()
+    with pytest.raises(TypeError):
+        telemetry.gauge('t.same')
+
+
+def test_breaker_transitions_recorded():
+    from da4ml_tpu.reliability.breaker import CircuitBreaker
+
+    telemetry.enable(metrics=True)
+    br = CircuitBreaker('probe', fail_threshold=2, reset_after=30.0)
+    br.record_failure()
+    br.record_failure()  # opens
+    snap = telemetry.metrics_snapshot()
+    assert snap['breaker.state.probe']['value'] == 1.0
+    assert snap['breaker.transitions']['value'] == 1.0
+    br.record_success()  # closes
+    snap = telemetry.metrics_snapshot()
+    assert snap['breaker.state.probe']['value'] == 0.0
+    assert snap['breaker.transitions']['value'] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# SolveReport integration
+# ---------------------------------------------------------------------------
+
+
+def test_solve_report_phases_and_span_ids(tmp_path):
+    path = tmp_path / 'trace.json'
+    telemetry.enable(path)
+    report = SolveReport()
+    solve(_small_kernel(), backend='cpu', report=report)
+    telemetry.disable()
+
+    d = report.to_dict()
+    assert d['backend_used'] == 'pure-python'
+    assert d['phases'], 'phase timings must be attached'
+    assert 'cmvm.dispatch' in d['phases']
+    assert all(v >= 0 for v in d['phases'].values())
+    assert isinstance(d['trace_span_id'], int)
+    assert all(isinstance(a['span_id'], int) for a in d['attempts'])
+    json.dumps(d)  # the whole report stays JSON-serializable
+
+
+def test_solve_report_phases_without_sink():
+    """A passed-in report collects phases even with no trace file at all."""
+    report = SolveReport()
+    solve(_small_kernel(), backend='cpu', report=report)
+    assert report.phases and 'cmvm.dispatch' in report.phases
+    assert report.trace_span_id is None or isinstance(report.trace_span_id, int)
+
+
+def test_campaign_heartbeats(tmp_path):
+    from da4ml_tpu.reliability import solve_many
+
+    path = tmp_path / 'trace.jsonl'
+    telemetry.enable(path)
+    kernels = [_small_kernel(seed) for seed in range(3)]
+    results, report = solve_many(kernels, backend='pure-python')
+    telemetry.disable()
+    assert len(results) == 3
+    events, metrics = telemetry.load_trace(path)
+    beats = [e for e in events if e['name'] == 'campaign.progress']
+    assert [b['args']['done'] for b in beats] == [1, 2, 3]
+    assert all(b['args']['total'] == 3 for b in beats)
+    assert metrics['campaign.done']['value'] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: four subsystems in one trace
+# ---------------------------------------------------------------------------
+
+
+def test_trace_solve_codegen_four_subsystems(tmp_path):
+    """Acceptance: one conversion-shaped run emits spans from trace, cmvm,
+    reliability, and codegen."""
+    from da4ml_tpu.codegen import RTLModel
+
+    path = tmp_path / 'trace.json'
+    telemetry.enable(path)
+    comb = _traced_comb()
+    RTLModel(comb, 'model', tmp_path / 'prj', latency_cutoff=-1).write()
+    telemetry.disable()
+
+    events, _ = telemetry.load_trace(path)
+    telemetry.validate_trace(events)
+    subsystems = {e['name'].split('.', 1)[0] for e in events if e['ph'] == 'X'}
+    assert {'trace', 'cmvm', 'reliability', 'codegen'} <= subsystems, subsystems
+
+
+def test_cli_keras_convert_trace_four_subsystems(tmp_path):
+    """Acceptance: a --trace-captured `da4ml-tpu convert` of a model file
+    yields valid Chrome trace JSON with spans from >= 4 subsystems."""
+    keras = pytest.importorskip('keras')
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input((4,)),
+            keras.layers.Dense(3, kernel_initializer='he_normal'),
+        ]
+    )
+    model_path = tmp_path / 'm.keras'
+    model.save(model_path)
+    trace_path = tmp_path / 'trace.json'
+    rc = main(
+        [
+            'convert', str(model_path), str(tmp_path / 'prj'),
+            '--trace', str(trace_path), '-n', '16', '-v', '0', '-ikif', '1', '3', '2',
+        ]  # fmt: skip
+    )
+    assert rc == 0
+    events, metrics = telemetry.load_trace(trace_path)
+    telemetry.validate_trace(events)
+    subsystems = {e['name'].split('.', 1)[0] for e in events if e['ph'] == 'X'}
+    assert {'trace', 'cmvm', 'reliability', 'codegen'} <= subsystems, subsystems
+    assert metrics['solve.calls']['value'] >= 1
+
+
+def test_env_var_activation(tmp_path):
+    """DA4ML_TRACE=<path> alone (no code changes) captures a trace."""
+    path = tmp_path / 'env_trace.json'
+    code = (
+        'import numpy as np\n'
+        'from da4ml_tpu.cmvm import solve\n'
+        "solve(np.array([[1.0, 2.0], [3.0, -1.0]]), backend='cpu')\n"
+    )
+    env = dict(os.environ, DA4ML_TRACE=str(path), JAX_PLATFORMS='cpu')
+    subprocess.run([sys.executable, '-c', code], check=True, env=env, timeout=120)
+    events, metrics = telemetry.load_trace(path)
+    telemetry.validate_trace(events)
+    assert any(e['name'] == 'cmvm.solve' for e in events)
+    assert metrics['solve.calls']['value'] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# CLI: --trace and stats
+# ---------------------------------------------------------------------------
+
+
+def test_cli_convert_trace_and_stats(tmp_path, capsys):
+    comb = _traced_comb()
+    model_json = tmp_path / 'comb.json'
+    comb.save(model_json)
+    trace_path = tmp_path / 'trace.json'
+    rc = main(
+        ['convert', str(model_json), str(tmp_path / 'prj'), '-n', '32', '-v', '0', '--trace', str(trace_path)]
+    )
+    assert rc == 0
+    events, _ = telemetry.load_trace(trace_path)
+    telemetry.validate_trace(events)
+    names = {e['name'] for e in events}
+    assert 'cli.convert' in names and 'codegen.rtl.write' in names and 'runtime.run_comb' in names
+
+    capsys.readouterr()
+    assert main(['stats', str(trace_path), '--validate']) == 0
+    out = capsys.readouterr().out
+    assert 'cli.convert' in out and 'codegen.rtl.write' in out
+
+    capsys.readouterr()
+    assert main(['stats', str(trace_path), '--json']) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['n_events'] == len(events)
+    assert doc['spans']['cli.convert']['count'] == 1
+
+
+def test_stats_missing_file(tmp_path, capsys):
+    assert main(['stats', str(tmp_path / 'nope.json')]) == 1
+
+
+# ---------------------------------------------------------------------------
+# logging satellite
+# ---------------------------------------------------------------------------
+
+
+def test_get_logger_stdout_and_stderr(capsys):
+    log = telemetry.get_logger('test.site')
+    log.info('plain info line')
+    log.warning('something odd')
+    cap = capsys.readouterr()
+    assert 'plain info line\n' in cap.out
+    assert '[WARNING] something odd\n' in cap.err
+    assert 'plain info line' not in cap.err
+
+
+def test_log_records_mirrored_into_trace(tmp_path):
+    path = tmp_path / 'trace.json'
+    telemetry.enable(path)
+    telemetry.get_logger('test.mirror').warning('breaker opened')
+    telemetry.disable()
+    events, _ = telemetry.load_trace(path)
+    warn = [e for e in events if e['name'] == 'log.warning']
+    assert warn and warn[0]['args']['message'] == 'breaker opened'
