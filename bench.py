@@ -93,9 +93,16 @@ def _host_solve(kernels, backend):
 
 
 def _host_16t_rate(n: int, host_t: float) -> float:
-    """Derived perfect-scaling 16-thread host rate (matrices/s)."""
+    """Derived perfect-scaling 16-thread host rate (matrices/s).
+
+    Clamped by the matrix count: perfect scaling can only be assumed over
+    independent work, and with n matrices there are at most n independent
+    solves — deriving a flat 16x/workers factor from n < 16 matrices
+    overstated the baseline for small configs (e.g. 2_jedi_mlp_layers).
+    """
     workers = min(HOST_THREADS, os.cpu_count() or 1)
-    return n / host_t * (HOST_THREADS / workers)
+    eff = min(HOST_THREADS, max(workers, n))
+    return n / host_t * (eff / workers)
 
 
 def _jax_solve(kernels):
@@ -345,19 +352,28 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
 
     if os.environ.get('DA4ML_BENCH_PLATFORM') == 'cpu':
         jax.config.update('jax_platforms', 'cpu')
-    try:
-        jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
-        jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
-    except Exception:
-        pass
+    # persistent compile cache: DA4ML_XLA_CACHE (legacy DA4ML_JAX_CACHE)
+    # or ~/.cache/da4ml_tpu/xla; --no-persistent-cache sets the env to '0'
+    from da4ml_tpu.cmvm.jax_search import ensure_compile_cache
+
+    ensure_compile_cache()
     host_backend = _resolve_host_backend()
 
     def _with_shape_classes(entry: dict) -> dict:
-        # distinct compiled device programs this section needed (pow2 shape
-        # classes; the persistent XLA cache makes them one-time costs)
-        from da4ml_tpu.cmvm.jax_search import _build_cse_fn
+        # distinct compiled device programs this section needed (canonical
+        # shape classes; the persistent XLA cache makes them one-time
+        # costs), the executables they expand to ((class, lane bucket)
+        # pairs), and the compile-vs-persistent-cache split of first calls
+        from da4ml_tpu.cmvm.jax_search import _build_cse_fn, executable_classes
+        from da4ml_tpu.telemetry.metrics import metrics_snapshot
 
         entry['shape_classes'] = _build_cse_fn.cache_info().currsize
+        entry['buckets'] = executable_classes()
+        snap = metrics_snapshot()
+        entry['compile_cache'] = {
+            'compile': int(snap.get('jit.compile', {}).get('value', 0)),
+            'cache_load': int(snap.get('jit.cache_load', {}).get('value', 0)),
+        }
         return entry
 
     if name == '5_full_model_trace':
@@ -544,15 +560,21 @@ def main():
 
     forced_cpu = os.environ.get('DA4ML_BENCH_PLATFORM') == 'cpu'
     platform, probe_err = probe_tpu()
-    limited = platform is None
     is_tpu = platform not in (None, 'cpu')  # a 'cpu' platform is a valid host, not a TPU
-    if limited:
+    # Any CPU XLA run — probe failure, forced, or a host with no TPU at all
+    # (probe succeeds with platform 'cpu') — uses the shrunken workloads:
+    # the full-size device sections are sized for a TPU and blow the
+    # wall-clock budget on a host CPU (round-6 finding: a no-TPU host with
+    # a HEALTHY probe previously ran the full sweep and timed out).
+    limited = not is_tpu
+    if platform is None:
         # a deliberate cpu run is not a TPU failure — report it separately
         detail['platform_forced' if forced_cpu else 'tpu_error'] = probe_err
+    if limited:
         os.environ['DA4ML_BENCH_PLATFORM'] = 'cpu'
         os.environ['JAX_PLATFORMS'] = 'cpu'
     detail['platform'] = platform or ('cpu-forced' if forced_cpu else 'cpu-fallback')
-    if limited and not forced_cpu:
+    if platform is None and not forced_cpu:
         # a real-TPU outage at capture time: attach the committed snapshot of
         # the last successful on-TPU measurement, clearly labeled as a PRIOR
         # measurement (docs/bench_snapshot.json) — never as the live result
@@ -640,7 +662,33 @@ def main():
     )
 
 
+def _parse_cache_flags(argv: list[str]) -> list[str]:
+    """Strip --cache-dir/--no-persistent-cache, arming the env they map to.
+
+    Applied before any section spawns so child processes inherit the same
+    cache policy: cold-vs-warm cache runs are both measurable
+    (``--no-persistent-cache`` for a guaranteed-cold in-process compile,
+    ``--cache-dir`` pointing at a shared path for cross-process warm runs).
+    """
+    out = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == '--no-persistent-cache':
+            os.environ['DA4ML_XLA_CACHE'] = '0'
+        elif a == '--cache-dir' and i + 1 < len(argv):
+            os.environ['DA4ML_XLA_CACHE'] = argv[i + 1]
+            i += 1
+        elif a.startswith('--cache-dir='):
+            os.environ['DA4ML_XLA_CACHE'] = a.split('=', 1)[1]
+        else:
+            out.append(a)
+        i += 1
+    return out
+
+
 if __name__ == '__main__':
+    sys.argv[1:] = _parse_cache_flags(sys.argv[1:])
     if len(sys.argv) >= 3 and sys.argv[1] == '--resume-child':
         _resume_child(sys.argv[2])
         raise SystemExit(0)

@@ -1,0 +1,136 @@
+"""Two-process persistent-compile-cache smoke drill (CPU backend).
+
+Runs the same ``solve_jax_many`` batch in two fresh processes sharing one
+persistent XLA cache dir. The first process compiles every canonical shape
+class (``jit.compile`` > 0); the second must deserialize everything
+(``jit.compile`` == 0, ``jit.cache_load`` > 0) and report a near-zero
+compile wall clock — the property the throughput-first scheduler depends
+on (docs/benchmarks.md#cold-vs-warm). CI runs this as a gate and uploads
+the stats JSON as a build artifact.
+
+Usage: python cache_smoke.py [--out stats.json] [--cache-dir DIR]
+Exit code 0 when the second process is compile-free, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _child() -> None:
+    import numpy as np
+
+    import jax
+
+    jax.config.update('jax_platforms', 'cpu')
+    from da4ml_tpu.cmvm.jax_search import ensure_compile_cache, executable_classes, solve_jax_many
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot
+
+    enable_metrics()
+    cache_dir = ensure_compile_cache()
+
+    rng = np.random.default_rng(20260804)
+    kernels = [
+        (rng.integers(0, 2**b, (d, d)) * rng.choice([-1.0, 1.0], (d, d))).astype(np.float64)
+        for d, b in ((6, 3), (8, 4))
+    ]
+    t0 = time.perf_counter()
+    sols = solve_jax_many(kernels)
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    solve_jax_many(kernels)
+    steady = time.perf_counter() - t0
+    for k, s in zip(kernels, sols):
+        assert np.array_equal(np.asarray(s.kernel, np.float64), k), 'parity violated'
+
+    snap = metrics_snapshot()
+    print(
+        json.dumps(
+            {
+                'cache_dir': cache_dir,
+                'first_s': round(first, 3),
+                'steady_s': round(steady, 3),
+                'jax_compile_s': round(max(first - steady, 0.0), 3),
+                'buckets': executable_classes(),
+                'jit_compile': int(snap.get('jit.compile', {}).get('value', 0)),
+                'jit_cache_load': int(snap.get('jit.cache_load', {}).get('value', 0)),
+                'metrics': snap,
+            }
+        )
+    )
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == '--child':
+        _child()
+        return 0
+    out_path = None
+    cache_dir = None
+    i = 0
+    while i < len(argv):
+        if argv[i] == '--out' and i + 1 < len(argv):
+            out_path = argv[i + 1]
+            i += 1
+        elif argv[i] == '--cache-dir' and i + 1 < len(argv):
+            cache_dir = argv[i + 1]
+            i += 1
+        i += 1
+
+    tmp = None
+    if cache_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix='da4ml-cache-smoke-')
+        cache_dir = tmp.name
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS='cpu',
+        DA4ML_XLA_CACHE=cache_dir,
+        # a fresh dir must be truly cold: neutralize any ambient jax cache
+        # config the invoking environment (e.g. the test conftest) exports
+        JAX_COMPILATION_CACHE_DIR='',
+    )
+    runs = []
+    try:
+        for phase in ('cold', 'warm'):
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), '--child'],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                env=env,
+            )
+            lines = [ln for ln in (r.stdout or '').splitlines() if ln.startswith('{')]
+            if r.returncode != 0 or not lines:
+                tail = (r.stderr or '').strip().splitlines()[-5:]
+                print(json.dumps({'phase': phase, 'error': ' | '.join(tail)[-400:] or f'rc={r.returncode}'}))
+                return 1
+            runs.append({'phase': phase, **json.loads(lines[-1])})
+    finally:
+        result = {
+            'metric': 'persistent_cache_smoke',
+            'runs': runs,
+            'ok': bool(
+                len(runs) == 2
+                and runs[0]['jit_compile'] > 0
+                and runs[1]['jit_compile'] == 0
+                and runs[1]['jit_cache_load'] > 0
+            ),
+        }
+        print(json.dumps({k: v for k, v in result.items() if k != 'runs'} | {'runs': [
+            {k: v for k, v in run.items() if k != 'metrics'} for run in runs
+        ]}))
+        if out_path:
+            with open(out_path, 'w') as fh:
+                json.dump(result, fh, indent=1)
+        if tmp is not None:
+            tmp.cleanup()
+    return 0 if result['ok'] else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,15 +1,29 @@
 """``da4ml-tpu warmup`` — pre-populate the persistent XLA compile cache.
 
-The device search compiles one program per (P, O, B, select, rows) shape
-class; through a remote TPU compiler a cold class costs seconds. A first
-conversion therefore pays a compile-dominated wall clock (the round-2 cold
-full-model trace measured 0.76x the host). This command runs one tiny solve
-per common shape class up front so later conversions hit the persistent
-cache (``jax_compilation_cache_dir``, env ``DA4ML_JAX_CACHE``).
+The device search compiles one program per (P, O, B, select, rows, lane
+bucket) shape class; through a remote TPU compiler a cold class costs
+seconds. A first conversion therefore pays a compile-dominated wall clock
+(the round-2 cold full-model trace measured 0.76x the host). This command
+populates the persistent cache (``jax_compilation_cache_dir``, env
+``DA4ML_XLA_CACHE``, default ``~/.cache/da4ml_tpu/xla``) up front so later
+conversions — in ANY process on this machine — deserialize compiled
+executables instead of compiling.
 
-Class lattice note: O buckets to powers of two (min 8), B to even counts,
-P to the pow2 rung ladder — so one warm class serves every matrix that
-buckets into it, across processes.
+Two mechanisms, both on by default:
+
+- ``--grid`` AOT-precompiles the **canonical bucket grid**: every rung of
+  every canonical (O, B) bucket a standard ``solve_jax_many`` over square
+  kernels up to ``--max-dim`` would walk (lower + compile, no execution —
+  mirrors the live scheduler through ``_ladder_specs``, so the classes
+  match exactly);
+- the **solve ladder** then runs one tiny real solve per dimension class,
+  which exercises upload/fetch/emit and verifies the cached executables
+  actually load.
+
+Class lattice note: O and B bucket to the canonical 2^k / 3*2^k / 5*2^k
+grid per lane, P to the pow2 rung ladder — classes are batch-independent,
+so one warm class serves every matrix that buckets into it, across
+processes (docs/api.md#bucketing).
 """
 
 from __future__ import annotations
@@ -23,27 +37,37 @@ def add_warmup_args(parser) -> None:
         '--max-dim', '-d', type=int, default=64, help='Largest square-kernel dimension class to warm (default 64)'
     )
     parser.add_argument('--bits', '-b', type=int, default=6, help='Weight bit width used for the probe kernels')
+    parser.add_argument(
+        '--cache-dir',
+        default=None,
+        help='Persistent compile cache directory (default DA4ML_XLA_CACHE or ~/.cache/da4ml_tpu/xla)',
+    )
+    parser.add_argument(
+        '--no-grid',
+        dest='grid',
+        action='store_false',
+        default=True,
+        help='Skip the AOT canonical-bucket-grid precompile (solve ladder only)',
+    )
+    parser.add_argument(
+        '--grid-only',
+        action='store_true',
+        help='AOT-precompile the canonical grid but skip the live solve ladder',
+    )
     parser.add_argument('--verbose', '-v', action='store_true')
 
 
 def warmup_main(args) -> int:
-    import jax
-
-    try:
-        # arm the persistent cache only when the process has not configured
-        # one — when warmup runs inside a conversion process (--warmup) it
-        # must never redirect a user-configured cache dir mid-run
-        if not jax.config.read('jax_compilation_cache_dir'):
-            jax.config.update('jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache'))
-            jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
-    except Exception:
-        pass
+    if getattr(args, 'cache_dir', None):
+        os.environ['DA4ML_XLA_CACHE'] = args.cache_dir
 
     import numpy as np
 
     from .. import telemetry
-    from ..cmvm.jax_search import solve_jax_many
+    from ..cmvm.jax_search import ensure_compile_cache, prewarm_for_kernels, solve_jax_many
     from ..telemetry.metrics import enable_metrics
+
+    cache_dir = ensure_compile_cache()
 
     # each ladder's compile wall clock lands in the warmup.compile_s
     # histogram (visible via `da4ml-tpu stats` / bench metrics snapshots)
@@ -52,16 +76,36 @@ def warmup_main(args) -> int:
 
     rng = np.random.default_rng(0)
     dims = [d for d in (4, 8, 16, 32, 64, 128, 256) if d <= args.max_dim]
+    kernels = {
+        d: (rng.integers(0, 2**args.bits, (d, d)) * rng.choice([-1, 1], (d, d))).astype(np.float64) for d in dims
+    }
     t_all = time.perf_counter()
-    for d in dims:
-        kern = (rng.integers(0, 2**args.bits, (d, d)) * rng.choice([-1, 1], (d, d))).astype(np.float64)
+
+    if getattr(args, 'grid', True):
+        # AOT pass: every (spec, lane bucket) class of the canonical grid,
+        # compiled inline on this thread (lower + compile, no device
+        # execution), each recorded in the cache-marker set so later
+        # processes classify their first calls as jit.cache_load
         t0 = time.perf_counter()
-        sol = solve_jax_many([kern])[0]
-        assert np.array_equal(np.asarray(sol.kernel, np.float64), kern)
+        n_classes = prewarm_for_kernels(
+            [[k] for k in kernels.values()], full_ladder=True, inline=True
+        )
         dt = time.perf_counter() - t0
-        telemetry.histogram('warmup.compile_s').observe(dt)
+        telemetry.histogram('warmup.grid_s').observe(dt)
         if args.verbose:
-            print(f'  {d}x{d}: {dt:.1f}s')
+            print(f'  grid: {n_classes} canonical classes AOT-compiled in {dt:.1f}s')
+
+    if not getattr(args, 'grid_only', False):
+        for d in dims:
+            kern = kernels[d]
+            t0 = time.perf_counter()
+            sol = solve_jax_many([kern])[0]
+            assert np.array_equal(np.asarray(sol.kernel, np.float64), kern)
+            dt = time.perf_counter() - t0
+            telemetry.histogram('warmup.compile_s').observe(dt)
+            if args.verbose:
+                print(f'  {d}x{d}: {dt:.1f}s')
     if not getattr(args, 'quiet', False):
-        print(f'warmup: {len(dims)} shape-class ladders compiled/cached in {time.perf_counter() - t_all:.1f}s')
+        where = f' -> {cache_dir}' if cache_dir else ''
+        print(f'warmup: {len(dims)} shape-class ladders compiled/cached in {time.perf_counter() - t_all:.1f}s{where}')
     return 0

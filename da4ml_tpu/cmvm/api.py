@@ -152,6 +152,7 @@ def _solve_dispatch(
     n_workers: int = 0,
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
+    mesh=None,
 ) -> Pipeline:
     """Direct (un-orchestrated) backend dispatch — the body of :func:`solve`.
 
@@ -175,6 +176,7 @@ def _solve_dispatch(
             n_workers=n_workers,
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
+            mesh=mesh,
         )
 
 
@@ -193,6 +195,7 @@ def _solve_dispatch_impl(
     n_workers: int = 0,
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
+    mesh=None,
 ) -> Pipeline:
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
         raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
@@ -222,6 +225,7 @@ def _solve_dispatch_impl(
             search_all_decompose_dc=search_all_decompose_dc,
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
+            mesh=mesh,
         )
 
     if method0_candidates:
@@ -303,6 +307,7 @@ def solve(
     n_workers: int = 0,
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
+    mesh=None,
     *,
     deadline: float | None = None,
     fallback=None,
@@ -318,7 +323,10 @@ def solve(
     (argmin keeps the cheapest solution); on the jax backend the extra
     candidates batch into the same device call, on cpu/cpp they solve
     sequentially. ``n_restarts`` adds random tie-break restarts as extra
-    device lanes (jax backend only; ignored on cpu/cpp).
+    device lanes (jax backend only; ignored on cpu/cpp). ``mesh`` (jax
+    backend) shards the lane batch over a device mesh; None auto-shards
+    over all local devices on multi-device TPU backends (``DA4ML_JAX_MESH``
+    overrides — docs/api.md#scheduler-knobs).
 
     Reliability (docs/reliability.md): by default a failed backend degrades
     along the bit-exact chain ``jax → native-threads → pure-python``
@@ -352,7 +360,7 @@ def solve(
         result = _solve_entry(
             kernel, method0, method1, hard_dc, decompose_dc, qintervals, latencies, adder_size,
             carry_size, search_all_decompose_dc, backend, n_workers, method0_candidates, n_restarts,
-            deadline=deadline, fallback=fallback, report=report, checkpoint=checkpoint,
+            mesh, deadline=deadline, fallback=fallback, report=report, checkpoint=checkpoint,
         )  # fmt: skip
         if _metrics:
             telemetry.counter('solve.calls').inc()
@@ -378,6 +386,7 @@ def _solve_entry(
     n_workers: int,
     method0_candidates: list[str] | None,
     n_restarts: int,
+    mesh=None,
     *,
     deadline: float | None,
     fallback,
@@ -412,6 +421,7 @@ def _solve_entry(
             n_workers=n_workers,
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
+            mesh=mesh,
         )
         return _post_solve_verify(result)
 
@@ -424,6 +434,7 @@ def _solve_entry(
             backend = 'cpu'
 
     solve_kwargs = dict(
+        mesh=mesh,
         method0=method0,
         method1=method1,
         hard_dc=hard_dc,

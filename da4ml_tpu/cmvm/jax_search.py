@@ -35,7 +35,9 @@ exactly, at equal-or-better total cost.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import time
 from dataclasses import dataclass
 from functools import lru_cache
 from math import ceil, inf, log2
@@ -75,15 +77,232 @@ except ValueError:
 search_stats = {'over_budget_accepts': 0, 'pmax_host_fallbacks': 0}
 
 #: (spec, lane bucket) classes whose device function has already been called
-#: once in this process — the first call of a class pays the XLA compile (or
-#: persistent-cache load), so its wall clock lands in ``jit.first_call_s``
-#: and increments ``jit.cache_miss``; later calls land in ``jit.execute_s``
+#: once in this process — the first call of a class pays the XLA compile or
+#: persistent-cache load (split into ``jit.compile`` vs ``jit.cache_load``
+#: by the cache-marker probe, see ``_classify_first_call``); later calls
+#: land in ``jit.execute_s``
 _SEEN_CLASSES: set = set()
+
+
+def executable_classes() -> int:
+    """Distinct (shape class, lane bucket) executables called this process."""
+    return len(_SEEN_CLASSES)
 
 
 def _next_pow2(x: int) -> int:
     """Smallest power of two >= max(x, 1)."""
     return 1 << (max(x, 1) - 1).bit_length()
+
+
+def _canon_dim(x: int, lo: int = 2) -> int:
+    """Round a shape-class dim up to the canonical 2^k / 3*2^k grid.
+
+    The grid (…, lo, 4, 6, 8, 10, 12, 16, 20, 24, 32, 40, 48, …) is batch-independent:
+    a matrix always lands in the same (O, B) class no matter what else is in
+    the batch, so thousands of heterogeneous matrices share a small set of
+    compiled executables — and the persistent XLA cache makes those classes
+    one-time costs per machine, not per process. 3*2^k rungs (kept even,
+    since B buckets to even counts) halve the worst-case padding waste of a
+    pure pow2 grid; the per-iteration search cost scales with O*B^2, so the
+    padding quantum matters.
+    """
+    x = max(x, lo)
+    p2 = _next_pow2(x)
+    best = p2
+    for c in ((p2 // 4) * 3, (p2 // 8) * 5):
+        if x <= c and c >= lo and c % 2 == 0 and c < best:
+            best = c
+    return best
+
+
+def ensure_compile_cache() -> str | None:
+    """Arm JAX's persistent compilation cache (idempotent).
+
+    Resolution order: an already-configured ``jax_compilation_cache_dir``
+    is always respected; else ``DA4ML_XLA_CACHE`` (legacy alias
+    ``DA4ML_JAX_CACHE``); else ``~/.cache/da4ml_tpu/xla``. Set
+    ``DA4ML_XLA_CACHE=0`` to disable. The min-compile-time/entry-size
+    thresholds are zeroed so even sub-second CPU-backend class compiles
+    persist — the point is that ``jax_compile_s`` is paid once per machine,
+    not once per process. Returns the active cache dir (None if disabled).
+    """
+    configured = getattr(jax.config, 'jax_compilation_cache_dir', None)
+    if configured:
+        return configured
+    path = os.environ.get('DA4ML_XLA_CACHE') or os.environ.get('DA4ML_JAX_CACHE') or ''
+    if path.lower() in ('0', 'none', 'off'):
+        return None
+    if not path:
+        path = os.path.expanduser('~/.cache/da4ml_tpu/xla')
+    try:
+        jax.config.update('jax_compilation_cache_dir', path)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    except Exception:
+        return None
+    return path
+
+
+def _class_marker_path(cache_dir: str, cls) -> str:
+    """Marker file recording that a (spec, bucket) class was compiled against
+    this persistent cache by some earlier process. Keyed on everything that
+    keys the executable: the class itself, the jax version, and the backend."""
+    key = repr((cls, jax.__version__, jax.default_backend()))
+    return os.path.join(cache_dir, 'da4ml-classes', hashlib.sha1(key.encode()).hexdigest())
+
+
+def _classify_first_call(cls) -> str:
+    """'compile' | 'cache_load': whether the first call of a class in this
+    process paid a real XLA compile or deserialized from the persistent
+    cache. A marker file per class (written on first compile) makes the
+    split observable — XLA itself does not surface it — so `da4ml-tpu
+    stats` can tell a cold machine from a cold process."""
+    cache_dir = getattr(jax.config, 'jax_compilation_cache_dir', None)
+    if not cache_dir:
+        return 'compile'
+    marker = _class_marker_path(cache_dir, cls)
+    if os.path.exists(marker):
+        return 'cache_load'
+    try:
+        os.makedirs(os.path.dirname(marker), exist_ok=True)
+        with open(marker, 'x'):
+            pass
+    except FileExistsError:
+        return 'cache_load'  # raced another process: the compile is shared
+    except OSError:
+        pass
+    return 'compile'
+
+
+def _record_first_call(cls, dt: float) -> None:
+    """Telemetry for the first call of a compile class: the compile-vs-load
+    split plus the legacy aggregate names (jit.cache_miss/first_call_s)."""
+    kind = _classify_first_call(cls)
+    telemetry.counter(f'jit.{kind}').inc()
+    telemetry.histogram(f'jit.{kind}_s').observe(dt)
+    telemetry.counter('jit.cache_miss').inc()
+    telemetry.histogram('jit.first_call_s').observe(dt)
+
+
+@lru_cache(maxsize=1)
+def _src_fingerprint() -> str:
+    """Content hash of this module — keys persisted export artifacts so a
+    kernel-builder change can never resurrect a stale compiled search."""
+    try:
+        with open(__file__, 'rb') as fh:
+            return hashlib.sha1(fh.read()).hexdigest()[:12]
+    except OSError:
+        return 'unversioned'
+
+
+#: per-process (spec, bucket) -> callable; values are either the jitted
+#: device fn or a deserialized jax.export artifact's .call
+_EXPORT_RUNNERS: dict[tuple, object] = {}
+
+
+def _class_runner(spec, bucket: int, fn, args):
+    """The callable that executes a (spec, bucket) class.
+
+    When a persistent cache dir is armed, the compiled class is ALSO
+    persisted as a ``jax.export`` artifact: the XLA compilation cache only
+    skips backend compilation, but a warm process still pays ~0.5s/class of
+    Python re-tracing + lowering before it can even look the executable up.
+    Deserializing the exported StableHLO skips that entirely (measured
+    ~0.3s vs ~0.65s per class on the cpu backend), and because every
+    process then compiles through the same exported module, the XLA cache
+    keys line up across processes. Any export failure falls back to the
+    plain jitted fn; mesh-sharded and fused classes always use the plain
+    path. ``DA4ML_JAX_EXPORT_CACHE=0`` disables."""
+    # env knobs that change the program WITHOUT changing the spec must key
+    # the runner (and the artifact sig below), or a toggled env could serve
+    # a stale program in-process
+    key = (spec, bucket, os.environ.get('DA4ML_JAX_TOPK_IMPL', ''), os.environ.get('DA4ML_JAX_EINSUM_DTYPE', ''))
+    hit = _EXPORT_RUNNERS.get(key)
+    if hit is not None:
+        return hit
+    runner = fn
+    cache_dir = getattr(jax.config, 'jax_compilation_cache_dir', None)
+    if (
+        cache_dir
+        and spec.select != 'fused'
+        and os.environ.get('DA4ML_JAX_EXPORT_CACHE', '1') not in ('0', 'false', 'off')
+    ):
+        try:
+            from jax import export as jexport
+
+            sig = repr(
+                (
+                    spec,
+                    tuple((tuple(a.shape), str(a.dtype)) for a in args),
+                    jax.__version__,
+                    jax.default_backend(),
+                    _src_fingerprint(),
+                    _einsum_dtype().__name__,
+                    os.environ.get('DA4ML_JAX_TOPK_IMPL', ''),
+                )
+            )
+            path = os.path.join(cache_dir, 'da4ml-exports', hashlib.sha1(sig.encode()).hexdigest())
+            if os.path.exists(path):
+                with open(path, 'rb') as fh:
+                    runner = jexport.deserialize(fh.read()).call
+                telemetry.counter('jit.export_load').inc()
+            else:
+                exp = jexport.export(fn)(*(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
+                blob = exp.serialize()
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f'{path}.tmp.{os.getpid()}'
+                with open(tmp, 'wb') as fh:
+                    fh.write(blob)
+                os.replace(tmp, path)  # atomic: concurrent processes race benignly
+                runner = exp.call
+                telemetry.counter('jit.export_save').inc()
+        except Exception:
+            runner = fn
+    _EXPORT_RUNNERS[key] = runner
+    return runner
+
+
+def _einsum_dtype():
+    """Digit-tensor einsum element type: bf16 on TPU (MXU-native), f32
+    elsewhere — CPU XLA runs bf16 contractions ~2x slower than f32, and the
+    operands (trits, counts < 32k) are exact in either. Env
+    ``DA4ML_JAX_EINSUM_DTYPE=bf16|f32`` overrides (new classes only: the
+    dtype is baked into each compiled program)."""
+    env = os.environ.get('DA4ML_JAX_EINSUM_DTYPE', '')
+    if env in ('bf16', 'bfloat16'):
+        return jnp.bfloat16
+    if env in ('f32', 'float32'):
+        return jnp.float32
+    return jnp.bfloat16 if jax.default_backend() == 'tpu' else jnp.float32
+
+
+def _auto_mesh():
+    """Default device mesh for the lane batch: all local devices, 1-D.
+
+    Only on multi-device TPU backends (the megabatch should fill the slice
+    by default); CPU/GPU keep the single-device path unless forced with
+    ``DA4ML_JAX_MESH=1`` (``=0`` disables everywhere). Callers passing an
+    explicit mesh bypass this entirely. Cached per env setting so every
+    solve shares one Mesh object (sharded-wrapper caches key on it).
+    """
+    return _auto_mesh_for(os.environ.get('DA4ML_JAX_MESH', ''))
+
+
+@lru_cache(maxsize=4)
+def _auto_mesh_for(env: str):
+    if env == '0':
+        return None
+    if jax.default_backend() != 'tpu' and env != '1':
+        return None
+    try:
+        devs = jax.local_devices()
+        if len(devs) < 2:
+            return None
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(devs), ('batch',))
+    except Exception:
+        return None
 
 
 def _select() -> str:
@@ -263,7 +482,11 @@ def _topk_scan(vals, k: int):
     ties by the FIRST position, so the axis is reversed going in and the
     indices mirrored back — one fused op instead of k max/mask passes.
     """
-    if os.environ.get('DA4ML_JAX_TOPK_IMPL') == 'sort':
+    impl = os.environ.get('DA4ML_JAX_TOPK_IMPL', '')
+    if impl == 'sort' or (not impl and jax.default_backend() != 'tpu'):
+        # CPU default: one fused top_k beats k sequential max/mask passes
+        # (~18% whole-solve) — the scan form stays the TPU default, where
+        # the fused op count is free and top_k lowers to a full sort
         v, pos = jax.lax.top_k(vals[..., ::-1], k)
         cols = vals.shape[-1] - 1 - pos
         return v, jnp.where(v == -jnp.inf, -1, cols.astype(jnp.int32))
@@ -312,6 +535,7 @@ def _build_cse_fn(spec: _KernelSpec):
     """
     P, O, B = spec.P, spec.O, spec.B
     K_CACHE = spec.topk
+    _ED = _einsum_dtype()  # baked into the program (bf16 on TPU, f32 on CPU)
     # op-record capacity: a call adds at most P - cur0 ops, and cur0 >= R_in
     # when rows are trimmed (st_cur == R_in for every live lane)
     n_iters = P - spec.R_in if spec.R_in else P
@@ -379,7 +603,7 @@ def _build_cse_fn(spec: _KernelSpec):
         diff = (|a||b| - ab)/2 over digits in {-1, 0, +1}. Computed once at
         stage entry; the loop maintains the counts incrementally.
         """
-        Ef = E.astype(jnp.bfloat16)
+        Ef = E.astype(_ED)
         sh = shifted_stack(Ef)
         A = jnp.einsum('iob,josb->sij', Ef, sh, preferred_element_type=jnp.float32)
         D = jnp.einsum('iob,josb->sij', jnp.abs(Ef), jnp.abs(sh), preferred_element_type=jnp.float32)
@@ -417,7 +641,7 @@ def _build_cse_fn(spec: _KernelSpec):
         All other pairs are unchanged (their rows were not modified), so the
         dirty-row einsums + row/column scatters refresh the exact counts.
         """
-        rowC, colC = row_col_counts(E.astype(jnp.bfloat16), R)
+        rowC, colC = row_col_counts(E.astype(_ED), R)
         s1, d1 = rowC[0].astype(cdtype), rowC[1].astype(cdtype)
         s2, d2 = colC[0].astype(cdtype), colC[1].astype(cdtype)
         # rows first, then columns: the column write also refreshes the
@@ -635,7 +859,7 @@ def _build_cse_fn(spec: _KernelSpec):
         The full [2, S, P, P] score tensor is never materialized: a
         lax.scan walks row blocks, scoring [2, S, BLK, P] at a time.
         """
-        Ef = E.astype(jnp.bfloat16)
+        Ef = E.astype(_ED)
         sh = shifted_stack(Ef)
         sha = jnp.abs(sh)
         iot = jnp.arange(P, dtype=jnp.int32)
@@ -693,7 +917,7 @@ def _build_cse_fn(spec: _KernelSpec):
 
                 # --- exact cache maintenance for the three dirty rows/cols
                 R = jnp.stack([i, j, cur])
-                rowC, colC = row_col_counts(E2.astype(jnp.bfloat16), R)
+                rowC, colC = row_col_counts(E2.astype(_ED), R)
                 novR, dltR = _meta_rows(qmeta, lat, R)  # [3, P] each
                 okR = (s_rng[:, None, None] > 0) | (R[None, :, None] < iot[None, None, :])  # [S, 3, P]
                 rowS = _score(rowC, novR[None, None], dltR[None, None], method, okR[None])
@@ -849,6 +1073,25 @@ def _lane_initial_digits(lane: _Lane) -> int:
     return int((lane.csd != 0).sum())
 
 
+def _ladder_P(cur_max: int, step: int | None) -> int:
+    """Slot budget of the next device rung.
+
+    Default (step=None) is the geometric ladder: P ≈ 2*cur rounded to pow2
+    (floored at cur+16 for tiny instances). Doubling bounds the lockstep
+    waste of the vmapped loop — every lane in a rung pays the rung's
+    per-iteration O(P) cost for as many iterations as the slowest lane, so
+    a first rung sized to the worst lane's total demand (the old
+    digits-derived step) made every cheap lane pay the straggler's price.
+    With doubling, total work is dominated by each lane's own final rung
+    (a geometric series), and the pow2 rungs are exactly the canonical
+    compile classes the persistent cache already holds. An explicit
+    ``step`` keeps the legacy cur+step rung for callers that tune it.
+    """
+    if step is not None:
+        return _next_pow2(cur_max + step)
+    return _next_pow2(cur_max + max(16, cur_max))
+
+
 def _bucket_lanes(n: int, mesh) -> int:
     """Pad the lane axis to a 2^k or 3*2^k (mesh-divisible) bucket so repeated
     calls with nearby batch sizes reuse the compiled program.
@@ -894,20 +1137,57 @@ def solve_single_lanes(
 ) -> list[CombLogic]:
     """Solve a batch of independent CMVM instances on device, emit on host.
 
-    The greedy search runs in *stages* of ``step`` iterations: per-iteration
-    selection cost is O(P^2) in the slot count P, so early iterations run with
-    small tensors and each stage re-enters the device function (state is
-    resumable) with P grown by ``step`` for only the lanes that are still
-    active — stragglers pay for large candidate tensors, finished lanes drop
-    out (compaction).
+    Throughput-first scheduling (three mechanisms, all decision-preserving):
+
+    - **canonical shape buckets** — lanes group by per-lane canonical
+      (O, B) class dims (``_canon_dim``), so classes are batch-independent
+      (persistent-cache hits across processes) and cheap lanes never ride
+      a worst-case-shaped program;
+    - **rung ladder** — within a bucket the greedy search runs in rungs of
+      the pow2 ``_ladder_P`` ladder (P ~doubles per rung; explicit ``step``
+      restores the legacy cur+step rungs): per-iteration selection cost is
+      O(P^2), so early iterations run on small tensors and only stragglers
+      resume at larger P (state is resumable; finished lanes drop out);
+    - **overlapped dispatch/emit** — chunks of a rung dispatch depth-2
+      pipelined (host pack/unpack overlaps device execute), and each
+      bucket's host emission runs on a background worker while the next
+      bucket's device rounds execute.
+
+    ``mesh=None`` resolves via ``_auto_mesh`` (all local devices on a
+    multi-device TPU backend; ``DA4ML_JAX_MESH`` overrides).
     """
     with telemetry.span('cmvm.jax.csd', n_lanes=len(lanes)):
         for lane in lanes:
             if lane.csd is None:
                 _prepare_lane(lane)
 
-    dummy_idx = [k for k, ln in enumerate(lanes) if ln.method == 'dummy']
     results: dict[int, CombLogic] = {}
+
+    # identical lanes solve ONCE and fan the result out: the dc ladder often
+    # produces byte-identical stage matrices at adjacent depths (and restart
+    # probes repeat lane objects), so the device batch carries only unique
+    # (matrix, metadata, method, permutation) work. Solutions are immutable
+    # (consumers materialize views via to_comb), so sharing one object is
+    # safe.
+    dup_of: dict[int, int] = {}
+    _uniq: dict[tuple, int] = {}
+    for k, ln in enumerate(lanes):
+        key = (
+            ln.kernel.tobytes(),
+            ln.kernel.shape,
+            ln.method,
+            tuple(ln.qintervals),
+            tuple(ln.latencies),
+            None if ln.perm is None else ln.perm.tobytes(),
+        )
+        if key in _uniq:
+            dup_of[k] = _uniq[key]
+        else:
+            _uniq[key] = k
+    if dup_of:
+        telemetry.counter('sched.dedup_lanes').inc(len(dup_of))
+
+    dummy_idx = [k for k, ln in enumerate(lanes) if ln.method == 'dummy' and k not in dup_of]
 
     # Lane-level slot-demand routing: each CSE merge eliminates >= 2 digit
     # pairs, so a lane needs at most n_in + digits/2 slots. Lanes beyond the
@@ -918,7 +1198,7 @@ def solve_single_lanes(
     over = [
         k
         for k, ln in enumerate(lanes)
-        if ln.method != 'dummy' and ln.csd.shape[0] + _lane_initial_digits(ln) // 2 > pmax_route
+        if k not in dup_of and ln.method != 'dummy' and ln.csd.shape[0] + _lane_initial_digits(ln) // 2 > pmax_route
     ]
     if over:
         from .core import solve_single as _host_solve_single
@@ -940,68 +1220,34 @@ def solve_single_lanes(
         state = _host_state_from(ln, np.zeros((0, 4), np.int32), csd, 0, adder_size, carry_size, shift0=shift0)
         results[k] = to_solution(state, adder_size, carry_size)
 
-    active = [k for k in range(len(lanes)) if k not in results]
+    active = [k for k in range(len(lanes)) if k not in results and k not in dup_of]
     if active:
-        # bucket the shape-class dims so heterogeneous batches (e.g. a sweep
-        # over layer shapes) reuse compiled programs instead of paying one XLA
-        # compile per exact (P, O, B) triple. Zero-padded slots / outputs /
+        ensure_compile_cache()
+        if mesh is None:
+            mesh = _auto_mesh()
+
+        # --- canonical shape buckets ------------------------------------
+        # Class dims are canonicalized PER LANE (the pow2 / 3*2^k grid of
+        # _canon_dim) and lanes are grouped by (O, B): a matrix lands in
+        # the same compiled class no matter what else rides in the batch
+        # (batch-independent classes -> cross-process persistent-cache
+        # hits), and small-B lanes stop paying the worst lane's O*B^2
+        # per-iteration cost in lockstep. Zero-padded slots / outputs /
         # bit planes can never be selected (count < 2), so bucketing is
         # decision-identical; the padding waste is bounded by the quantum.
-        def _ceil_to(x: int, q: int) -> int:
-            return -(-x // q) * q
+        groups: dict[tuple[int, int], list[int]] = {}
+        for k in active:
+            gk = (_canon_dim(lanes[k].csd.shape[1], 8), _canon_dim(lanes[k].csd.shape[2], 2))
+            groups.setdefault(gk, []).append(k)
+        telemetry.counter('sched.bucket_groups').inc(len(groups))
+        telemetry.counter('sched.bucket_lanes').inc(len(active))
 
-        # pow2 so the first rung's cur0 equals the trimmed-row class R_in
-        # exactly (the op-record capacity P - R_in relies on cur0 >= R_in)
-        n_in_max = _next_pow2(max(lanes[k].csd.shape[0] for k in active))
-        # O and the P ladder (below) round to powers of two: TPU compiles are
-        # expensive (remote, minutes at large shapes), so the class lattice is
-        # kept coarse — one compile per (pow2 P, pow2 O, even B) serves every
-        # stage and every config that fits it, and the persistent XLA cache
-        # makes the classes reusable across processes
-        O = max(8, _next_pow2(max(lanes[k].csd.shape[1] for k in active)))
-        B = _ceil_to(max(lanes[k].csd.shape[2] for k in active), 2)
-        digits_max = max(_lane_initial_digits(lanes[k]) for k in active)
-        if step is None:
-            step = _ceil_to(max(16, -(-digits_max // 8)), 8)
-
-        n_act = len(active)
-        st_E: dict[int, NDArray] = {}  # final digit tensors, filled as lanes finish
-        st_cur = np.full((n_act,), n_in_max, dtype=np.int32)
-        mcodes = np.zeros((n_act,), dtype=np.int32)
-        recs: list[list[NDArray]] = [[] for _ in range(n_act)]
-
-        # initial per-lane search state (host numpy; see the host-resident
-        # rung loop below for why state never lives on device between rungs)
-        Eb = np.zeros((n_act, n_in_max, O, B), dtype=np.int8)
-        qb = np.zeros((n_act, n_in_max, 3), dtype=np.float32)
-        qb[:, :, 2] = 1.0  # benign step for unused slots
-        lb = np.zeros((n_act, n_in_max), dtype=np.float32)
-        for a, k in enumerate(active):
-            ln = lanes[k]
-            ni, no, nb = ln.csd.shape
-            Eb[a, :ni, :no, :nb] = ln.csd
-            for i in range(ni):
-                sf = 2.0 ** float(ln.shift0[i])
-                q = ln.qintervals[ln.slot(i)]
-                lo, hi, stp = q.min * sf, q.max * sf, q.step * sf
-                # all-zero rows carry the lsb sentinel shift (2**127) and/or an
-                # inf step; they are never selected — store benign metadata
-                if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, stp)):
-                    lo, hi, stp = 0.0, 0.0, 1.0
-                qb[a, i] = (lo, hi, stp)
-                lb[a, i] = ln.latencies[ln.slot(i)]
-            mcodes[a] = _METHOD_CODES[ln.method]
-
-        def _fetch(tree):
-            """Device→host fetch that also works when the mesh spans
-            processes: sharded outputs are not fully addressable locally, so
-            gather them across hosts first (every process then emits the
-            full batch — redundant but identical)."""
-            if multiproc:
-                from jax.experimental import multihost_utils
-
-                return multihost_utils.process_allgather(tree, tiled=True)
-            return jax.device_get(tree)
+        debug = bool(int(os.environ.get('DA4ML_JAX_DEBUG', '0') or '0'))
+        try:
+            hbm_budget = int(float(os.environ.get('DA4ML_JAX_HBM_BUDGET', '') or (4 << 30)))
+        except ValueError:
+            hbm_budget = 4 << 30
+        pmax = _pmax()
 
         multiproc = False
         sh = None
@@ -1014,287 +1260,423 @@ def solve_single_lanes(
             sh = batch_sharding(mesh, mesh.axis_names[0])
             multiproc = bool(jax.process_count() > 1 and any(d.process_index != jax.process_index() for d in mesh.devices.flat))
 
-        debug = bool(int(os.environ.get('DA4ML_JAX_DEBUG', '0') or '0'))
-        pend = list(range(n_act))
-        # Between rungs the search state lives on the HOST (numpy, one entry
-        # per lane), not device-resident: re-slicing device state with
-        # data-dependent shapes (take of the finished subset, pads, concats)
-        # creates a fresh tiny XLA program per shape, and through the remote
-        # compiler each costs ~1.5s on first call — ~46s of a 71s first solve
-        # at the conv config. With host-side state every device program has a
-        # fixed shape per (P, O, B, bucket) class; the extra cost is one
-        # packed full-batch fetch + re-upload per rung (~0.1s/10MB).
-        hE: list[NDArray] = [Eb[a] for a in range(n_act)]
-        hq: list[NDArray] = [qb[a] for a in range(n_act)]
-        hl: list[NDArray] = [lb[a] for a in range(n_act)]
-        try:
-            hbm_budget = int(float(os.environ.get('DA4ML_JAX_HBM_BUDGET', '') or (4 << 30)))
-        except ValueError:
-            hbm_budget = 4 << 30
-        pmax = _pmax()
-        while pend:
-            P = _next_pow2(int(st_cur[pend].max()) + step)
-            if P > pmax:
-                if int(st_cur[pend].max()) < pmax:
-                    P = pmax  # last, clamped rung (pmax is itself a pow2)
-                else:
-                    # safety net (normally pre-empted by the estimate in
-                    # solve_jax_many): finish the true stragglers on the host
-                    # from scratch rather than compiling an oversized device
-                    # program. Restart lanes of the same instance collapse to
-                    # one host solve — the host path ignores the permutation,
-                    # so the duplicates would be byte-identical.
-                    from .core import solve_single as _host_solve_single
+        from ..reliability.deadline import check_deadline
 
-                    memo: dict[tuple, CombLogic] = {}
-                    for a in pend:
-                        k = active[a]
-                        ln = lanes[k]
-                        search_stats['pmax_host_fallbacks'] += 1
-                        key = (ln.kernel.tobytes(), ln.kernel.shape, ln.method)
-                        if key not in memo:
-                            memo[key] = _host_solve_single(
-                                ln.kernel, ln.method, ln.qintervals, ln.latencies, adder_size, carry_size
-                            )
-                        results[k] = memo[key]
-                        st_E.pop(a, None)
-                    pend = []
-                    break
-            n_pend = len(pend)
-            # rows actually carrying state this rung: n_in_max on entry, the
-            # previous rung's P on resume (st_cur hits the cap exactly).
-            # Rounded up to a power of two so the compile-class lattice stays
-            # coarse — a fresh R_in value would otherwise recompile the whole
-            # CSE program just to trim the upload. The topk rule (cache is
-            # exact at small P; deeper K at large P) and the fused pad-up /
-            # VMEM-fallback policy live in _resolve_rung_class, shared with
-            # the prewarm estimators.
-            spec = _resolve_rung_class(
-                P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(int(st_cur[pend].max()))
-            )
-            P, select, topk = spec.P, spec.select, spec.topk
-            rows_in = spec.R_in or P
-            fn = _build_cse_fn(spec)
-            if select == 'fused' and mesh is not None and sh is not None:
-                fn = _fused_sharded(fn, mesh)
+        def _fetch(tree):
+            """Device->host fetch that also works when the mesh spans
+            processes: sharded outputs are not fully addressable locally, so
+            gather them across hosts first (every process then emits the
+            full batch — redundant but identical)."""
+            if multiproc:
+                from jax.experimental import multihost_utils
 
-            if _prewarm_enabled() and P < pmax:
-                # lanes whose slot demand outgrows this rung will resume at
-                # the next one; AOT-compile that class while this rung runs
-                resume_est = [
-                    a
-                    for a in pend
-                    if lanes[active[a]].csd.shape[0] + _lane_initial_digits(lanes[active[a]]) // 2 > P
-                ]
-                P2 = min(_next_pow2(P + step), pmax)
-                if resume_est and P2 > P:
-                    spec2 = _resolve_rung_class(P2, O, B, adder_size, carry_size, _select(), pmax, P)
-                    bucket2 = _bucket_lanes(len(resume_est), mesh)
-                    _prewarm_submit(lambda s=spec2, b=bucket2: _prewarm_class(s, b))
+                return multihost_utils.process_allgather(tree, tiled=True)
+            return jax.device_get(tree)
 
-            # HBM guard: bound the lanes per device call so a wide batch of
-            # large matrices cannot OOM-crash the worker; excess lanes run in
-            # sequential chunks of the same compiled program.
-            if select in ('top4', 'fused'):
-                # no carried [S, P, P] state: the footprint is the shifted
-                # digit stack + abs copy at stage entry (bf16 [P, O, S, B]
-                # each), the blocked init scoring transient, the top-k cache
-                # (f32+int32 [2, S, P, K] each), and the merge transient
-                blk = min(128, P)
-                per_lane = 4 * P * O * B * B + 16 * B * blk * P + 16 * B * P * topk + 96 * B * P + P * O * B + 32 * P
-                if select == 'fused':
-                    # HBM side of the fused path: f32 digit plane + layout
-                    # transposes (the loop state itself lives in VMEM)
-                    per_lane += 16 * P * O * B
-            else:
-                itemsize = _count_itemsize(O, B)
-                # carried counts (+f32 scoring transients) dominate; the
-                # carried pairwise metadata adds 2 f32 [P, P] planes; stage
-                # entry also materializes the shifted digit stack and its abs
-                # copy (pair_counts), bf16 [P, O, S, B] each
-                per_lane = 2 * B * P * P * (itemsize + 4) + 8 * P * P + 4 * P * O * B * B + P * O * B + 16 * P
-            # under a sharded mesh the lane axis splits across devices, so the
-            # per-device footprint is bucket/nd lanes
-            nd = mesh.devices.size if (mesh is not None and sh is not None) else 1
-            # the budget must hold for the *padded* lane bucket (power of two
-            # and a mesh multiple, _bucket_lanes), not just the chunk length
-            max_lanes = max(1, (nd * hbm_budget) // per_lane)
-            if _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
-                # floor to a power of two first (bucket(pow2) == pow2 without
-                # a mesh), then halve until the mesh-rounded bucket also fits
-                max_lanes = 1 << (max_lanes.bit_length() - 1)
-                while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
-                    max_lanes //= 2
+        def _run_group(O: int, B: int, g_active: list[int]):
+            """One canonical (O, B) bucket through the rung ladder.
 
-            next_pend: list[int] = []
-            for lo in range(0, n_pend, max_lanes):
-                hi = min(lo + max_lanes, n_pend)
-                chunk = pend[lo:hi]
-                n_chunk = hi - lo
-                bucket = _bucket_lanes(n_chunk, mesh)
-                # host arrays trimmed to the rows that carry state (the device
-                # pads to P); pad rows keep the benign-metadata invariant
-                # (step 1.0, not 0): zero digit rows are never selectable, but
-                # scoring reads the step column unguarded. Padding lanes start
-                # at cur = P so their loop exits immediately.
-                rows_h = rows_in if rows_in < P else P
-                cE = np.zeros((bucket, rows_h, O, B), np.int8)
-                cq = np.zeros((bucket, rows_h, 3), np.float32)
-                cq[:, :, 2] = 1.0
-                cl = np.zeros((bucket, rows_h), np.float32)
-                cc = np.full((bucket,), P, np.int32)
-                cm = np.zeros((bucket,), np.int32)
-                for x, a in enumerate(chunk):
-                    pa = min(hE[a].shape[0], rows_h)
-                    cE[x, :pa] = hE[a][:pa]
-                    cq[x, :pa] = hq[a][:pa]
-                    cl[x, :pa] = hl[a][:pa]
-                    cc[x] = st_cur[a]
-                    cm[x] = mcodes[a]
-                if rows_h < P and (O * B) % 16 == 0:
-                    # trit-packed upload (16 digits per int32 word, offset by
-                    # 1); the device unpacks — see _pack_digits
-                    cE_send = _trit_pack_np(cE.reshape(bucket, rows_h, O * B))
-                elif rows_h < P and (O * B) % 4 == 0:
-                    # int32-packed upload (same little-endian view the fetch
-                    # side uses); the device bitcasts back to int8
-                    cE_send = np.ascontiguousarray(cE).reshape(bucket, rows_h, O * B).view(np.int32)
-                else:
-                    cE_send = cE
-                args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm))
+            Returns (emit_jobs, safety_net_results): finished lanes come
+            back as emit jobs so their host emission can overlap the next
+            bucket's device rounds; lanes the PMAX safety net re-routed come
+            back already solved.
+            """
+            active = g_active
+            net: dict[int, CombLogic] = {}
+            # pow2 so the first rung's cur0 equals the trimmed-row class
+            # R_in exactly (op-record capacity P - R_in relies on cur0 >= R_in)
+            n_in_max = _next_pow2(max(lanes[k].csd.shape[0] for k in active))
 
-                # time the device round only when someone consumes it (the
-                # compile-vs-execute split below or the debug line): the
-                # disabled path must not pay even the clock reads
-                _timed = debug or telemetry.metrics_on()
-                if _timed:
-                    import time as _time
+            n_act = len(active)
+            st_E: dict[int, NDArray] = {}  # final digit tensors, filled as lanes finish
+            st_cur = np.full((n_act,), n_in_max, dtype=np.int32)
+            mcodes = np.zeros((n_act,), dtype=np.int32)
+            recs: list[list[NDArray]] = [[] for _ in range(n_act)]
 
-                    _t0 = _time.perf_counter()
-                try:
-                    oE, oq, ol, o_rec, ocur = fn(*args)
-                    # one tree fetch (not one device_get per output): the
-                    # remote tunnel charges a round trip per call, so
-                    # cur/records/digits come back together. qmeta/lat are
-                    # only needed for lanes that resume at a larger P
-                    # (finished lanes' metadata is re-derived on host in f64
-                    # from the records) — a second fetch only in that case.
-                    h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
-                except Exception as e:
-                    if select != 'fused':
-                        raise
-                    # Mosaic compile / runtime failure of the fused kernel
-                    # (interpret mode passes where TPU tiling constraints can
-                    # bite): retry THIS chunk on the XLA top4 program of the
-                    # SAME shape class — identical P/R_in/topk means the
-                    # already-packed arguments fit unchanged and decisions
-                    # are identical — and disable fused for the process.
-                    import dataclasses
-                    import warnings
+            # initial per-lane search state (host numpy; see the rung loop
+            # below for why state never lives on device between rungs)
+            hE: list[NDArray] = []
+            hq: list[NDArray] = []
+            hl: list[NDArray] = []
+            for a, k in enumerate(active):
+                ln = lanes[k]
+                ni, no, nb = ln.csd.shape
+                E = np.zeros((n_in_max, O, B), dtype=np.int8)
+                E[:ni, :no, :nb] = ln.csd
+                q = np.zeros((n_in_max, 3), dtype=np.float32)
+                q[:, 2] = 1.0  # benign step for unused slots
+                lb = np.zeros((n_in_max,), dtype=np.float32)
+                for i in range(ni):
+                    sf = 2.0 ** float(ln.shift0[i])
+                    qi = ln.qintervals[ln.slot(i)]
+                    lo, hi, stp = qi.min * sf, qi.max * sf, qi.step * sf
+                    # all-zero rows carry the lsb sentinel shift (2**127) and/or
+                    # an inf step; they are never selected — store benign metadata
+                    if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, stp)):
+                        lo, hi, stp = 0.0, 0.0, 1.0
+                    q[i] = (lo, hi, stp)
+                    lb[i] = ln.latencies[ln.slot(i)]
+                hE.append(E)
+                hq.append(q)
+                hl.append(lb)
+                mcodes[a] = _METHOD_CODES[ln.method]
 
-                    _mark_fused_broken(e)
-                    warnings.warn(f'fused CSE kernel failed ({type(e).__name__}); using the XLA top4 loop: {e}')
-                    select = 'top4'
-                    fn = _build_cse_fn(dataclasses.replace(spec, select='top4'))
-                    oE, oq, ol, o_rec, ocur = fn(*args)
-                    h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
-                cur_f = np.asarray(h_cur)[:n_chunk]
-                if _timed:
-                    _dt = _time.perf_counter() - _t0
-                    if telemetry.metrics_on():
-                        # first-call timing per compile class approximates the
-                        # XLA compile cost; later calls of the same class are
-                        # pure device-execute + transfer
-                        _cls = (spec, bucket)
-                        if _cls not in _SEEN_CLASSES:
-                            _SEEN_CLASSES.add(_cls)
-                            telemetry.counter('jit.cache_miss').inc()
-                            telemetry.histogram('jit.first_call_s').observe(_dt)
-                        else:
-                            telemetry.histogram('jit.execute_s').observe(_dt)
-                        telemetry.counter('cse.device_rounds').inc()
-                    if debug:
-                        _logger.info(
-                            f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
-                            f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_dt:.2f}s'
-                        )
-                if bool((cur_f >= P).any()):
-                    q_all, l_all = _fetch((oq, ol))
-                    q_all, l_all = np.asarray(q_all)[:n_chunk], np.asarray(l_all)[:n_chunk]
-                op_rec = np.asarray(h_rec)[:n_chunk]
-                E_all = _unpack_digits(np.asarray(hEp), O, B)[:n_chunk]
-
-                _n_subst = 0
-                for x, a in enumerate(chunk):
-                    c0, c1 = int(st_cur[a]), int(cur_f[x])
-                    if c1 > c0:
-                        recs[a].append(op_rec[x, : c1 - c0].copy())
-                        _n_subst += c1 - c0
-                    st_cur[a] = c1
-                    # .copy(): a bare slice would be a view pinning the whole
-                    # bucket-sized fetch buffer until emission
-                    if c1 >= P:  # budget exhausted -> resume with a larger P
-                        next_pend.append(a)
-                        hE[a], hq[a], hl[a] = E_all[x].copy(), q_all[x].copy(), l_all[x].copy()
+            pend = list(range(n_act))
+            # Between rungs the search state lives on the HOST (numpy, one
+            # entry per lane), not device-resident: re-slicing device state
+            # with data-dependent shapes (take of the finished subset, pads,
+            # concats) creates a fresh tiny XLA program per shape, and through
+            # the remote compiler each costs ~1.5s on first call. With
+            # host-side state every device program has a fixed shape per
+            # (P, O, B, bucket) class; the extra cost is one packed
+            # full-batch fetch + re-upload per rung (~0.1s/10MB).
+            while pend:
+                # async dispatch must not outlive a reliability deadline: a
+                # budgeted solve aborts between rungs instead of burning a
+                # detached worker thread on rounds nobody will consume
+                check_deadline('cmvm.jax device rung')
+                cur_max = int(st_cur[pend].max())
+                P = _ladder_P(cur_max, step)
+                if P > pmax:
+                    if cur_max < pmax:
+                        P = pmax  # last, clamped rung (pmax is itself a pow2)
                     else:
-                        st_E[a] = E_all[x].copy()
-                if _n_subst:
-                    # greedy CSE substitutions materialized this device round
-                    telemetry.counter('cse.substitutions').inc(_n_subst)
-            pend = next_pend
+                        # safety net (normally pre-empted by the estimate in
+                        # solve_jax_many): finish the true stragglers on the
+                        # host from scratch rather than compiling an oversized
+                        # device program. Restart lanes of the same instance
+                        # collapse to one host solve — the host path ignores
+                        # the permutation, so the duplicates would be
+                        # byte-identical.
+                        from .core import solve_single as _host_solve_single
 
-        emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)
-        for a, k in enumerate(active):
-            if k in results:  # solved on host by the PMAX safety net
-                continue
-            ln = lanes[k]
-            ni, no, nb = ln.csd.shape
-            n_add = int(st_cur[a]) - n_in_max
-            E_f = st_E[a]
-            # slots in the device tensor: [0, n_in_max) inputs, [n_in_max, ...) new.
-            # remap device slot index -> host op index (inputs of THIS lane first)
-            E_lane = np.concatenate([E_f[:ni, :no, :nb], E_f[n_in_max : n_in_max + n_add, :no, :nb]], axis=0)
-            rec = np.concatenate(recs[a], axis=0) if recs[a] else np.zeros((0, 4), np.int32)
-            shift_down = n_in_max - ni
-            if shift_down:
-                rec = rec.copy()
-                rec[:, 0] = np.where(rec[:, 0] >= ni, rec[:, 0] - shift_down, rec[:, 0])
-                rec[:, 1] = np.where(rec[:, 1] >= ni, rec[:, 1] - shift_down, rec[:, 1])
-            shift0 = ln.shift0
-            if ln.perm is not None:
-                # restart lane: device slot k held input perm[k]; renumber
-                # back to the original input order (operand roles — and thus
-                # values — are untouched; ids are pure references)
-                perm = np.asarray(ln.perm)
-                E_un = E_lane.copy()
-                E_un[perm] = E_lane[:ni]
-                E_lane = E_un
-                shift0 = np.empty_like(ln.shift0)
-                shift0[perm] = ln.shift0
-                rec = rec.copy()
-                for c in (0, 1):
-                    v = rec[:, c]
-                    rec[:, c] = np.where(v < ni, perm[np.minimum(v, ni - 1)], v)
-            emit_jobs.append((k, E_lane, rec, shift0))
+                        memo: dict[tuple, CombLogic] = {}
+                        for a in pend:
+                            k = active[a]
+                            ln = lanes[k]
+                            search_stats['pmax_host_fallbacks'] += 1
+                            key = (ln.kernel.tobytes(), ln.kernel.shape, ln.method)
+                            if key not in memo:
+                                memo[key] = _host_solve_single(
+                                    ln.kernel, ln.method, ln.qintervals, ln.latencies, adder_size, carry_size
+                                )
+                            net[k] = memo[key]
+                            st_E.pop(a, None)
+                        pend = []
+                        break
+                telemetry.counter('sched.rungs').inc()
+                n_pend = len(pend)
+                # rows actually carrying state this rung: n_in_max on entry,
+                # the previous rung's P on resume (st_cur hits the cap
+                # exactly). Rounded up to a power of two so the compile-class
+                # lattice stays coarse — a fresh R_in value would otherwise
+                # recompile the whole CSE program just to trim the upload. The
+                # topk rule (cache is exact at small P; deeper K at large P)
+                # and the fused pad-up / VMEM-fallback policy live in
+                # _resolve_rung_class, shared with the prewarm estimators.
+                spec = _resolve_rung_class(
+                    P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(cur_max)
+                )
+                P, select, topk = spec.P, spec.select, spec.topk
+                rows_in = spec.R_in or P
+                fn = _build_cse_fn(spec)
+                if select == 'fused' and mesh is not None and sh is not None:
+                    fn = _fused_sharded(fn, mesh)
 
-        with telemetry.span('cmvm.jax.emit', n_jobs=len(emit_jobs)):
-            if _native_emit_available():
-                from ..native.bindings import emit_batch
+                if _prewarm_enabled() and P < pmax:
+                    # lanes whose slot demand outgrows this rung will resume at
+                    # the next one; AOT-compile that class while this rung runs
+                    resume_est = [
+                        a
+                        for a in pend
+                        if lanes[active[a]].csd.shape[0] + _lane_initial_digits(lanes[active[a]]) // 2 > P
+                    ]
+                    P2 = min(_ladder_P(P, step), pmax)
+                    if resume_est and P2 > P:
+                        spec2 = _resolve_rung_class(P2, O, B, adder_size, carry_size, _select(), pmax, P)
+                        bucket2 = _bucket_lanes(len(resume_est), mesh)
+                        _prewarm_submit(lambda s=spec2, b=bucket2: _prewarm_class(s, b))
 
-                lane_tuples = []
-                for k, E_lane, rec, shift0 in emit_jobs:
-                    ln = lanes[k]
-                    qints = np.asarray([(q.min, q.max, q.step) for q in ln.qintervals], np.float64).reshape(-1, 3)
-                    lats = np.asarray(ln.latencies, np.float64)
-                    lane_tuples.append((shift0, ln.shift1, qints, lats, E_lane, rec))
-                for (k, _, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
-                    results[k] = sol
-            else:
-                for k, E_lane, rec, shift0 in emit_jobs:
-                    ln = lanes[k]
-                    state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size, shift0=shift0)
-                    results[k] = to_solution(state, adder_size, carry_size)
+                # HBM guard: bound the lanes per device call so a wide batch of
+                # large matrices cannot OOM-crash the worker; excess lanes run
+                # in sequential chunks of the same compiled program.
+                if select in ('top4', 'fused'):
+                    # no carried [S, P, P] state: the footprint is the shifted
+                    # digit stack + abs copy at stage entry (bf16 [P, O, S, B]
+                    # each), the blocked init scoring transient, the top-k
+                    # cache (f32+int32 [2, S, P, K] each), and the merge
+                    # transient
+                    blk = min(128, P)
+                    per_lane = 4 * P * O * B * B + 16 * B * blk * P + 16 * B * P * topk + 96 * B * P + P * O * B + 32 * P
+                    if select == 'fused':
+                        # HBM side of the fused path: f32 digit plane + layout
+                        # transposes (the loop state itself lives in VMEM)
+                        per_lane += 16 * P * O * B
+                else:
+                    itemsize = _count_itemsize(O, B)
+                    # carried counts (+f32 scoring transients) dominate; the
+                    # carried pairwise metadata adds 2 f32 [P, P] planes; stage
+                    # entry also materializes the shifted digit stack and its
+                    # abs copy (pair_counts), bf16 [P, O, S, B] each
+                    per_lane = 2 * B * P * P * (itemsize + 4) + 8 * P * P + 4 * P * O * B * B + P * O * B + 16 * P
+                # under a sharded mesh the lane axis splits across devices, so
+                # the per-device footprint is bucket/nd lanes
+                nd = mesh.devices.size if (mesh is not None and sh is not None) else 1
+                # the budget must hold for the *padded* lane bucket (power of
+                # two and a mesh multiple, _bucket_lanes), not just the chunk
+                # length
+                max_lanes = max(1, (nd * hbm_budget) // per_lane)
+                if _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
+                    # floor to a power of two first (bucket(pow2) == pow2
+                    # without a mesh), then halve until the mesh-rounded bucket
+                    # also fits
+                    max_lanes = 1 << (max_lanes.bit_length() - 1)
+                    while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
+                        max_lanes //= 2
+                if n_pend > max_lanes:
+                    # the rung splits into chunks: halve the budget so the
+                    # depth-2 dispatch pipeline below never holds more than
+                    # the original budget resident, and order lanes by
+                    # remaining slot demand so chunks are homogeneous (the
+                    # vmapped loop runs to the slowest lane of its chunk)
+                    while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget // 2:
+                        max_lanes //= 2
+                    pend = sorted(
+                        pend,
+                        key=lambda a: -(lanes[active[a]].csd.shape[0] + _lane_initial_digits(lanes[active[a]]) // 2),
+                    )
 
+                next_pend: list[int] = []
+                _timed = debug or telemetry.metrics_on()
+
+                def _drain(ent):
+                    """Fetch + unpack one in-flight chunk (FIFO with dispatch)."""
+                    nonlocal select, fn
+                    lo, n_chunk, chunk, bucket, args, outs, t0, cls = ent
+                    try:
+                        oE, oq, ol, o_rec, ocur = outs
+                        # one tree fetch (not one device_get per output): the
+                        # remote tunnel charges a round trip per call, so
+                        # cur/records/digits come back together. qmeta/lat are
+                        # only needed for lanes that resume at a larger P
+                        # (finished lanes' metadata is re-derived on host in
+                        # f64 from the records) — a second fetch only then.
+                        h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                    except Exception as e:
+                        if select != 'fused':
+                            raise
+                        # Mosaic compile / runtime failure of the fused kernel
+                        # (interpret mode passes where TPU tiling constraints
+                        # can bite): retry THIS chunk on the XLA top4 program
+                        # of the SAME shape class — identical P/R_in/topk
+                        # means the packed arguments fit unchanged and
+                        # decisions are identical — and disable fused for the
+                        # process.
+                        import dataclasses
+                        import warnings
+
+                        _mark_fused_broken(e)
+                        warnings.warn(f'fused CSE kernel failed ({type(e).__name__}); using the XLA top4 loop: {e}')
+                        select = 'top4'
+                        fn = _build_cse_fn(dataclasses.replace(spec, select='top4'))
+                        oE, oq, ol, o_rec, ocur = fn(*args)
+                        h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                    cur_f = np.asarray(h_cur)[:n_chunk]
+                    if _timed:
+                        _dt = time.perf_counter() - t0
+                        if telemetry.metrics_on():
+                            if cls not in _SEEN_CLASSES:
+                                _SEEN_CLASSES.add(cls)
+                                _record_first_call(cls, _dt)
+                            else:
+                                telemetry.histogram('jit.execute_s').observe(_dt)
+                            telemetry.counter('cse.device_rounds').inc()
+                        if debug:
+                            _logger.info(
+                                f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
+                                f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_dt:.2f}s'
+                            )
+                    if bool((cur_f >= P).any()):
+                        q_all, l_all = _fetch((oq, ol))
+                        q_all, l_all = np.asarray(q_all)[:n_chunk], np.asarray(l_all)[:n_chunk]
+                    op_rec = np.asarray(h_rec)[:n_chunk]
+                    E_all = _unpack_digits(np.asarray(hEp), O, B)[:n_chunk]
+
+                    _n_subst = 0
+                    for x, a in enumerate(chunk):
+                        c0, c1 = int(st_cur[a]), int(cur_f[x])
+                        if c1 > c0:
+                            recs[a].append(op_rec[x, : c1 - c0].copy())
+                            _n_subst += c1 - c0
+                        st_cur[a] = c1
+                        # .copy(): a bare slice would be a view pinning the
+                        # whole bucket-sized fetch buffer until emission
+                        if c1 >= P:  # budget exhausted -> resume, larger P
+                            next_pend.append(a)
+                            hE[a], hq[a], hl[a] = E_all[x].copy(), q_all[x].copy(), l_all[x].copy()
+                        else:
+                            st_E[a] = E_all[x].copy()
+                    if _n_subst:
+                        # greedy CSE substitutions materialized this round
+                        telemetry.counter('cse.substitutions').inc(_n_subst)
+
+                # depth-2 dispatch pipeline: chunk k+1 is packed, uploaded,
+                # and dispatched while chunk k still executes (jax dispatch
+                # is async; the fetch in _drain is the only blocking point),
+                # so host pack/unpack overlaps device compute
+                inflight: list = []
+                for lo in range(0, n_pend, max_lanes):
+                    hi = min(lo + max_lanes, n_pend)
+                    chunk = pend[lo:hi]
+                    n_chunk = hi - lo
+                    bucket = _bucket_lanes(n_chunk, mesh)
+                    # host arrays trimmed to the rows that carry state (the
+                    # device pads to P); pad rows keep the benign-metadata
+                    # invariant (step 1.0, not 0): zero digit rows are never
+                    # selectable, but scoring reads the step column unguarded.
+                    # Padding lanes start at cur = P so their loop exits
+                    # immediately.
+                    rows_h = rows_in if rows_in < P else P
+                    cE = np.zeros((bucket, rows_h, O, B), np.int8)
+                    cq = np.zeros((bucket, rows_h, 3), np.float32)
+                    cq[:, :, 2] = 1.0
+                    cl = np.zeros((bucket, rows_h), np.float32)
+                    cc = np.full((bucket,), P, np.int32)
+                    cm = np.zeros((bucket,), np.int32)
+                    for x, a in enumerate(chunk):
+                        pa = min(hE[a].shape[0], rows_h)
+                        cE[x, :pa] = hE[a][:pa]
+                        cq[x, :pa] = hq[a][:pa]
+                        cl[x, :pa] = hl[a][:pa]
+                        cc[x] = st_cur[a]
+                        cm[x] = mcodes[a]
+                    if rows_h < P and (O * B) % 16 == 0:
+                        # trit-packed upload (16 digits per int32 word, offset
+                        # by 1); the device unpacks — see _pack_digits
+                        cE_send = _trit_pack_np(cE.reshape(bucket, rows_h, O * B))
+                    elif rows_h < P and (O * B) % 4 == 0:
+                        # int32-packed upload (same little-endian view the
+                        # fetch side uses); the device bitcasts back to int8
+                        cE_send = np.ascontiguousarray(cE).reshape(bucket, rows_h, O * B).view(np.int32)
+                    else:
+                        cE_send = cE
+                    args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm))
+                    run = fn if sh is not None else _class_runner(spec, bucket, fn, args)
+                    t0 = time.perf_counter() if _timed else 0.0
+                    try:
+                        outs = run(*args)
+                    except Exception as e:
+                        if select != 'fused':
+                            raise
+                        import dataclasses
+                        import warnings
+
+                        _mark_fused_broken(e)
+                        warnings.warn(f'fused CSE kernel failed ({type(e).__name__}); using the XLA top4 loop: {e}')
+                        select = 'top4'
+                        fn = _build_cse_fn(dataclasses.replace(spec, select='top4'))
+                        outs = fn(*args)
+                    inflight.append((lo, n_chunk, chunk, bucket, args, outs, t0, (spec, bucket)))
+                    if len(inflight) >= 2:
+                        _drain(inflight.pop(0))
+                while inflight:
+                    _drain(inflight.pop(0))
+                pend = next_pend
+
+            emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)
+            for a, k in enumerate(active):
+                if k in net:  # solved on host by the PMAX safety net
+                    continue
+                ln = lanes[k]
+                ni, no, nb = ln.csd.shape
+                n_add = int(st_cur[a]) - n_in_max
+                E_f = st_E[a]
+                # slots in the device tensor: [0, n_in_max) inputs,
+                # [n_in_max, ...) new. Remap device slot index -> host op
+                # index (inputs of THIS lane first)
+                E_lane = np.concatenate([E_f[:ni, :no, :nb], E_f[n_in_max : n_in_max + n_add, :no, :nb]], axis=0)
+                rec = np.concatenate(recs[a], axis=0) if recs[a] else np.zeros((0, 4), np.int32)
+                shift_down = n_in_max - ni
+                if shift_down:
+                    rec = rec.copy()
+                    rec[:, 0] = np.where(rec[:, 0] >= ni, rec[:, 0] - shift_down, rec[:, 0])
+                    rec[:, 1] = np.where(rec[:, 1] >= ni, rec[:, 1] - shift_down, rec[:, 1])
+                shift0 = ln.shift0
+                if ln.perm is not None:
+                    # restart lane: device slot k held input perm[k]; renumber
+                    # back to the original input order (operand roles — and
+                    # thus values — are untouched; ids are pure references)
+                    perm = np.asarray(ln.perm)
+                    E_un = E_lane.copy()
+                    E_un[perm] = E_lane[:ni]
+                    E_lane = E_un
+                    shift0 = np.empty_like(ln.shift0)
+                    shift0[perm] = ln.shift0
+                    rec = rec.copy()
+                    for c in (0, 1):
+                        v = rec[:, c]
+                        rec[:, c] = np.where(v < ni, perm[np.minimum(v, ni - 1)], v)
+                emit_jobs.append((k, E_lane, rec, shift0))
+            return emit_jobs, net
+
+        def _emit_group(emit_jobs: list) -> dict[int, CombLogic]:
+            """Host-side solution emission for one bucket's finished lanes."""
+            out: dict[int, CombLogic] = {}
+            with telemetry.span('cmvm.jax.emit', n_jobs=len(emit_jobs)):
+                if _native_emit_available():
+                    from ..native.bindings import emit_batch
+
+                    lane_tuples = []
+                    for k, E_lane, rec, shift0 in emit_jobs:
+                        ln = lanes[k]
+                        qints = np.asarray([(q.min, q.max, q.step) for q in ln.qintervals], np.float64).reshape(-1, 3)
+                        lats = np.asarray(ln.latencies, np.float64)
+                        lane_tuples.append((shift0, ln.shift1, qints, lats, E_lane, rec))
+                    for (k, _, _, _), sol in zip(emit_jobs, emit_batch(lane_tuples, adder_size, carry_size, raw=raw)):
+                        out[k] = sol
+                else:
+                    for k, E_lane, rec, shift0 in emit_jobs:
+                        ln = lanes[k]
+                        state = _host_state_from(ln, rec, E_lane, len(rec), adder_size, carry_size, shift0=shift0)
+                        out[k] = to_solution(state, adder_size, carry_size)
+            return out
+
+        # --- overlapped dispatch/emit -----------------------------------
+        # buckets run their device ladders sequentially (heaviest class
+        # first), but each bucket's host emission is handed to a single
+        # background worker so it overlaps the NEXT bucket's device rounds
+        # — the serial "execute, fetch, emit, repeat" round-trip becomes a
+        # two-stage pipeline. One worker (not a pool) keeps emission
+        # single-threaded: to_solution / emit_batch were never required to
+        # be re-entrant across lanes of different groups.
+        use_async = len(groups) > 1 and os.environ.get('DA4ML_JAX_ASYNC_EMIT', '1') not in ('0', 'false', 'off')
+        order = sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True)
+        if not use_async:
+            for (gO, gB), g_active in order:
+                emit_jobs, net = _run_group(gO, gB, g_active)
+                results.update(net)
+                results.update(_emit_group(emit_jobs))
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            futs = []
+            pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix='da4ml-emit')
+            try:
+                for (gO, gB), g_active in order:
+                    emit_jobs, net = _run_group(gO, gB, g_active)
+                    results.update(net)
+                    futs.append(pool.submit(_emit_group, emit_jobs))
+                    telemetry.counter('emit.async_batches').inc()
+                for fut in futs:
+                    t_w = time.perf_counter()
+                    results.update(fut.result())
+                    # ~0 wait = the emission fully overlapped device rounds
+                    telemetry.histogram('emit.async_wait_s').observe(time.perf_counter() - t_w)
+            finally:
+                pool.shutdown(wait=True)
+
+    for k, src in dup_of.items():
+        results[k] = results[src]
     return [results[k] for k in range(len(lanes))]
 
 
@@ -1340,20 +1722,25 @@ def _prewarm_submit(job) -> None:
     _PREWARM_Q.put(job)
 
 
+#: (spec, bucket) classes already AOT-compiled by a prewarm this process —
+#: estimators from different callers overlap heavily, and each redundant
+#: lower+compile burns background CPU the live solve needs
+_PREWARMED: set = set()
+
+
 def _prewarm_class(spec: _KernelSpec, bucket: int) -> None:
     """AOT-compile a shape class (lower + compile, NO execution — a prewarm
     must never contend for device HBM with the live solve). With the
     persistent XLA cache armed the later real call deserializes instead of
-    recompiling; failures are swallowed."""
+    recompiling; failures are swallowed. Idempotent per (spec, bucket)."""
+    if (spec, bucket) in _PREWARMED:
+        return
+    _PREWARMED.add((spec, bucket))
     try:
         # arm the persistent cache if the process has not configured one —
         # without it an AOT compile warms nothing (never override a
         # user-configured dir)
-        if not jax.config.read('jax_compilation_cache_dir'):
-            jax.config.update(
-                'jax_compilation_cache_dir', os.environ.get('DA4ML_JAX_CACHE', '/tmp/da4ml_jax_cache')
-            )
-            jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+        ensure_compile_cache()
         fn = _build_cse_fn(spec)
         P, O, B = spec.P, spec.O, spec.B
         rows = spec.R_in or P
@@ -1368,6 +1755,9 @@ def _prewarm_class(spec: _KernelSpec, bucket: int) -> None:
         cc = jax.ShapeDtypeStruct((bucket,), jnp.int32)
         cm = jax.ShapeDtypeStruct((bucket,), jnp.int32)
         fn.lower(E, q, lat, cc, cm).compile()
+        # record the class marker so a later process's first call of this
+        # class classifies as jit.cache_load, not jit.compile
+        _classify_first_call((spec, bucket))
     except Exception:
         pass
 
@@ -1408,17 +1798,14 @@ def _resolve_rung_class(
     return _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk)
 
 
-def _first_rung_spec(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None):
-    """(spec, bucket) the FIRST device rung of ``solve_single_lanes`` will
-    use for these lanes — a mirror of the rung-entry calculation there, used
-    only to pre-warm compiles; a drifted estimate wastes one background
-    compile and can never change results. Returns None when nothing routes
-    to the device. Repeated lane references (restart copies) share one CSD
-    decomposition while counting toward the bucket."""
-
-    def _ceil_to(x: int, q: int) -> int:
-        return -(-x // q) * q
-
+def _first_rung_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None) -> list[tuple]:
+    """The (spec, bucket) pairs of the FIRST device rung of every canonical
+    (O, B) bucket ``solve_single_lanes`` will form for these lanes — a
+    mirror of the group-entry calculation there, used only to pre-warm
+    compiles; a drifted estimate wastes one background compile and can
+    never change results. Empty when nothing routes to the device.
+    Repeated lane references (restart copies) share one CSD decomposition
+    while counting toward their bucket."""
     active = [ln for ln in lanes if ln.method != 'dummy']
     for ln in active:
         if ln.csd is None:
@@ -1426,19 +1813,66 @@ def _first_rung_spec(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=
     pmax = _pmax()
     active = [ln for ln in active if ln.csd.shape[0] + _lane_initial_digits(ln) // 2 <= pmax]
     if not active:
-        return None
-    n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in active))
-    O = max(8, _next_pow2(max(ln.csd.shape[1] for ln in active)))
-    B = _ceil_to(max(ln.csd.shape[2] for ln in active), 2)
-    digits_max = max(_lane_initial_digits(ln) for ln in active)
-    step = _ceil_to(max(16, -(-digits_max // 8)), 8)
-    P = _next_pow2(n_in_max + step)
-    if P > pmax:
-        if n_in_max >= pmax:
-            return None
-        P = pmax
-    spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, n_in_max)
-    return spec, _bucket_lanes(len(active), mesh)
+        return []
+    if mesh is None:
+        mesh = _auto_mesh()
+    groups: dict[tuple[int, int], list[_Lane]] = {}
+    for ln in active:
+        gk = (_canon_dim(ln.csd.shape[1], 8), _canon_dim(ln.csd.shape[2], 2))
+        groups.setdefault(gk, []).append(ln)
+    out: list[tuple] = []
+    for (O, B), grp in sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True):
+        n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in grp))
+        P = _ladder_P(n_in_max, None)
+        if P > pmax:
+            if n_in_max >= pmax:
+                continue
+            P = pmax
+        spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, n_in_max)
+        out.append((spec, _bucket_lanes(len(grp), mesh)))
+    return out
+
+
+def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None) -> list[tuple]:
+    """Every (spec, bucket) rung of every canonical bucket these lanes walk
+    — the full-ladder extension of :func:`_first_rung_specs`, mirroring the
+    live rung loop's resume policy (geometric ``_ladder_P``, resume buckets
+    shrink to the lanes whose slot demand outgrows a rung). Used by the
+    warmup CLI to AOT-precompile a whole grid without running solves."""
+    active = [ln for ln in lanes if ln.method != 'dummy']
+    for ln in active:
+        if ln.csd is None:
+            _prepare_lane(ln)
+    pmax = _pmax()
+    active = [ln for ln in active if ln.csd.shape[0] + _lane_initial_digits(ln) // 2 <= pmax]
+    if not active:
+        return []
+    if mesh is None:
+        mesh = _auto_mesh()
+    groups: dict[tuple[int, int], list[_Lane]] = {}
+    for ln in active:
+        gk = (_canon_dim(ln.csd.shape[1], 8), _canon_dim(ln.csd.shape[2], 2))
+        groups.setdefault(gk, []).append(ln)
+    out: list[tuple] = []
+    for (O, B), grp in sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True):
+        n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in grp))
+        demands = [ln.csd.shape[0] + _lane_initial_digits(ln) // 2 for ln in grp]
+        cur = n_in_max
+        while True:
+            P = _ladder_P(cur, None)
+            if P > pmax:
+                if cur >= pmax:
+                    break
+                P = pmax
+            pending = [d for d in demands if d > cur] if cur > n_in_max else demands
+            if not pending:
+                break
+            spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(cur))
+            out.append((spec, _bucket_lanes(len(pending), mesh)))
+            if P >= max(demands) or P >= pmax:
+                break
+            cur = P
+    return out
 
 
 def prewarm_for_kernels(
@@ -1453,6 +1887,8 @@ def prewarm_for_kernels(
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
     mesh=None,
+    full_ladder: bool = False,
+    inline: bool = False,
     **_ignored,
 ) -> int:
     """Model-level background prewarm: AOT-compile every device shape class a
@@ -1474,8 +1910,14 @@ def prewarm_for_kernels(
     ``DA4ML_JAX_PREWARM=1``) or every group was empty/degenerate — all the
     per-class compiles run inside that one queued job. Unknown solver
     options are ignored so callers can forward ``solver_options`` wholesale.
+
+    ``full_ladder=True`` precompiles every rung of every canonical bucket
+    (``_ladder_specs``), not just the first rungs; ``inline=True`` runs the
+    job synchronously on the caller's thread (bypassing the platform gate —
+    an explicit warmup is user intent) and returns the number of classes
+    compiled. The warmup CLI uses both to populate the persistent cache.
     """
-    if not _prewarm_enabled():
+    if not inline and not _prewarm_enabled():
         return 0
     groups = [[np.ascontiguousarray(np.asarray(k, np.float64)) for k in g] for g in kernel_groups if g]
     groups = [g for g in groups if all(k.ndim == 2 and k.size for k in g)]
@@ -1531,11 +1973,18 @@ def prewarm_for_kernels(
                 copies = n_restarts if p0.method != 'dummy' else 1
                 lanes0.extend([p0] * copies)
                 lanes1.extend([p1] * copies)
+            _estimate = _ladder_specs if full_ladder else _first_rung_specs
             for lanes in (lanes0, lanes1):
-                got = _first_rung_spec(lanes, adder_size, carry_size, mesh)
-                if got is not None:
-                    _prewarm_class(*got)
+                for got in _estimate(lanes, adder_size, carry_size, mesh):
+                    key = (got[0], got[1])
+                    if key not in warmed:
+                        warmed.add(key)
+                        _prewarm_class(*got)
 
+    warmed: set = set()
+    if inline:
+        _job()
+        return len(warmed)
     _prewarm_submit(_job)
     return 1
 
@@ -1652,8 +2101,13 @@ def solve_jax(
     search_all_decompose_dc: bool = True,
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
+    mesh=None,
 ) -> Pipeline:
-    """Drop-in `solve` with the candidate search running on TPU."""
+    """Drop-in `solve` with the candidate search running on TPU.
+
+    ``mesh=None`` auto-shards the lane batch over all local devices on a
+    multi-device TPU backend (``_auto_mesh``); pass an explicit mesh to
+    pin, or set ``DA4ML_JAX_MESH=0`` to keep a single device."""
     return solve_jax_many(
         [kernel],
         method0=method0,
@@ -1667,6 +2121,7 @@ def solve_jax(
         search_all_decompose_dc=search_all_decompose_dc,
         method0_candidates=method0_candidates,
         n_restarts=n_restarts,
+        mesh=mesh,
     )[0]
 
 
@@ -1724,6 +2179,11 @@ def _solve_jax_many_impl(
     # orchestration drill point: lets tests/chaos runs fail the whole device
     # search deterministically (DA4ML_FAULT_INJECT=cmvm.jax=...)
     fault_check('cmvm.jax')
+
+    if mesh is None:
+        # resolve the default mesh once here so the background prewarm
+        # estimates below target the same lane buckets the solve will use
+        mesh = _auto_mesh()
 
     kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
     n_mat = len(kernels)
@@ -1833,8 +2293,7 @@ def _solve_jax_many_impl(
         ]
 
         def _warm_stage1(probe=probe):
-            got = _first_rung_spec(probe, adder_size, carry_size, mesh)
-            if got is not None:
+            for got in _first_rung_specs(probe, adder_size, carry_size, mesh):
                 _prewarm_class(*got)
 
         _prewarm_submit(_warm_stage1)

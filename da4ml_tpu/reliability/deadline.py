@@ -16,9 +16,32 @@ the supervised calls release the GIL inside XLA anyway.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable
 
 from .errors import SolveTimeout
+
+#: per-thread active deadline (monotonic clock), set by run_with_deadline in
+#: its worker so supervised code can abort COOPERATIVELY at safe points
+_local = threading.local()
+
+
+def active_deadline() -> float | None:
+    """The supervising deadline of the current thread (monotonic seconds),
+    or None when the thread runs unbudgeted."""
+    return getattr(_local, 'deadline', None)
+
+
+def check_deadline(what: str = 'work') -> None:
+    """Raise SolveTimeout if the current thread's supervising deadline has
+    passed. The supervisor in :func:`run_with_deadline` would fire anyway —
+    but it cannot cancel a worker stuck in native code, so long-running
+    pipelines (the async device-dispatch scheduler in ``cmvm.jax_search``
+    polls this between rungs) call it at safe points to stop burning a
+    detached thread on rounds nobody will consume."""
+    d = active_deadline()
+    if d is not None and time.monotonic() > d:
+        raise SolveTimeout(f'{what}: cooperative deadline check fired (budget exhausted)')
 
 
 def run_with_deadline(fn: Callable[..., Any], deadline_s: float | None, *args, name: str = 'solve', **kwargs) -> Any:
@@ -34,11 +57,14 @@ def run_with_deadline(fn: Callable[..., Any], deadline_s: float | None, *args, n
     done = threading.Event()
 
     def _worker() -> None:
+        prev = getattr(_local, 'deadline', None)
+        _local.deadline = time.monotonic() + deadline_s
         try:
             outcome.append(('ok', fn(*args, **kwargs)))
         except BaseException as e:  # noqa: BLE001 - relayed to the caller
             outcome.append(('err', e))
         finally:
+            _local.deadline = prev
             done.set()
 
     t = threading.Thread(target=_worker, name=f'da4ml-deadline-{name}', daemon=True)

@@ -14,8 +14,12 @@ One solve walks the chain from its requested backend downward. Per attempt:
 a circuit breaker decides whether the backend is worth trying at all,
 transient errors retry with backoff + jitter, and a wall-clock deadline
 bounds the *whole walk* (a hung XLA compile surfaces as
-:class:`SolveTimeout`, not an unbounded stall). Outcomes land in a
-structured :class:`~.report.SolveReport`. An optional
+:class:`SolveTimeout`, not an unbounded stall). Deadline accounting also
+sees the jax backend's ASYNC dispatch pipeline: ``run_with_deadline`` arms
+a per-thread deadline (``deadline.check_deadline``) that the device
+scheduler polls between rungs, so a budgeted solve aborts cooperatively
+instead of burning a detached worker thread on device rounds nobody will
+consume. Outcomes land in a structured :class:`~.report.SolveReport`. An optional
 :class:`~.checkpoint.CheckpointStore` short-circuits kernels already solved
 by a previous (possibly killed) run of the same campaign.
 
@@ -117,6 +121,11 @@ def _call_backend(backend: str, kernel, kw: dict):
     if backend == 'jax':
         from ..cmvm.jax_search import solve_jax
 
+        # the mesh shards device lanes without changing results, so it is
+        # forwarded to the jax backend but deliberately NOT part of
+        # _SOLVE_KW (checkpoint keys must not miss when the mesh changes)
+        if kw.get('mesh') is not None:
+            args['mesh'] = kw['mesh']
         return solve_jax(kernel, **args)
     from ..cmvm import api
 

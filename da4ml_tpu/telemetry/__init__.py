@@ -17,7 +17,7 @@ Instrumentation API (all safe to call when disabled)::
         ...
         sp.set(cost=result.cost)
 
-    telemetry.counter('jit.cache_miss').inc()
+    telemetry.counter('jit.compile').inc()
     telemetry.histogram('solve.duration_s').observe(dt)
     telemetry.gauge('campaign.done').set(i)
     telemetry.instant('campaign.progress', done=i, total=n)
@@ -55,6 +55,7 @@ from .metrics import (
     histogram,
     metrics_on,
     metrics_snapshot,
+    timer,
 )
 
 __all__ = [
@@ -79,6 +80,7 @@ __all__ = [
     'histogram',
     'metrics_on',
     'metrics_snapshot',
+    'timer',
     'Counter',
     'Gauge',
     'Histogram',

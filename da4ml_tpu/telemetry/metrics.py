@@ -10,11 +10,22 @@ Names follow a dotted ``subsystem.metric`` convention — the catalog lives
 in docs/telemetry.md. :func:`metrics_snapshot` returns the whole registry
 as a JSON-serializable dict; the Chrome trace exporter embeds it in the
 trace file's ``otherData`` and ``bench.py`` attaches it to the BENCH JSON.
+
+Device-scheduler metrics (docs/telemetry.md#scheduler): the CMVM search
+driver reports its canonical shape buckets (``sched.bucket_groups`` /
+``sched.bucket_lanes`` / ``sched.dedup_lanes``), rung ladder
+(``sched.rungs``), compile-vs-persistent-cache split (``jit.compile`` /
+``jit.cache_load`` and their ``_s`` histograms — the legacy
+``jit.cache_miss`` / ``jit.first_call_s`` aggregate both), and
+dispatch/emit overlap (``emit.async_batches`` / ``emit.async_wait_s`` —
+a ~0 wait means emission fully overlapped device rounds).
 """
 
 from __future__ import annotations
 
 import threading
+import time
+from contextlib import contextmanager
 
 #: default histogram bucket upper bounds (seconds-oriented, exponential):
 #: spans 100µs .. 100s, which covers everything from a single no-op solve to
@@ -203,6 +214,23 @@ def disable_metrics() -> None:
 def reset_metrics() -> None:
     with _lock:
         _registry.clear()
+
+
+@contextmanager
+def timer(name: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+    """Observe a code block's wall clock into histogram ``name``.
+
+    No-op when metrics are disabled — the clock is never read on the
+    disabled path, matching the zero-cost contract of the accessors.
+    """
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        histogram(name, buckets).observe(time.perf_counter() - t0)
 
 
 def metrics_snapshot() -> dict:

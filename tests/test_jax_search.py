@@ -385,9 +385,9 @@ def test_prewarm_paths(rng, monkeypatch):
         np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
     # the mirror agrees with an actual first-rung spec for simple lanes
     lanes = [js._Lane(kernels[0], [QInterval(-128.0, 127.0, 1.0)] * 8, [0.0] * 8, 'wmc')]
-    got = js._first_rung_spec(lanes, -1, -1)
-    assert got is not None
-    spec, bucket = got
+    specs = js._first_rung_specs(lanes, -1, -1)
+    assert specs
+    spec, bucket = specs[0]
     assert spec.P >= 8 and spec.O >= 8 and bucket >= 1
     # the worker is a daemon on a SimpleQueue: queued AOT compiles never
     # block interpreter exit, so there is nothing to drain here
@@ -403,6 +403,15 @@ def test_prewarm_for_kernels_covers_solve_classes(rng, monkeypatch):
     assert prewarm_for_kernels([[random_kernel(rng, 8, 4)]]) == 0  # disabled: no-op
 
     monkeypatch.setenv('DA4ML_JAX_PREWARM', '1')
+    # drain stale background prewarm jobs queued by EARLIER tests: the
+    # daemon worker is FIFO, so once a barrier job runs, no previously
+    # queued job can append into the monkeypatched recorder below
+    import threading
+
+    _drained = threading.Event()
+    js._prewarm_submit(_drained.set)
+    assert _drained.wait(timeout=120), 'background prewarm worker wedged'
+
     warmed: list = []
     monkeypatch.setattr(js, '_prewarm_submit', lambda job: job())  # run inline
     monkeypatch.setattr(js, '_prewarm_class', lambda spec, bucket: warmed.append((spec, bucket)))

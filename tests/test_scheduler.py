@@ -1,0 +1,202 @@
+"""Throughput-first device scheduler: dedupe, overlap, cache split, mesh,
+and cooperative deadlines (docs/api.md#scheduler-knobs)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import da4ml_tpu.cmvm.jax_search as js
+from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+
+def random_kernel(rng, n_in, n_out, bits):
+    mag = rng.integers(0, 2**bits, (n_in, n_out)).astype(np.float64)
+    return mag * rng.choice([-1.0, 1.0], (n_in, n_out))
+
+
+def _identical(a, b):
+    assert float(a.cost) == float(b.cost)
+    for sa, sb in zip(a.stages, b.stages):
+        assert len(sa.ops) == len(sb.ops)
+        for oa, ob in zip(sa.ops, sb.ops):
+            assert (oa.id0, oa.id1, oa.opcode, oa.data) == (ob.id0, ob.id1, ob.opcode, ob.data)
+
+
+def test_duplicate_lanes_dedupe(rng):
+    """Byte-identical kernels in one batch solve once and fan out; results
+    are identical objects and still exact."""
+    from da4ml_tpu.telemetry.metrics import disable_metrics, enable_metrics, metrics_snapshot, reset_metrics
+
+    k = random_kernel(rng, 6, 6, 4)
+    enable_metrics()
+    reset_metrics()
+    try:
+        sols = solve_jax_many([k, k.copy(), k.copy()])
+        snap = metrics_snapshot()
+    finally:
+        disable_metrics()
+    for s in sols:
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+    _identical(sols[0], sols[1])
+    _identical(sols[0], sols[2])
+    # the dc ladder of 3 identical matrices dedupes at least the copies
+    assert snap.get('sched.dedup_lanes', {}).get('value', 0) >= 2
+
+
+def test_async_emit_toggle_identical(rng, monkeypatch):
+    """DA4ML_JAX_ASYNC_EMIT=0 (serial emit) and the default overlapped emit
+    produce identical solutions for a multi-bucket batch."""
+    kernels = [random_kernel(rng, 6, 6, 2), random_kernel(rng, 8, 8, 6)]  # 2 canonical buckets
+    base = solve_jax_many(kernels)
+    monkeypatch.setenv('DA4ML_JAX_ASYNC_EMIT', '0')
+    serial = solve_jax_many(kernels)
+    for a, b in zip(base, serial):
+        _identical(a, b)
+
+
+def test_auto_mesh_parity(rng, monkeypatch):
+    """DA4ML_JAX_MESH=1 shards the lane batch over the 8 virtual cpu
+    devices; solutions are identical to the single-device path."""
+    kernels = [random_kernel(rng, 6, 6, 4), random_kernel(rng, 8, 6, 3)]
+    base = solve_jax_many(kernels)
+    monkeypatch.setenv('DA4ML_JAX_MESH', '1')
+    js._auto_mesh_for.cache_clear()
+    try:
+        meshy = solve_jax_many(kernels)
+    finally:
+        js._auto_mesh_for.cache_clear()
+    for k, a, b in zip(kernels, base, meshy):
+        np.testing.assert_array_equal(np.asarray(b.kernel, np.float64), k)
+        _identical(a, b)
+
+
+def test_auto_mesh_off_by_default_on_cpu():
+    assert js._auto_mesh() is None  # cpu backend: explicit opt-in only
+
+
+def test_first_call_classification_markers(tmp_path, monkeypatch):
+    """_classify_first_call: first sighting of a class against a cache dir
+    is 'compile' (and writes the marker), later sightings are 'cache_load'
+    — including from other processes sharing the dir."""
+    import jax
+
+    prev = getattr(jax.config, 'jax_compilation_cache_dir', None)
+    jax.config.update('jax_compilation_cache_dir', str(tmp_path))
+    try:
+        cls = ('probe-class', 123)
+        assert js._classify_first_call(cls) == 'compile'
+        assert js._classify_first_call(cls) == 'cache_load'
+        other = ('probe-class', 456)
+        assert js._classify_first_call(other) == 'compile'
+    finally:
+        jax.config.update('jax_compilation_cache_dir', prev)
+
+
+def test_record_first_call_metrics(tmp_path):
+    import jax
+
+    from da4ml_tpu.telemetry.metrics import disable_metrics, enable_metrics, metrics_snapshot, reset_metrics
+
+    prev = getattr(jax.config, 'jax_compilation_cache_dir', None)
+    jax.config.update('jax_compilation_cache_dir', str(tmp_path))
+    enable_metrics()
+    reset_metrics()
+    try:
+        js._record_first_call(('m1', 1), 0.25)
+        js._record_first_call(('m1', 1), 0.01)  # marker now exists -> cache_load
+        snap = metrics_snapshot()
+    finally:
+        disable_metrics()
+        reset_metrics()
+        jax.config.update('jax_compilation_cache_dir', prev)
+    assert snap['jit.compile']['value'] == 1
+    assert snap['jit.cache_load']['value'] == 1
+    # the legacy aggregate still counts both first calls
+    assert snap['jit.cache_miss']['value'] == 2
+
+
+def test_cooperative_deadline_check():
+    from da4ml_tpu.reliability import deadline as dl
+    from da4ml_tpu.reliability.errors import SolveTimeout
+
+    # no active deadline: a no-op
+    dl.check_deadline('unit test')
+    # expired deadline on this thread: raises
+    dl._local.deadline = time.monotonic() - 1.0
+    try:
+        with pytest.raises(SolveTimeout):
+            dl.check_deadline('unit test')
+    finally:
+        dl._local.deadline = None
+
+
+def test_run_with_deadline_arms_cooperative_checks():
+    from da4ml_tpu.reliability import deadline as dl
+
+    got = dl.run_with_deadline(dl.active_deadline, 5.0, name='probe')
+    assert got is not None and got > time.monotonic()
+    assert dl.active_deadline() is None  # restored outside the worker
+
+
+def test_solve_deadline_aborts_device_rungs(rng, monkeypatch):
+    """A budgeted orchestrated jax solve stops between rungs instead of
+    burning the detached worker: the cooperative check fires inside
+    solve_single_lanes."""
+    from da4ml_tpu.reliability import deadline as dl
+    from da4ml_tpu.reliability.errors import SolveTimeout
+
+    kernel = random_kernel(rng, 8, 8, 4)
+    dl._local.deadline = time.monotonic() - 1.0
+    try:
+        with pytest.raises(SolveTimeout):
+            solve_jax_many([kernel])
+    finally:
+        dl._local.deadline = None
+
+
+def test_warmup_grid_mirror(rng, monkeypatch):
+    """_ladder_specs (the warmup grid enumerator) contains every class the
+    live solve builds for the same kernels — the no-drift property."""
+    from da4ml_tpu.ir import QInterval
+
+    kernels = [random_kernel(rng, 8, 8, 4)]
+    monkeypatch.setenv('DA4ML_JAX_PREWARM', '0')
+
+    used: list = []
+    real_build = js._build_cse_fn
+    monkeypatch.setattr(js, '_build_cse_fn', lambda spec: (used.append(spec), real_build(spec))[1])
+    sols = solve_jax_many(kernels)
+    np.testing.assert_array_equal(np.asarray(sols[0].kernel, np.float64), kernels[0])
+    monkeypatch.setattr(js, '_build_cse_fn', real_build)
+
+    warmed: list = []
+    monkeypatch.setattr(js, '_prewarm_class', lambda spec, bucket: warmed.append(spec))
+    n = js.prewarm_for_kernels([kernels], full_ladder=True, inline=True)
+    assert n == len(warmed) and n > 0
+    missing = set(used) - set(warmed)
+    assert not missing, f'live classes missing from the warmup grid: {missing}'
+
+
+def test_cache_smoke_script(tmp_path):
+    """The two-process persistent-cache drill (also the CI gate): the second
+    process must report zero jit.compile events and a sub-second compile
+    wall clock."""
+    script = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), 'cache_smoke.py')
+    out = tmp_path / 'stats.json'
+    r = subprocess.run(
+        [sys.executable, script, '--out', str(out), '--cache-dir', str(tmp_path / 'xla')],
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert r.returncode == 0, (r.stdout or '')[-500:] + (r.stderr or '')[-500:]
+    data = json.loads(out.read_text())
+    assert data['ok']
+    cold, warm = data['runs']
+    assert cold['jit_compile'] > 0 and cold['jit_cache_load'] == 0
+    assert warm['jit_compile'] == 0 and warm['jit_cache_load'] > 0
