@@ -244,6 +244,35 @@ def _run_inference_micro(limited: bool):
     out_host = comb.predict(data, n_threads=HOST_THREADS)
     host_t = time.perf_counter() - t0
 
+    # per-mode regression surface: rate + compile seconds for each concrete
+    # execution mode (docs/runtime.md) on a capped batch (scan's execution
+    # buffer is n_ops x batch; the headline device_rate above stays full-size)
+    prog = decode(comb.to_binary())
+    mode_n = min(n_samples, 65536)
+    mode_data = data[:mode_n]
+    host_ref = out_host[:mode_n]
+    modes = {}
+    for m in ('unroll', 'scan', 'level'):
+        try:
+            t0 = time.perf_counter()
+            exm = DaisExecutor(prog, mode=m)
+            out_m = exm(mode_data)  # first call pays the compile
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out_m = exm(mode_data)
+            mt = time.perf_counter() - t0
+            modes[m] = {
+                'rate': round(mode_n / mt, 1),
+                'compile_s': round(compile_s, 3),
+                'bit_exact': bool(np.array_equal(out_m, host_ref)),
+            }
+        except Exception as e:
+            modes[m] = {'error': f'{type(e).__name__}: {e}'[:160]}
+
+    # >UNROLL_LIMIT program (ir.synth, layered): unroll must refuse, level
+    # must compile in O(depth x families) and outrun the scan interpreter
+    large = _run_large_program_probe(limited)
+
     # multi-stage pipeline: fused single-program vs per-stage chained jax
     from da4ml_tpu.trace import to_pipeline
 
@@ -254,18 +283,26 @@ def _run_inference_micro(limited: bool):
     fused_t = time.perf_counter() - t0
     chain = [s.to_binary() for s in pipe.stages]
 
-    def _chained(d):
-        from da4ml_tpu.runtime.jax_backend import run_binary
+    # chained = per-stage jitted programs with device-resident donated
+    # intermediates (run_pipeline(fused=False)); hostloop = the legacy
+    # float host round-trip at every stage boundary
+    from da4ml_tpu.runtime.jax_backend import run_binary, run_pipeline
 
+    run_pipeline(chain, data, fused=False)
+    t0 = time.perf_counter()
+    out_c = run_pipeline(chain, data, fused=False)
+    chain_t = time.perf_counter() - t0
+
+    def _hostloop(d):
         out = d
         for b in chain:
             out = run_binary(b, out)
         return out
 
-    _chained(data)
+    _hostloop(data)
     t0 = time.perf_counter()
-    out_c = _chained(data)
-    chain_t = time.perf_counter() - t0
+    out_h = _hostloop(data)
+    hostloop_t = time.perf_counter() - t0
     return {
         'n_samples': n_samples,
         'device_rate': round(n_samples / dev_t, 1),
@@ -274,12 +311,53 @@ def _run_inference_micro(limited: bool):
         'speedup': round(host_t / dev_t, 3),
         'speedup_resident': round(host_t / res_t, 3),
         'bit_exact': bool(np.array_equal(out_dev, out_host)),
+        'auto_mode': ex.mode,
+        'modes': modes,
+        'large_program': large,
         'pipeline_stages': len(pipe.stages),
         'pipeline_fused_rate': round(n_samples / fused_t, 1),
         'pipeline_chained_rate': round(n_samples / chain_t, 1),
+        'pipeline_hostloop_rate': round(n_samples / hostloop_t, 1),
         'pipeline_fused_vs_chained': round(chain_t / fused_t, 3),
-        'pipeline_bit_exact': bool(np.array_equal(out_f, out_host) and np.array_equal(out_c, out_host)),
+        'pipeline_bit_exact': bool(
+            np.array_equal(out_f, out_host) and np.array_equal(out_c, out_host) and np.array_equal(out_h, out_host)
+        ),
     }
+
+
+def _run_large_program_probe(limited: bool) -> dict:
+    """level-mode acceptance probe: a layered >20k-op DAIS program that
+    ``unroll`` refuses must compile under ``level`` and outrun ``scan``."""
+    from da4ml_tpu.ir.synth import random_inputs, random_program
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.runtime.numpy_backend import run_program
+
+    rng = np.random.default_rng(17)
+    big = random_program(rng, n_ops=21_000, n_in=16, n_out=8, n_levels=24)
+    bdata = random_inputs(rng, big, 128 if limited else 4096)
+    entry: dict = {'n_ops': big.n_ops, 'n_samples': len(bdata)}
+    try:
+        DaisExecutor(big, mode='unroll')
+        entry['unroll_refused'] = False
+    except ValueError:
+        entry['unroll_refused'] = True
+    ref = run_program(big, bdata)
+    for m in ('level', 'scan'):
+        try:
+            t0 = time.perf_counter()
+            exm = DaisExecutor(big, mode=m)
+            out = exm(bdata)
+            entry[f'{m}_compile_s'] = round(time.perf_counter() - t0, 3)
+            t0 = time.perf_counter()
+            out = exm(bdata)
+            dt = time.perf_counter() - t0
+            entry[f'{m}_rate'] = round(len(bdata) / dt, 1)
+            entry[f'{m}_bit_exact'] = bool(np.array_equal(out, ref))
+        except Exception as e:
+            entry[f'{m}_error'] = f'{type(e).__name__}: {e}'[:160]
+    if entry.get('level_rate') and entry.get('scan_rate'):
+        entry['level_vs_scan'] = round(entry['level_rate'] / entry['scan_rate'], 3)
+    return entry
 
 
 def _section_kernels(name: str, n1: int, limited: bool):

@@ -49,6 +49,20 @@ def _load_program(path: Path):
     return CombLogic.from_dict(blob, verify=False)
 
 
+def _schedule_stats(program) -> list[dict]:
+    """ASAP level-schedule stats per stage (ir.schedule): depth is the
+    dependency critical path in ops; mean level width is how many ops are
+    executable together — the parallelism the level-packed runtime exploits."""
+    from ..ir.schedule import levelize_comb
+
+    stages = program.stages if hasattr(program, 'stages') else [program]
+    per = []
+    for st in stages:
+        s = levelize_comb(st)
+        per.append({'n_ops': len(st.ops), 'depth': s.depth, 'width_max': s.width_max, 'width_mean': round(s.width_mean, 1)})
+    return per
+
+
 def verify_main(args: argparse.Namespace) -> int:
     from ..analysis import verify
 
@@ -70,11 +84,18 @@ def verify_main(args: argparse.Namespace) -> int:
             continue
 
         result = verify(program, passes=passes, target=str(raw_path))
-        results.append(result.to_dict())
+        entry = result.to_dict()
+        try:
+            entry['schedule'] = _schedule_stats(program)
+        except Exception:  # stats are informational; never fail the verify
+            pass
+        results.append(entry)
         if not result.ok or (args.strict and result.warnings):
             rc = max(rc, 1)
         if not args.as_json:
             print(result.format_text(show_warnings=not args.no_warnings))
+            for i, s in enumerate(entry.get('schedule', [])):
+                print(f'  stage {i}: {s["n_ops"]} ops, schedule depth {s["depth"]}, mean level width {s["width_mean"]}')
 
     if args.as_json:
         print(json.dumps(results if len(results) > 1 else results[0], indent=2))

@@ -1,10 +1,15 @@
 from .comb import CombLogic, Pipeline
 from .lut import LookupTable, TableSpec, interpret_as, lsb_loc
+from .schedule import LevelSchedule, levelize, levelize_comb, levelize_program
 from .types import Op, Precision, QInterval, minimal_kif, qint_add, quantize_float, relu_float
 
 __all__ = [
     'CombLogic',
     'Pipeline',
+    'LevelSchedule',
+    'levelize',
+    'levelize_comb',
+    'levelize_program',
     'LookupTable',
     'TableSpec',
     'Op',

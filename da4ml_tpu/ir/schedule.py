@@ -1,0 +1,139 @@
+"""Dependency-level (ASAP) scheduling of DAIS SSA op lists.
+
+A DAIS program is a static dataflow graph: every op depends only on earlier
+slots, so ops at equal dependency depth are mutually independent and can
+execute together. ``levelize`` assigns each op its ASAP level (inputs and
+constants at level 0, every other op one past its deepest operand) and
+returns a :class:`LevelSchedule` — a packed execution order in which each
+level (optionally each (level, key) group) is a contiguous run.
+
+Consumers:
+
+- ``runtime/jax_backend`` (``mode='level'``) executes each (level, opcode
+  family) group as a handful of vectorized primitives instead of one op at
+  a time — compile cost O(depth × families), runtime vectorized over
+  ops × samples;
+- ``da4ml-tpu verify`` reports the schedule depth / mean level width per
+  program (a quick read on how parallel a program is);
+- codegen pipelining can cut stages on level boundaries (levels are exactly
+  the combinational rank of each op).
+
+Works on both decoded :class:`~.dais_binary.DaisProgram` streams and
+:class:`~.comb.CombLogic` op lists.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+from numpy.typing import NDArray
+
+#: opcodes whose id1 slot is a live dependency (docs/dais.md:46-68)
+_USES_ID1 = frozenset((0, 1, 6, -6, 7, 10))
+
+
+class LevelSchedule(NamedTuple):
+    """ASAP schedule of an SSA op list.
+
+    ``order`` is a permutation of op indices sorted by (level, sort_key,
+    index); ``starts`` bounds each level within ``order`` so level ``l``
+    occupies ``order[starts[l]:starts[l+1]]``.
+    """
+
+    level: NDArray[np.int32]  # (n_ops,) dependency depth per op
+    order: NDArray[np.int32]  # (n_ops,) packed execution order
+    starts: NDArray[np.int64]  # (depth+1,) level boundaries within `order`
+
+    @property
+    def depth(self) -> int:
+        """Number of levels (0 for an empty program)."""
+        return len(self.starts) - 1
+
+    def ops_at(self, lvl: int) -> NDArray[np.int32]:
+        """Op indices (original numbering) scheduled at level ``lvl``."""
+        return self.order[int(self.starts[lvl]) : int(self.starts[lvl + 1])]
+
+    @property
+    def width_max(self) -> int:
+        return int(np.diff(self.starts).max()) if self.depth else 0
+
+    @property
+    def width_mean(self) -> float:
+        return float(len(self.level) / self.depth) if self.depth else 0.0
+
+
+def levelize(
+    opcode: NDArray,
+    id0: NDArray,
+    id1: NDArray,
+    cond: NDArray | None = None,
+    sort_key: NDArray | None = None,
+) -> LevelSchedule:
+    """Compute the ASAP level schedule of an SSA op list.
+
+    ``cond`` carries the MSB-mux condition slot per op (only read where
+    ``|opcode| == 6``); ``sort_key`` orders ops *within* a level (the runtime
+    passes the opcode family so each (level, family) group is contiguous in
+    ``order``). Causality (deps < op index) is assumed, as guaranteed by
+    ``DaisProgram.validate`` / the tracer.
+    """
+    n = len(opcode)
+    oc = np.asarray(opcode, dtype=np.int64)
+    uses0 = (oc != -1) & (oc != 5)
+    uses1 = np.isin(oc, tuple(_USES_ID1))
+    usesc = np.abs(oc) == 6
+
+    # plain-int lists: ~5x faster than scalar ndarray indexing in the loop
+    u0 = uses0.tolist()
+    u1 = uses1.tolist()
+    uc = usesc.tolist()
+    d0 = np.asarray(id0, dtype=np.int64).tolist()
+    d1 = np.asarray(id1, dtype=np.int64).tolist()
+    dc = np.asarray(cond, dtype=np.int64).tolist() if cond is not None else None
+
+    lvl: list[int] = [0] * n
+    for i in range(n):
+        m = -1
+        if u0[i]:
+            m = lvl[d0[i]]
+        if u1[i]:
+            v = lvl[d1[i]]
+            if v > m:
+                m = v
+        if uc[i] and dc is not None:
+            v = lvl[dc[i]]
+            if v > m:
+                m = v
+        lvl[i] = m + 1
+
+    level = np.asarray(lvl, dtype=np.int32)
+    if sort_key is not None:
+        order = np.lexsort((np.arange(n), np.asarray(sort_key), level)).astype(np.int32)
+    else:
+        order = np.argsort(level, kind='stable').astype(np.int32)
+    depth = int(level.max()) + 1 if n else 0
+    counts = np.bincount(level, minlength=depth) if n else np.zeros(0, dtype=np.int64)
+    starts = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return LevelSchedule(level=level, order=order, starts=starts)
+
+
+def levelize_program(prog, sort_key: NDArray | None = None) -> LevelSchedule:
+    """Level schedule of a decoded :class:`~.dais_binary.DaisProgram`."""
+    return levelize(prog.opcode, prog.id0, prog.id1, cond=prog.data_lo, sort_key=sort_key)
+
+
+def levelize_comb(comb) -> LevelSchedule:
+    """Level schedule of a :class:`~.comb.CombLogic` op list.
+
+    The mux condition slot lives in the low half of ``op.data``
+    (comb.py ``_rp_msb_mux``).
+    """
+    ops = comb.ops
+    opcode = np.fromiter((op.opcode for op in ops), dtype=np.int64, count=len(ops))
+    id0 = np.fromiter((op.id0 for op in ops), dtype=np.int64, count=len(ops))
+    id1 = np.fromiter((op.id1 for op in ops), dtype=np.int64, count=len(ops))
+    cond = np.fromiter(
+        ((op.data & 0xFFFFFFFF) if abs(op.opcode) == 6 else 0 for op in ops), dtype=np.int64, count=len(ops)
+    )
+    return levelize(opcode, id0, id1, cond=cond)

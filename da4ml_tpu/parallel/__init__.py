@@ -25,6 +25,19 @@ def batch_sharding(mesh: Mesh, axis_name: str = 'batch') -> NamedSharding:
     return NamedSharding(mesh, P(axis_name))
 
 
+def local_batch_sharding(axis_name: str = 'batch') -> NamedSharding | None:
+    """Sample-axis sharding over all local devices, or None on single-device
+    hosts (sharding a 1-device mesh only adds dispatch overhead).
+
+    The default upload path of ``runtime.jax_backend`` (``DaisExecutor`` /
+    ``PipelineExecutor`` ``__call__``) uses this so sample batches shard over
+    every local chip without the caller building a mesh.
+    """
+    if jax.local_device_count() <= 1:
+        return None
+    return batch_sharding(default_mesh(axis_name, jax.local_devices()))
+
+
 def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0) -> tuple[np.ndarray, int]:
     """Pad axis length up to a device-count multiple; returns (padded, n_pad)."""
     n = x.shape[axis]
@@ -52,6 +65,7 @@ from .distributed import global_mesh, initialize as initialize_distributed  # no
 __all__ = [
     'default_mesh',
     'batch_sharding',
+    'local_batch_sharding',
     'shard_batch',
     'pad_to_multiple',
     'global_mesh',

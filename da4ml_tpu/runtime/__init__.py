@@ -16,16 +16,21 @@ from .. import telemetry
 
 
 def run_comb(
-    comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: int = 0, mesh=None
+    comb, data: NDArray[np.float64], backend: str = 'auto', n_threads: int = 0, mesh=None, mode: str | None = None
 ) -> NDArray[np.float64]:
     """Execute a CombLogic over a (n_samples, n_in) batch with the given backend.
 
     ``mesh`` (jax backend only) shards the sample axis over a device mesh —
-    multi-chip batch inference through the top-level predict API.
+    multi-chip batch inference through the top-level predict API. ``mode``
+    (jax backend only) selects the device execution mode
+    (``'unroll'``/``'scan'``/``'level'``; default ``'auto'`` autotunes —
+    docs/runtime.md).
     """
     if mesh is not None and backend not in ('jax', 'auto'):
         raise ValueError(f"mesh sharding requires backend='jax', got {backend!r}")
-    if mesh is not None:
+    if mode is not None and backend not in ('jax', 'auto'):
+        raise ValueError(f"execution mode selection requires backend='jax', got {backend!r}")
+    if mesh is not None or mode is not None:
         backend = 'jax'
     binary = comb.to_binary()
     if backend == 'auto':
@@ -38,14 +43,14 @@ def run_comb(
     _metrics = telemetry.metrics_on()
     _t0 = time.perf_counter() if _metrics else 0.0
     with telemetry.span('runtime.run_comb', backend=backend, n_samples=len(data)):
-        result = _run_comb_backend(binary, data, backend, n_threads, mesh)
+        result = _run_comb_backend(binary, data, backend, n_threads, mesh, mode)
     if _metrics:
         telemetry.histogram('runtime.run_s').observe(time.perf_counter() - _t0)
         telemetry.counter('runtime.samples').inc(len(data))
     return result
 
 
-def _run_comb_backend(binary, data, backend: str, n_threads: int, mesh) -> NDArray[np.float64]:
+def _run_comb_backend(binary, data, backend: str, n_threads: int, mesh, mode: str | None = None) -> NDArray[np.float64]:
     if backend == 'numpy':
         from .numpy_backend import run_binary
 
@@ -57,7 +62,7 @@ def _run_comb_backend(binary, data, backend: str, n_threads: int, mesh) -> NDArr
     if backend == 'jax':
         from .jax_backend import run_binary
 
-        return run_binary(binary, data, mesh=mesh)
+        return run_binary(binary, data, mesh=mesh, mode=mode or 'auto')
     raise ValueError(f'Unknown backend {backend!r} (expected auto/numpy/cpp/jax)')
 
 

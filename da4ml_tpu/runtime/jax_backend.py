@@ -1,13 +1,36 @@
 """Jitted XLA executor for DAIS programs (TPU batch inference).
 
-TPU-first design: the op list is static SSA, so instead of an interpreter loop
-we emit one closed jaxpr — a Python unroll over ops at trace time — which XLA
-fuses into a single integer kernel. The float boundary (input scaling/floor,
-output rescale) stays on the host so the device program is pure fixed-point
-integer arithmetic (int32 fast path, int64 when widths demand it).
+TPU-first design: the op list is static SSA, so instead of an interpreter
+loop the executor compiles the program into one of three device kernels
+(docs/runtime.md):
 
-The throughput axis is the sample batch; shard it with
-``da4ml_tpu.parallel.shard_batch`` for multi-chip inference.
+- ``unroll`` — a closed jaxpr, one Python unroll over ops at trace time;
+  best runtime for small programs, compile time grows with program size
+  (refuses past ``UNROLL_LIMIT``);
+- ``scan`` — a ``lax.scan`` interpreter, O(1) compile but one op per step;
+- ``level`` — the ops are topologically packed into dependency levels
+  (``ir.schedule``), each level's ops grouped by opcode family and executed
+  as a handful of vectorized primitives: one operand ``take`` per input
+  leg, shift-by-multiply against precomputed pow2 vectors, fused add/sub
+  via a sign vector, vectorized wrap from per-op (width, signed) tables,
+  and one contiguous buffer update per group. Compile cost is
+  O(depth × families); runtime is vectorized over ops × samples.
+
+``mode='auto'`` is a measured autotuner: the cheap candidates are compiled,
+timed on one warm synthetic batch, and the winner is cached per program
+digest next to the persistent XLA compile cache. ``DA4ML_RUN_MODE`` forces
+a mode.
+
+The float boundary (input scaling/floor, output rescale) stays on the host
+so the device program is pure fixed-point integer arithmetic (int32 fast
+path, int64 when widths demand it; the int64 requirement is scoped to the
+executor's own traces instead of flipping ``jax_enable_x64`` process-wide).
+
+The throughput axis is the sample batch: ``__call__`` shards it over all
+local devices by default (``parallel.shard_batch`` semantics,
+``DA4ML_RUN_SHARD=0`` disables) and splits large batches into equal-shape
+chunks with overlapped H2D / compute / D2H; per-call input buffers are
+donated to XLA where the backend supports it.
 
 Bit-exactness contract: identical results to runtime.numpy_backend /
 the native C++ interpreter (reference DAISInterpreter.cc semantics).
@@ -15,60 +38,167 @@ the native C++ interpreter (reference DAISInterpreter.cc semantics).
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import time
 from collections import OrderedDict
+from contextlib import nullcontext
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from numpy.typing import NDArray
 
+from .. import telemetry
 from ..ir.dais_binary import DaisProgram, decode
+from ..ir.schedule import levelize_program
+
+#: concrete execution modes (``'auto'`` resolves to one of these)
+MODES = ('unroll', 'scan', 'level')
 
 
 def _shl(v, s: int):
     return v << s if s >= 0 else v >> (-s)
 
 
-#: batch size at which ``__call__`` switches to equal-shape chunks with
-#: overlapped H2D / compute / D2H (the remote tunnel's transfer latency is
-#: the end-to-end bottleneck; pipelining hides it behind compute)
-_CHUNK_MIN = 1 << 16
+def _x64_scope():
+    """Context enabling 64-bit jax types for the calls inside it.
+
+    Wide DAIS programs need int64 on device; flipping ``jax_enable_x64``
+    process-wide (the old behavior) invalidates every cached jit in the
+    process — including the cmvm search's — so the executor scopes the flag
+    to its own traces and calls. If the contextual API is unavailable the
+    global flag is flipped once, with a one-time telemetry warning.
+    """
+    if jax.config.read('jax_enable_x64'):
+        return nullcontext()
+    try:
+        from jax.experimental import enable_x64
+
+        return enable_x64()
+    except ImportError:  # pragma: no cover - jax without the contextual API
+        telemetry.warn_once(
+            'runtime.x64_flip',
+            'jax.experimental.enable_x64 unavailable: flipping jax_enable_x64 process-wide for a wide '
+            'DAIS program; cached jits of unrelated modules will be invalidated',
+            logger='runtime.jax',
+        )
+        jax.config.update('jax_enable_x64', True)
+        return nullcontext()
 
 
-def _infer_chunks(n: int) -> int:
-    """Chunk count for a batch (env ``DA4ML_JAX_INFER_CHUNKS`` overrides)."""
+def _maybe_scoped(fn, needs_x64: bool):
+    """Wrap a jitted callable so its traces/calls run inside the x64 scope."""
+    if not needs_x64:
+        return fn
+
+    def call(x, _fn=fn):
+        with _x64_scope():
+            return _fn(x)
+
+    return call
+
+
+def _donate_argnums() -> tuple[int, ...]:
+    """Donate per-call input buffers to XLA (device memory reuse) on
+    backends that implement donation; cpu does not and would warn on every
+    dispatch. ``DA4ML_RUN_DONATE=0`` disables."""
+    if os.environ.get('DA4ML_RUN_DONATE', '1').strip().lower() in ('0', 'off', 'false'):
+        return ()
+    try:
+        return (0,) if jax.default_backend() != 'cpu' else ()
+    except Exception:  # pragma: no cover - backend probing failed
+        return ()
+
+
+@lru_cache(maxsize=1)
+def _local_sharding():
+    """A NamedSharding over all local devices (None on single-device hosts)."""
+    try:
+        from ..parallel import local_batch_sharding
+
+        return local_batch_sharding('batch')
+    except Exception:  # pragma: no cover - exotic backend wiring
+        return None
+
+
+def _active_sharding():
+    """Default sample-axis sharding for ``__call__`` (``DA4ML_RUN_SHARD=0``
+    disables; single-device hosts get None)."""
+    if os.environ.get('DA4ML_RUN_SHARD', '1').strip().lower() in ('0', 'off', 'false'):
+        return None
+    return _local_sharding()
+
+
+#: per-chunk transfer budget for the overlapped upload/compute/fetch pipeline
+_CHUNK_BYTES_DEFAULT = 1 << 20
+_CHUNK_MAX = 16
+
+
+def _infer_chunks(n: int, row_bytes: int = 0) -> int:
+    """Chunk count for a batch, derived from batch bytes over a per-chunk
+    budget (``DA4ML_JAX_INFER_CHUNK_BYTES``, default 1 MiB, cap 16 chunks)
+    so small-row/huge-batch and wide-row/short-batch cases both pipeline
+    near the budget. ``DA4ML_JAX_INFER_CHUNKS`` forces an explicit count.
+    """
     try:
         env = int(os.environ.get('DA4ML_JAX_INFER_CHUNKS', '0') or 0)
     except ValueError:
         env = 0
     if env > 0:
         return max(1, min(env, n))
-    return 6 if n >= _CHUNK_MIN else 1
+    try:
+        budget = int(os.environ.get('DA4ML_JAX_INFER_CHUNK_BYTES', '0') or 0)
+    except ValueError:
+        budget = 0
+    if budget <= 0:
+        budget = _CHUNK_BYTES_DEFAULT
+    total = n * max(row_bytes, 1)
+    if total < 2 * budget:
+        return 1
+    return int(max(1, min(-(-total // budget), _CHUNK_MAX, n)))
 
 
-def _run_overlapped(fn, xp: NDArray, n_chunks: int) -> NDArray:
-    """Enqueue equal-shape chunks back to back — device_put, dispatch, and
-    async fetch are all non-blocking, so chunk i+1's upload rides behind
-    chunk i's compute and the downloads stream back concurrently. The last
-    chunk is padded to the common shape (one compiled program); pad rows are
-    dropped on return, so the result is bit-identical to the monolithic call.
+def _run_batch(fn, xp: NDArray, sharding=None, x64: bool = False) -> NDArray:
+    """Upload → execute → fetch one prepared integer batch.
+
+    Shards the sample axis over all local devices when ``sharding`` is given
+    (rows padded to a device-count multiple, dropped on return) and splits
+    large batches into equal-shape chunks enqueued back to back —
+    device_put, dispatch, and async fetch are all non-blocking, so chunk
+    i+1's upload rides behind chunk i's compute and the downloads stream
+    back concurrently. Bit-identical to a monolithic single-device call.
     """
     n = len(xp)
-    chunk = -(-n // n_chunks)
-    pad = chunk * n_chunks - n
+    if n == 0:
+        with _x64_scope() if x64 else nullcontext():
+            return np.asarray(jax.device_get(fn(jax.device_put(xp))))
+    row_bytes = int(xp.itemsize * int(np.prod(xp.shape[1:], dtype=np.int64))) if xp.ndim > 1 else int(xp.itemsize)
+    nc = _infer_chunks(n, row_bytes)
+    mult = int(sharding.mesh.devices.size) if sharding is not None else 1
+    chunk = -(-n // nc)
+    if mult > 1:
+        chunk = -(-chunk // mult) * mult
+    nc = max(-(-n // chunk), 1)
+    pad = chunk * nc - n
     if pad:
         xp = np.pad(xp, ((0, pad),) + ((0, 0),) * (xp.ndim - 1))
     ys = []
-    for i in range(n_chunks):
-        xc = jax.device_put(xp[i * chunk : (i + 1) * chunk])
-        yc = fn(xc)
-        try:
-            yc.copy_to_host_async()
-        except Exception:  # pragma: no cover - backends without async fetch
-            pass
-        ys.append(yc)
-    return np.concatenate([np.asarray(y) for y in ys], axis=0)[:n]
+    with _x64_scope() if x64 else nullcontext():
+        for i in range(nc):
+            xc = xp[i * chunk : (i + 1) * chunk]
+            xd = jax.device_put(xc, sharding) if sharding is not None else jax.device_put(xc)
+            yc = fn(xd)
+            try:
+                yc.copy_to_host_async()
+            except Exception:  # pragma: no cover - backends without async fetch
+                pass
+            ys.append(yc)
+        if nc == 1:
+            return np.asarray(jax.device_get(ys[0]))[:n]
+        return np.concatenate([np.asarray(y) for y in ys], axis=0)[:n]
 
 
 def _wrap_packed(raw, n_in: int, n_out: int, in_g: int, out_g: int, dtype):
@@ -94,17 +224,94 @@ def _wrap_packed(raw, n_in: int, n_out: int, in_g: int, out_g: int, dtype):
     return packed
 
 
+# ---------------------------------------------------------------------------
+# mode='auto' decision cache: in-memory per process, persisted per program
+# digest next to the PR-4 persistent XLA compile cache
+# ---------------------------------------------------------------------------
+
+_MODE_DECISIONS: dict[str, str] = {}
+
+
+def _mode_cache_dir() -> str | None:
+    """Directory for persisted autotune decisions, colocated with the
+    persistent XLA compile cache (``ensure_compile_cache``)."""
+    try:
+        from ..cmvm.jax_search import ensure_compile_cache
+
+        base = ensure_compile_cache()
+    except Exception:  # pragma: no cover - cmvm unavailable
+        base = getattr(jax.config, 'jax_compilation_cache_dir', None)
+    if not base:
+        return None
+    path = os.path.join(base, 'da4ml-run-modes')
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:  # pragma: no cover - unwritable cache dir
+        return None
+    return path
+
+
+def _load_mode_decision(digest: str) -> str | None:
+    mode = _MODE_DECISIONS.get(digest)
+    if mode:
+        return mode
+    d = _mode_cache_dir()
+    if not d:
+        return None
+    try:
+        with open(os.path.join(d, digest + '.json')) as fh:
+            mode = json.load(fh).get('mode')
+    except (OSError, ValueError):
+        return None
+    if mode in MODES:
+        _MODE_DECISIONS[digest] = mode
+        return mode
+    return None
+
+
+def _store_mode_decision(digest: str, mode: str, info: dict) -> None:
+    _MODE_DECISIONS[digest] = mode
+    d = _mode_cache_dir()
+    if not d:
+        return
+    path = os.path.join(d, digest + '.json')
+    tmp = f'{path}.tmp{os.getpid()}'
+    try:
+        with open(tmp, 'w') as fh:
+            json.dump({'mode': mode, **info}, fh)
+        os.replace(tmp, path)
+    except OSError:  # pragma: no cover - unwritable cache dir
+        pass
+
+
+def _record_call(holder, n: int, dt: float) -> None:
+    """run.* telemetry for one batch call; the first call of an executor
+    includes its compile and is recorded as ``run.compile_s``."""
+    if not holder._compile_recorded:
+        holder._compile_recorded = True
+        telemetry.histogram('run.compile_s').observe(dt)
+    if telemetry.metrics_on() and dt > 0:
+        telemetry.gauge('run.samples_per_s').set(n / dt)
+        telemetry.histogram('run.batch_s').observe(dt)
+        telemetry.counter('run.samples').inc(n)
+
+
 class DaisExecutor:
     """Compiles a DAIS program into a jitted integer XLA function.
 
     ``fn_int`` maps (batch, n_in) int → (batch, n_out) int on device;
-    ``__call__`` wraps it with the host-side float conversions.
+    ``__call__`` wraps it with the host-side float conversions, default
+    multi-device sharding, and chunked transfer overlap.
     """
 
-    #: op-count threshold above which ``mode='auto'`` switches from the fully
-    #: unrolled jaxpr (best runtime, compile time grows with program size) to
-    #: the scan interpreter (O(1) compile, one fused step body)
+    #: op-count ceiling for the fully unrolled jaxpr (compile time grows
+    #: with program size); ``mode='unroll'`` refuses bigger programs —
+    #: ``mode='level'`` compiles them in O(depth × families)
     UNROLL_LIMIT = 20_000
+
+    #: below this op count ``mode='auto'`` skips the measured autotune and
+    #: keeps the unroll heuristic (compiles are trivial and unroll wins)
+    AUTOTUNE_MIN_OPS = 1024
 
     def __init__(self, prog: DaisProgram, force_i64: bool | None = None, mode: str = 'auto'):
         prog.validate()
@@ -112,25 +319,136 @@ class DaisExecutor:
         # +2 headroom: shift_add aligns operands before the narrowing shift
         wide = prog.max_width + 2 > 31
         self.use_i64 = wide if force_i64 is None else force_i64
-        if self.use_i64 and not jax.config.read('jax_enable_x64'):
-            jax.config.update('jax_enable_x64', True)
         self.dtype = jnp.int64 if self.use_i64 else jnp.int32
-        self._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in prog.tables)
-        if mode not in ('auto', 'unroll', 'scan'):
-            raise ValueError(f"mode must be 'auto', 'unroll' or 'scan', got {mode!r}")
-        if mode == 'auto':
-            mode = 'unroll' if prog.n_ops <= self.UNROLL_LIMIT else 'scan'
-        self.mode = mode
-        raw = self._build() if mode == 'unroll' else self._build_scan()
-        self.fn_int = jax.jit(raw)
-        # packed host<->device boundary: int8/int16 lanes (by width analysis)
-        # carried in int32 words — the remote tunnel charges per byte, and
-        # narrow-int transfers are several times slower per byte than int32
-        self._in_group, self._out_group = self._pack_plan()
-        if self._in_group or self._out_group:
-            self.fn_int_packed = jax.jit(_wrap_packed(raw, prog.n_in, prog.n_out, self._in_group, self._out_group, self.dtype))
+        if mode not in ('auto', *MODES):
+            raise ValueError(f"mode must be 'auto', 'unroll', 'scan' or 'level', got {mode!r}")
+        env_mode = os.environ.get('DA4ML_RUN_MODE', '').strip().lower()
+        if mode == 'auto' and env_mode in MODES:
+            mode = env_mode
+        prejit = None
+        with self._x64():
+            self._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in prog.tables)
+            if mode == 'auto':
+                mode, prejit = self._select_mode()
+            if mode == 'unroll' and prog.n_ops > self.UNROLL_LIMIT:
+                raise ValueError(
+                    f"mode='unroll' refuses a {prog.n_ops}-op program (compile time grows with program "
+                    f"size; UNROLL_LIMIT={self.UNROLL_LIMIT}). Use mode='level'."
+                )
+            self.mode = mode
+            if prejit is not None:
+                raw, jitted = prejit
+            else:
+                raw = self._builders()[mode]()
+                jitted = jax.jit(raw)
+            self._raw = raw
+            self.fn_int = _maybe_scoped(jitted, self.use_i64)
+            # packed host<->device boundary: int8/int16 lanes (by width
+            # analysis) carried in int32 words — the remote tunnel charges
+            # per byte, and narrow-int transfers are several times slower
+            # per byte than int32
+            self._in_group, self._out_group = self._pack_plan()
+            if self._in_group or self._out_group:
+                packed = _wrap_packed(raw, prog.n_in, prog.n_out, self._in_group, self._out_group, self.dtype)
+                self.fn_int_packed = _maybe_scoped(jax.jit(packed), self.use_i64)
+            else:
+                packed = raw
+                self.fn_int_packed = self.fn_int
+            dn = _donate_argnums()
+            self._fn_call = jax.jit(packed, donate_argnums=dn) if dn else self.fn_int_packed
+        self._compile_recorded = False
+        telemetry.counter(f'run.mode.{self.mode}').inc()
+
+    # -- mode selection ----------------------------------------------------
+
+    def _x64(self):
+        return _x64_scope() if self.use_i64 else nullcontext()
+
+    def _builders(self):
+        return {'unroll': self._build, 'scan': self._build_scan, 'level': self._build_level}
+
+    def _digest(self) -> str:
+        """Program+environment digest keying the autotune decision cache."""
+        prog = self.prog
+        h = hashlib.sha1()
+        for a in (
+            prog.inp_shifts, prog.out_idxs, prog.out_shifts, prog.out_negs, prog.opcode, prog.id0,
+            prog.id1, prog.data_lo, prog.data_hi, prog.signed, prog.integers, prog.fractionals,
+        ):  # fmt: skip
+            h.update(np.ascontiguousarray(a, dtype=np.int64).tobytes())
+        for t in prog.tables:
+            h.update(np.ascontiguousarray(t, dtype=np.int64).tobytes())
+        env = f'|{prog.n_in}|{prog.n_out}|{self.use_i64}|{jax.__version__}|{jax.default_backend()}|{jax.local_device_count()}'
+        h.update(env.encode())
+        return h.hexdigest()
+
+    def _select_mode(self):
+        """Resolve ``mode='auto'``: static heuristic for small programs,
+        measured autotune (cached per program digest) otherwise.
+
+        Returns ``(mode, (raw, jitted) | None)`` — the autotuner hands back
+        the winner's already-jitted function so its compile isn't paid twice.
+        """
+        n_ops = self.prog.n_ops
+        try:
+            min_ops = int(os.environ.get('DA4ML_RUN_AUTOTUNE_MIN_OPS', '') or self.AUTOTUNE_MIN_OPS)
+        except ValueError:
+            min_ops = self.AUTOTUNE_MIN_OPS
+        if n_ops <= min(min_ops, self.UNROLL_LIMIT):
+            return 'unroll', None
+        if os.environ.get('DA4ML_RUN_AUTOTUNE', '1').strip().lower() in ('0', 'off', 'false'):
+            return ('unroll' if n_ops <= self.UNROLL_LIMIT else 'level'), None
+        digest = self._digest()
+        cached = _load_mode_decision(digest)
+        if cached is not None:
+            telemetry.counter('run.mode_cache_hit').inc()
+            return cached, None
+        return self._autotune(digest)
+
+    def _autotune(self, digest: str):
+        """Compile the cheap candidate modes, time one warm synthetic batch
+        each, pick the winner; the decision persists next to the XLA
+        compile cache keyed by the program digest."""
+        prog = self.prog
+        if prog.n_ops <= self.UNROLL_LIMIT:
+            candidates = ['level', 'unroll']
         else:
-            self.fn_int_packed = self.fn_int
+            candidates = ['level', 'scan']
+            sched = levelize_program(prog)
+            if sched.depth and prog.n_ops / sched.depth < 4:
+                # chain-shaped program: levels are nearly singletons, so the
+                # level build would degenerate into an unroll-sized jaxpr
+                candidates = ['scan']
+        try:
+            bsz = int(os.environ.get('DA4ML_RUN_AUTOTUNE_BATCH', '') or 4096)
+        except ValueError:
+            bsz = 4096
+        np_dt = np.int64 if self.use_i64 else np.int32
+        x = ((np.arange(bsz * max(prog.n_in, 1), dtype=np.int64).reshape(bsz, -1) * 2654435761) % 255 - 127).astype(np_dt)
+        info: dict[str, float] = {}
+        best = None
+        builders = self._builders()
+        with telemetry.span('run.autotune', n_ops=prog.n_ops, candidates=','.join(candidates)):
+            for m in candidates:
+                t0 = time.perf_counter()
+                raw = builders[m]()
+                jitted = jax.jit(raw)
+                jax.block_until_ready(jitted(x))
+                compile_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                jax.block_until_ready(jitted(x))
+                run_s = max(time.perf_counter() - t0, 1e-9)
+                telemetry.histogram('run.compile_s').observe(compile_s)
+                info[f'{m}_compile_s'] = round(compile_s, 6)
+                info[f'{m}_samples_per_s'] = round(bsz / run_s, 1)
+                if best is None or run_s < best[0]:
+                    best = (run_s, m, (raw, jitted))
+        _, mode, prejit = best
+        telemetry.counter('run.autotune').inc()
+        _store_mode_decision(digest, mode, info)
+        return mode, prejit
+
+    # -- kernel builders ---------------------------------------------------
 
     def _build(self):
         prog = self.prog
@@ -241,17 +559,13 @@ class DaisExecutor:
 
         return fn
 
-    def _build_scan(self):
-        """lax.scan interpreter over the op table — the compile-time fallback.
-
-        One switch-dispatched step body runs ``n_ops`` times against a dense
-        execution buffer; every per-op constant becomes a gathered array.
-        Bit-exact with the unrolled path (same semantics, traced shifts).
-        """
+    def _op_meta(self) -> dict[str, NDArray]:
+        """Gathered per-op operand metadata shared by the scan and level
+        builders (numpy, original op order; garbage where a branch ignores
+        a field)."""
         prog = self.prog
-        dtype = self.dtype
-        n_ops = prog.n_ops
         np_dt = np.int64 if self.use_i64 else np.int32
+        n_ops = prog.n_ops
 
         f_arr = prog.fractionals.astype(np_dt)
         sg_arr = prog.signed.astype(np_dt)
@@ -297,16 +611,40 @@ class DaisExecutor:
         bb_neg1 = ((dhi_arr & 2) != 0).astype(np_dt)
         bb_subop = (dhi_arr >> 24).astype(np_dt)
 
+        return {
+            'branch': branch_arr, 'neg': neg_arr, 'issub': sub_arr, 'oc': oc_arr,
+            'id0': id0_arr, 'id1': id1_arr, 'dlo': dlo_arr, 'dhi': dhi_arr,
+            'f': f_arr, 'sg': sg_arr, 'w': w_arr, 'f0': f0_arr, 'f1': f1_arr,
+            'a_shift': a_shift_arr, 'g_shift': g_shift_arr, 'const': const_arr,
+            'sgc': sgc_arr, 'wc': wc_arr, 'mux_s0': mux_s0_arr, 'mux_s1': mux_s1_arr,
+            'tab_off': tab_off_arr, 'tab_end': tab_end_arr, 'lut_zero': lut_zero_arr,
+            'mask0': mask0_arr, 'bb_neg0': bb_neg0, 'bb_neg1': bb_neg1, 'bb_subop': bb_subop,
+            'flat_tab': flat_tab,
+        }  # fmt: skip
+
+    def _build_scan(self):
+        """lax.scan interpreter over the op table — the O(1)-compile
+        fallback. One switch-dispatched step body runs ``n_ops`` times
+        against a dense execution buffer; every per-op constant becomes a
+        gathered array. Bit-exact with the unrolled path (same semantics,
+        traced shifts)."""
+        prog = self.prog
+        dtype = self.dtype
+        n_ops = prog.n_ops
+        np_dt = np.int64 if self.use_i64 else np.int32
+        m = self._op_meta()
+
         P = {
-            'branch': branch_arr, 'neg': neg_arr, 'id0': id0_arr.astype(np.int32), 'id1': id1_arr.astype(np.int32),
-            'dlo': dlo_arr.astype(np.int32), 'f': f_arr, 'sg': sg_arr, 'w': w_arr, 'f0': f0_arr, 'f1': f1_arr,
-            'a_shift': a_shift_arr, 'g_shift': g_shift_arr, 'const': const_arr, 'sgc': sgc_arr, 'wc': wc_arr,
-            'mux_s0': mux_s0_arr, 'mux_s1': mux_s1_arr, 'tab_off': tab_off_arr, 'tab_end': tab_end_arr,
-            'lut_zero': lut_zero_arr, 'mask0': mask0_arr, 'bb_neg0': bb_neg0, 'bb_neg1': bb_neg1,
-            'bb_subop': bb_subop, 'issub': sub_arr,
+            'branch': m['branch'], 'neg': m['neg'], 'id0': m['id0'].astype(np.int32), 'id1': m['id1'].astype(np.int32),
+            'dlo': m['dlo'].astype(np.int32), 'f': m['f'], 'sg': m['sg'], 'w': m['w'], 'f0': m['f0'], 'f1': m['f1'],
+            'a_shift': m['a_shift'], 'g_shift': m['g_shift'], 'const': m['const'], 'sgc': m['sgc'], 'wc': m['wc'],
+            'mux_s0': m['mux_s0'], 'mux_s1': m['mux_s1'], 'tab_off': m['tab_off'], 'tab_end': m['tab_end'],
+            'lut_zero': m['lut_zero'], 'mask0': m['mask0'], 'bb_neg0': m['bb_neg0'], 'bb_neg1': m['bb_neg1'],
+            'bb_subop': m['bb_subop'], 'issub': m['issub'],
         }  # fmt: skip
         P = {k: jnp.asarray(v) for k, v in P.items()}
-        flat_tab_d = jnp.asarray(flat_tab)
+        flat_tab_d = jnp.asarray(m['flat_tab'])
+        dhi_np = m['dhi'].astype(np_dt)
         one = jnp.asarray(1, dtype)
 
         def shl(v, s):
@@ -392,7 +730,7 @@ class DaisExecutor:
                 return buf, None
 
             Pt = dict(P)
-            Pt['dhi'] = jnp.asarray(dhi_arr.astype(np_dt))
+            Pt['dhi'] = jnp.asarray(dhi_np)
             Pt['t'] = jnp.arange(n_ops, dtype=jnp.int32)
             buf0 = jnp.zeros((n_ops, batch), dtype=dtype)
             buf, _ = jax.lax.scan(step, buf0, Pt)
@@ -408,6 +746,207 @@ class DaisExecutor:
             return jnp.stack(outs, axis=-1)
 
         return fn
+
+    def _build_level(self):
+        """Level-packed vectorized executor (``mode='level'``).
+
+        Ops are scheduled into dependency levels (``ir.schedule``), packed
+        so each (level, opcode family) group is a contiguous slice of the
+        execution buffer, and every group executes as one vectorized block:
+        operand gathers, shift-by-multiply against precomputed pow2
+        vectors, fused add/sub via a sign vector, vectorized two's-
+        complement wrap from per-op (width, signed) tables, and one
+        contiguous ``dynamic_update_slice`` per group. Compile cost is
+        O(depth × families) — independent of op count — while the runtime
+        stays vectorized over ops × samples. Bit-exact with unroll/scan.
+        """
+        prog = self.prog
+        dtype = self.dtype
+        np_dt = np.int64 if self.use_i64 else np.int32
+        n_ops = prog.n_ops
+        m = self._op_meta()
+
+        fam = m['branch'].astype(np.int64)
+        sched = levelize_program(prog, sort_key=fam)
+        order = sched.order.astype(np.int64)
+        pos = np.zeros(max(n_ops, 1), dtype=np.int64)
+        pos[order] = np.arange(n_ops, dtype=np.int64)
+
+        # contiguous (level, family) groups in packed order
+        if n_ops:
+            key = sched.level[order].astype(np.int64) * 16 + fam[order]
+            cuts = (np.flatnonzero(np.diff(key)) + 1).tolist()
+            bounds = [0, *cuts, n_ops]
+        else:
+            bounds = [0]
+
+        def pow2(s):
+            # two's-complement multiply ≡ left shift mod 2^width, so the
+            # wrapped pow2 constant is exact even at the top bit
+            return (np.int64(1) << np.asarray(s, np.int64)).astype(np_dt)
+
+        def cvec(a):
+            """(g,) per-op constant -> (g, 1) column in the execution dtype."""
+            return np.ascontiguousarray(np.asarray(a)).astype(np_dt)[:, None]
+
+        def shift_consts(s):
+            """(multiplier, right-shift) pair implementing shift-by-``s``."""
+            return cvec(pow2(np.maximum(s, 0))), cvec(np.maximum(-s, 0))
+
+        def wrap_consts(ii):
+            w = m['w'][ii].astype(np.int64)
+            sg = m['sg'][ii].astype(np.int64)
+            mod = cvec(np.int64(1) << w)
+            imin = cvec(np.where(sg != 0, -(np.int64(1) << np.maximum(w - 1, 0)), 0))
+            return mod, imin
+
+        def sign_of(flags):
+            return cvec(np.where(np.asarray(flags) != 0, -1, 1))
+
+        def safe_pos(ids):
+            return pos[np.clip(ids, 0, max(n_ops - 1, 0))]
+
+        emits = []  # (packed start row, body(buf, xT) -> (g, batch) block)
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            idxs = order[s:e]
+            fm = int(fam[idxs[0]])
+            start = int(s)
+            p0 = safe_pos(m['id0'][idxs])
+            p1 = safe_pos(m['id1'][idxs])
+            neg = sign_of(m['neg'][idxs])
+
+            if fm == 0:  # input copy + wrap
+                src = m['id0'][idxs]
+                mod, imin = wrap_consts(idxs)
+
+                def body(buf, xT, src=src, mod=mod, imin=imin):
+                    v = jnp.take(xT, src, axis=0)
+                    return ((v - imin) % mod) + imin
+
+            elif fm == 1:  # fused add/sub: sign vector + pow2 shift-by-multiply
+                a = m['a_shift'][idxs]
+                l0 = cvec(pow2(np.maximum(-a, 0)))
+                l1 = cvec(pow2(np.maximum(a, 0)))
+                gs = cvec(np.maximum(m['g_shift'][idxs], 0))
+                sub = sign_of(m['issub'][idxs])
+
+                def body(buf, xT, p0=p0, p1=p1, l0=l0, l1=l1, gs=gs, sub=sub):
+                    x0 = jnp.take(buf, p0, axis=0)
+                    x1 = jnp.take(buf, p1, axis=0)
+                    return (x0 * l0 + x1 * sub * l1) >> gs
+
+            elif fm in (2, 3):  # relu / quantize: shift, wrap, (relu: clamp)
+                sh = m['f'][idxs].astype(np.int64) - m['f0'][idxs].astype(np.int64)
+                ql, qr = shift_consts(sh)
+                mod, imin = wrap_consts(idxs)
+                relu = fm == 2
+
+                def body(buf, xT, p0=p0, neg=neg, ql=ql, qr=qr, mod=mod, imin=imin, relu=relu):
+                    v = jnp.take(buf, p0, axis=0) * neg
+                    q = ((((v * ql) >> qr) - imin) % mod) + imin
+                    return jnp.where(v < 0, jnp.zeros_like(q), q) if relu else q
+
+            elif fm == 4:  # const add
+                sh = m['f'][idxs].astype(np.int64) - m['f0'][idxs].astype(np.int64)
+                ql, qr = shift_consts(sh)
+                cst = cvec(m['const'][idxs])
+
+                def body(buf, xT, p0=p0, ql=ql, qr=qr, cst=cst):
+                    x0 = jnp.take(buf, p0, axis=0)
+                    return ((x0 * ql) >> qr) + cst
+
+            elif fm == 5:  # constant definition
+                cst = cvec(m['const'][idxs])
+
+                def body(buf, xT, cst=cst):
+                    return jnp.broadcast_to(jnp.asarray(cst), (cst.shape[0], xT.shape[1]))
+
+            elif fm == 6:  # msb mux
+                pc = safe_pos(m['dlo'][idxs])
+                sgc = cvec(m['sgc'][idxs])
+                thr = cvec(pow2(np.maximum(m['wc'][idxs].astype(np.int64) - 1, 0)))
+                l0v, r0v = shift_consts(m['mux_s0'][idxs])
+                l1v, r1v = shift_consts(m['mux_s1'][idxs])
+                mod, imin = wrap_consts(idxs)
+
+                def body(
+                    buf, xT, p0=p0, p1=p1, pc=pc, neg=neg, sgc=sgc, thr=thr,
+                    l0v=l0v, r0v=r0v, l1v=l1v, r1v=r1v, mod=mod, imin=imin,
+                ):  # fmt: skip
+                    xc = jnp.take(buf, pc, axis=0)
+                    cond = jnp.where(sgc != 0, xc < 0, xc >= thr)
+                    x0 = jnp.take(buf, p0, axis=0)
+                    v1 = jnp.take(buf, p1, axis=0) * neg
+                    r0 = ((((x0 * l0v) >> r0v) - imin) % mod) + imin
+                    r1 = ((((v1 * l1v) >> r1v) - imin) % mod) + imin
+                    return jnp.where(cond, r0, r1)
+
+            elif fm == 7:  # multiply
+
+                def body(buf, xT, p0=p0, p1=p1):
+                    return jnp.take(buf, p0, axis=0) * jnp.take(buf, p1, axis=0)
+
+            elif fm == 8:  # table lookup (flattened tables, per-op clip)
+                lz = cvec(m['lut_zero'][idxs])
+                dh = cvec(m['dhi'][idxs])
+                to = cvec(m['tab_off'][idxs])
+                te = cvec(m['tab_end'][idxs])
+                ft = m['flat_tab']
+
+                def body(buf, xT, p0=p0, lz=lz, dh=dh, to=to, te=te, ft=ft):
+                    x0 = jnp.take(buf, p0, axis=0)
+                    index = jnp.clip(x0 - lz - dh + to, to, te)
+                    return jnp.take(jnp.asarray(ft), index, mode='clip')
+
+            elif fm == 9:  # unary bitwise: not / any / all
+                mask = cvec(m['mask0'][idxs])
+                sgo = cvec(m['sg'][idxs])
+                d = m['dlo'][idxs]
+                is0 = cvec(d == 0)
+                is1 = cvec(d == 1)
+
+                def body(buf, xT, p0=p0, neg=neg, mask=mask, sgo=sgo, is0=is0, is1=is1):
+                    v = jnp.take(buf, p0, axis=0) * neg
+                    r_not = jnp.where(sgo != 0, ~v, (~v) & mask)
+                    r_any = (v != 0).astype(dtype)
+                    r_all = ((v & mask) == mask).astype(dtype)
+                    return jnp.where(is0 != 0, r_not, jnp.where(is1 != 0, r_any, r_all))
+
+            else:  # fm == 10: binary bitwise with operand alignment
+                s0 = sign_of(m['bb_neg0'][idxs])
+                s1 = sign_of(m['bb_neg1'][idxs])
+                a = m['a_shift'][idxs]
+                apos = cvec(a > 0)
+                l1v = cvec(pow2(np.maximum(a, 0)))
+                l0v = cvec(pow2(np.maximum(-a, 0)))
+                so = m['bb_subop'][idxs]
+                so0 = cvec(so == 0)
+                so1 = cvec(so == 1)
+
+                def body(buf, xT, p0=p0, p1=p1, s0=s0, s1=s1, apos=apos, l0v=l0v, l1v=l1v, so0=so0, so1=so1):
+                    v1 = jnp.take(buf, p0, axis=0) * s0
+                    v2 = jnp.take(buf, p1, axis=0) * s1
+                    v2 = jnp.where(apos != 0, v2 * l1v, v2)
+                    v1 = jnp.where(apos != 0, v1, v1 * l0v)
+                    return jnp.where(so0 != 0, v1 & v2, jnp.where(so1 != 0, v1 | v2, v1 ^ v2))
+
+            emits.append((start, body))
+
+        out_idx = prog.out_idxs.astype(np.int64)
+        pos_out = np.where(out_idx >= 0, pos[np.clip(out_idx, 0, max(n_ops - 1, 0))], 0)
+        osign = np.where(out_idx < 0, 0, np.where(prog.out_negs != 0, -1, 1)).astype(np_dt)
+
+        def fn(x):
+            xT = x.T.astype(dtype)
+            buf = jnp.zeros((max(n_ops, 1), xT.shape[1]), dtype=dtype)
+            for start, body in emits:
+                buf = jax.lax.dynamic_update_slice(buf, body(buf, xT).astype(dtype), (start, 0))
+            outs = jnp.take(buf, pos_out, axis=0) * osign[:, None]
+            return outs.T
+
+        return fn
+
+    # -- host boundary -----------------------------------------------------
 
     def _int_inputs(self, data: NDArray[np.float64]) -> NDArray:
         prog = self.prog
@@ -463,21 +1002,24 @@ class DaisExecutor:
         return np.ascontiguousarray(out).view(t)[:, : self.prog.n_out]
 
     def __call__(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
-        xp = self._pack_inputs_np(self._int_inputs(data))
-        nc = _infer_chunks(len(xp))
-        if nc <= 1:
-            raw = jax.device_get(self.fn_int_packed(xp))
-        else:
-            raw = _run_overlapped(self.fn_int_packed, xp, nc)
-        out = self._unpack_outputs_np(np.asarray(raw))
-        return out.astype(np.float64) * self._out_scale()
+        t0 = time.perf_counter()
+        with telemetry.span('run.call', mode=self.mode, n_samples=len(data)):
+            xp = self._pack_inputs_np(self._int_inputs(data))
+            raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self.use_i64)
+            out = self._unpack_outputs_np(np.asarray(raw))
+            res = out.astype(np.float64) * self._out_scale()
+        _record_call(self, len(data), time.perf_counter() - t0)
+        return res
 
     def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:
-        """Batch inference with the sample axis sharded over a device mesh."""
+        """Batch inference with the sample axis sharded over an explicit
+        device mesh (``__call__`` already shards over local devices by
+        default; this is the multi-host / custom-mesh entry point)."""
         from ..parallel import shard_batch
 
-        x, _ = shard_batch(self._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
-        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        with self._x64():
+            x, _ = shard_batch(self._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
+            out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
         return out[: len(data)] * self._out_scale()
 
 
@@ -493,6 +1035,10 @@ class PipelineExecutor:
     on the grid-aligned boundary value is exactly an arithmetic shift of the
     previous stage's output code (floor division for negative ``s``), so the
     fused path is bit-exact with the chained one.
+
+    :meth:`chained` is the per-stage alternative: each stage stays its own
+    jitted program (separate dispatches) but the integer activations remain
+    device-resident between stages and every stage donates its input buffer.
 
     Reference analog: the clocked II=1 emulation loop of the Verilator
     binder (src/da4ml/codegen/rtl/common_source/binder_util.hh:11-40 of
@@ -512,81 +1058,141 @@ class PipelineExecutor:
             for i in range(pb.n_ops):
                 if pb.opcode[i] == -1:
                     f_in[int(pb.id0[i])] = int(pb.fractionals[i])
-            shifts.append((pa.out_shifts.astype(np.int64) - f_out + pb.inp_shifts.astype(np.int64) + f_in))
+            shifts.append(pa.out_shifts.astype(np.int64) - f_out + pb.inp_shifts.astype(np.int64) + f_in)
 
         exs = self.stages
+        self._shifts = shifts
+        # boundary k shifts in the WIDER of the two boundary dtypes: widening
+        # first keeps a 32->64-bit up-shift from overflowing, and a 64->32-bit
+        # boundary must right-shift the full value BEFORE the next stage's
+        # input cast wraps it (floor then mod-2^32, matching the chained
+        # path's float floor + astype). An up-shift between two int32 stages
+        # must itself widen so it cannot wrap before the next stage's input
+        # cast does the wrapping — the executor scopes x64 as needed.
+        self._bound64 = [
+            exs[k].use_i64 or exs[k + 1].use_i64 or bool(np.any(shifts[k] > 0)) for k in range(len(shifts))
+        ]
+        self._needs_x64 = any(ex.use_i64 for ex in exs) or any(self._bound64)
+
+        def boundary(x, k):
+            wd = jnp.int64 if self._bound64[k] else jnp.int32
+            # clamp each branch's amount — both sides of the where are
+            # evaluated and negative shifts are undefined
+            s = jnp.asarray(shifts[k], dtype=wd)
+            x = x.astype(wd)
+            return jnp.where(s >= 0, x << jnp.maximum(s, 0), x >> jnp.maximum(-s, 0))
+
+        self._boundary = boundary
 
         def fn(x):
             for k, ex in enumerate(exs):
-                x = ex.fn_int(x.astype(ex.dtype))
+                x = ex._raw(x.astype(ex.dtype))
                 if k < len(shifts):
-                    # shift in the WIDER of the two boundary dtypes: widening
-                    # first keeps a 32->64-bit up-shift from overflowing, and
-                    # a 64->32-bit boundary must right-shift the full value
-                    # BEFORE the next stage's input cast wraps it (floor then
-                    # mod-2^32, matching the chained path's float floor +
-                    # astype). Clamp each branch's amount — both sides of the
-                    # where are evaluated and negative shifts are undefined.
-                    wd = exs[k].dtype if exs[k].use_i64 else exs[k + 1].dtype
-                    if wd == jnp.int32 and np.any(shifts[k] > 0) and jax.config.read('jax_enable_x64'):
-                        # an up-shift between two int32 stages must not wrap
-                        # before the next stage's input cast does the wrapping
-                        wd = jnp.int64
-                    s = jnp.asarray(shifts[k], dtype=wd)
-                    x = x.astype(wd)
-                    x = jnp.where(s >= 0, x << jnp.maximum(s, 0), x >> jnp.maximum(-s, 0))
+                    x = boundary(x, k)
             return x
 
-        self.fn_int = jax.jit(fn)
+        self.fn_int = _maybe_scoped(jax.jit(fn), self._needs_x64)
 
         # packed boundary: first stage's input plan, last stage's output plan
         first, last = exs[0], exs[-1]
         if first._in_group or last._out_group:
-            self.fn_int_packed = jax.jit(
-                _wrap_packed(fn, progs[0].n_in, progs[-1].n_out, first._in_group, last._out_group, first.dtype)
-            )
+            packed = _wrap_packed(fn, progs[0].n_in, progs[-1].n_out, first._in_group, last._out_group, first.dtype)
+            self.fn_int_packed = _maybe_scoped(jax.jit(packed), self._needs_x64)
         else:
+            packed = fn
             self.fn_int_packed = self.fn_int
+        dn = _donate_argnums()
+        self._fn_call = jax.jit(packed, donate_argnums=dn) if dn else self.fn_int_packed
+        self._chain_fns: list | None = None
+        self._compile_recorded = False
+
+    def _x64(self):
+        return _x64_scope() if self._needs_x64 else nullcontext()
 
     def __call__(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
+        t0 = time.perf_counter()
+        with telemetry.span('run.call', mode='pipeline-fused', n_samples=len(data)):
+            first, last = self.stages[0], self.stages[-1]
+            xp = first._pack_inputs_np(first._int_inputs(data))
+            raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self._needs_x64)
+            out = last._unpack_outputs_np(np.asarray(raw))
+            res = out.astype(np.float64) * last._out_scale()
+        _record_call(self, len(data), time.perf_counter() - t0)
+        return res
+
+    def chained(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
+        """Per-stage dispatch with device-resident, donated intermediates.
+
+        Unlike the fused ``__call__`` this keeps one jitted program per
+        stage (the production shape when stages are swapped independently),
+        but the integer activations never round-trip through the host and
+        each stage donates its input buffer so XLA can reuse the memory.
+        Bit-exact with the fused path and the numpy oracle.
+        """
+        if self._chain_fns is None:
+            dn = _donate_argnums()
+            fns = []
+            for k, ex in enumerate(self.stages):
+
+                def step(x, _ex=ex, _k=k):
+                    y = _ex._raw(x.astype(_ex.dtype))
+                    if _k < len(self._shifts):
+                        y = self._boundary(y, _k)
+                    return y
+
+                fns.append(jax.jit(step, donate_argnums=dn))
+            self._chain_fns = fns
+        t0 = time.perf_counter()
         first, last = self.stages[0], self.stages[-1]
-        xp = first._pack_inputs_np(first._int_inputs(data))
-        nc = _infer_chunks(len(xp))
-        if nc <= 1:
-            raw = jax.device_get(self.fn_int_packed(xp))
-        else:
-            raw = _run_overlapped(self.fn_int_packed, xp, nc)
-        out = last._unpack_outputs_np(np.asarray(raw))
-        return out.astype(np.float64) * last._out_scale()
+        with telemetry.span('run.call', mode='pipeline-chained', n_samples=len(data)):
+            x = first._int_inputs(data)
+            sharding = _active_sharding()
+            with self._x64():
+                if sharding is not None:
+                    from ..parallel import pad_to_multiple
+
+                    x, _ = pad_to_multiple(x, int(sharding.mesh.devices.size))
+                    xd = jax.device_put(x, sharding)
+                else:
+                    xd = jax.device_put(x)
+                for f in self._chain_fns:
+                    xd = f(xd)
+                out = np.asarray(jax.device_get(xd))
+            res = out[: len(data)].astype(np.float64) * last._out_scale()
+        _record_call(self, len(data), time.perf_counter() - t0)
+        return res
 
     def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:
         from ..parallel import shard_batch
 
-        x, _ = shard_batch(self.stages[0]._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
-        out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
+        with self._x64():
+            x, _ = shard_batch(self.stages[0]._int_inputs(data), mesh, axis_name or mesh.axis_names[0])
+            out = np.asarray(jax.device_get(self.fn_int(x)), dtype=np.float64)
         return out[: len(data)] * self.stages[-1]._out_scale()
 
 
-_executor_cache: OrderedDict[bytes, DaisExecutor] = OrderedDict()
+_executor_cache: OrderedDict[tuple, DaisExecutor] = OrderedDict()
 _EXECUTOR_CACHE_CAP = 256
 
 
-def executor_for_binary(binary: NDArray[np.int32]) -> DaisExecutor:
-    key = np.asarray(binary, dtype=np.int32).tobytes()
+def executor_for_binary(binary: NDArray[np.int32], mode: str = 'auto') -> DaisExecutor:
+    key = (np.asarray(binary, dtype=np.int32).tobytes(), mode, os.environ.get('DA4ML_RUN_MODE', ''))
     ex = _executor_cache.get(key)
     if ex is None:
         # LRU: long conversion sweeps touch many programs; evicting one cold
         # entry keeps the rest of the working set (and its XLA compiles) warm
         while len(_executor_cache) >= _EXECUTOR_CACHE_CAP:
             _executor_cache.popitem(last=False)
-        _executor_cache[key] = ex = DaisExecutor(decode(binary))
+        _executor_cache[key] = ex = DaisExecutor(decode(binary), mode=mode)
     else:
         _executor_cache.move_to_end(key)
     return ex
 
 
-def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64], mesh=None) -> NDArray[np.float64]:
-    ex = executor_for_binary(binary)
+def run_binary(
+    binary: NDArray[np.int32], data: NDArray[np.float64], mesh=None, mode: str = 'auto'
+) -> NDArray[np.float64]:
+    ex = executor_for_binary(binary, mode=mode)
     if mesh is not None:
         return ex.predict_sharded(data, mesh)
     return ex(data)
@@ -595,8 +1201,12 @@ def run_binary(binary: NDArray[np.int32], data: NDArray[np.float64], mesh=None) 
 _pipeline_cache: OrderedDict[bytes, PipelineExecutor] = OrderedDict()
 
 
-def run_pipeline(binaries: list[NDArray[np.int32]], data: NDArray[np.float64], mesh=None) -> NDArray[np.float64]:
-    """Fused multi-stage execution: one device program for the whole pipeline."""
+def run_pipeline(
+    binaries: list[NDArray[np.int32]], data: NDArray[np.float64], mesh=None, fused: bool = True
+) -> NDArray[np.float64]:
+    """Multi-stage execution: one fused device program for the whole
+    pipeline, or (``fused=False``) per-stage programs with device-resident
+    donated intermediates."""
     # length-prefixed segments: plain concatenation would let two different
     # stage lists with identical byte streams collide
     key = b''.join(
@@ -611,4 +1221,6 @@ def run_pipeline(binaries: list[NDArray[np.int32]], data: NDArray[np.float64], m
         _pipeline_cache.move_to_end(key)
     if mesh is not None:
         return ex.predict_sharded(data, mesh)
+    if not fused:
+        return ex.chained(data)
     return ex(data)

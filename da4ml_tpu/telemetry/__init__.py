@@ -44,7 +44,7 @@ from .export import (
     sink_for,
     validate_trace,
 )
-from .log import get_logger
+from .log import get_logger, warn_once
 from .metrics import (
     DEFAULT_BUCKETS,
     Counter,
@@ -86,6 +86,7 @@ __all__ = [
     'Histogram',
     'DEFAULT_BUCKETS',
     'get_logger',
+    'warn_once',
 ]
 
 from .core import _init_from_env

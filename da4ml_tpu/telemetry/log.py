@@ -80,6 +80,25 @@ def _configure_base() -> None:
         _configured = True
 
 
+_warned_once: set[str] = set()
+_warn_once_lock = threading.Lock()
+
+
+def warn_once(key: str, message: str, logger: str = '') -> bool:
+    """Emit ``message`` as a warning exactly once per process per ``key``.
+
+    For conditions that are worth surfacing but would otherwise repeat on a
+    hot path (e.g. a process-global config flag being flipped as a fallback).
+    Returns True when the warning was actually emitted.
+    """
+    with _warn_once_lock:
+        if key in _warned_once:
+            return False
+        _warned_once.add(key)
+    get_logger(logger).warning(message)
+    return True
+
+
 def get_logger(name: str = '') -> logging.Logger:
     """A logger under the ``da4ml_tpu`` hierarchy (``name`` may be a bare
     suffix like ``'cmvm.jax'`` or a full ``da4ml_tpu.*`` module path)."""

@@ -1,0 +1,349 @@
+"""Cross-mode runtime parity + autotuner determinism (docs/runtime.md).
+
+Randomized DAIS programs (ir.synth) covering every opcode family — LUT ops,
+negative shifts, muxes, bitwise ops, the int64 wide path, packed int8/int16
+I/O — must run bit-exactly identical through the numpy oracle and all three
+device execution modes (unroll / scan / level). Plus: the level scheduler's
+invariants, the mode autotuner's cached decision and env override, the
+bytes-adaptive chunking, and the sharded-by-default batch path (conftest
+provides the virtual 8-device CPU mesh).
+"""
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.ir.schedule import levelize_comb, levelize_program
+from da4ml_tpu.ir.synth import FAMILIES, random_inputs, random_program
+from da4ml_tpu.runtime import jax_backend as jb
+from da4ml_tpu.runtime.jax_backend import MODES, DaisExecutor
+from da4ml_tpu.runtime.numpy_backend import run_program
+
+
+def _traced_model(rng):
+    """A traced model exercising LUTs, relu, abs, and bitwise ops."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    inp = FixedVariableArrayInput((8,), hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(8), np.full(8, 4), np.full(8, 1))
+    w = rng.integers(-8, 8, (8, 5)).astype(np.float64)
+    y = np.sin(x[:4]).quantize(np.ones(4), np.ones(4), np.full(4, 6))
+    z = (x @ w).relu()
+    out = np.concatenate([z, y, abs(x[:2]), x[:2] & x[2:4]])
+    return comb_trace(inp, out)
+
+
+# ---------------------------------------------------------------------------
+# level scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_levelize_invariants():
+    rng = np.random.default_rng(5)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    sched = levelize_program(prog)
+    lvl = sched.level
+    for i in range(prog.n_ops):
+        oc = int(prog.opcode[i])
+        if oc in (-1, 5):
+            assert lvl[i] == 0
+            continue
+        assert lvl[i] > lvl[int(prog.id0[i])]
+        if oc in (0, 1, 6, -6, 7, 10):
+            assert lvl[i] > lvl[int(prog.id1[i])]
+        if abs(oc) == 6:
+            assert lvl[i] > lvl[int(prog.data_lo[i])]
+    # order is a permutation, level-sorted, with starts bounding each level
+    assert sorted(sched.order.tolist()) == list(range(prog.n_ops))
+    assert (np.diff(lvl[sched.order]) >= 0).all()
+    for level in range(sched.depth):
+        assert (lvl[sched.ops_at(level)] == level).all()
+    assert sched.starts[-1] == prog.n_ops
+    assert sched.width_max >= 1 and sched.width_mean > 0
+
+
+def test_levelize_comb_matches_program(rng):
+    from da4ml_tpu.ir.dais_binary import decode
+
+    comb = _traced_model(rng)
+    sc = levelize_comb(comb)
+    sp = levelize_program(decode(comb.to_binary()))
+    np.testing.assert_array_equal(sc.level, sp.level)
+
+
+def test_layered_program_depth():
+    rng = np.random.default_rng(2)
+    prog = random_program(rng, n_ops=2000, n_in=8, n_out=4, n_levels=10)
+    sched = levelize_program(prog)
+    assert 10 <= sched.depth <= 14  # n_levels + a little slack for muxes
+    assert sched.width_mean > 100
+
+
+# ---------------------------------------------------------------------------
+# cross-mode bit-exactness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('seed', [0, 1, 2, 3])
+def test_parity_random_programs(seed):
+    rng = np.random.default_rng(seed)
+    prog = random_program(rng, n_ops=250, n_in=6, n_out=5)
+    data = random_inputs(rng, prog, 257)  # odd: exercises shard padding
+    ref = run_program(prog, data)
+    for mode in MODES:
+        got = DaisExecutor(prog, mode=mode)(data)
+        np.testing.assert_array_equal(got, ref, err_msg=f'mode={mode} seed={seed}')
+
+
+def test_parity_covers_all_families():
+    present: set[int] = set()
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        prog = random_program(rng, n_ops=250, n_in=6, n_out=5, families=FAMILIES)
+        present |= set(np.abs(prog.opcode).tolist())
+    # input, add/sub, relu, quant, cadd, const, mux, mul, lookup, bitu, bitb
+    assert {1, 0, 2, 3, 4, 5, 6, 7, 8, 9, 10} <= present
+
+
+def test_parity_wide_i64_scoped():
+    """Wide programs run on the int64 path without flipping jax_enable_x64
+    process-wide (the old global flip invalidated unrelated cached jits)."""
+    import jax
+
+    flag_before = jax.config.read('jax_enable_x64')
+    rng = np.random.default_rng(11)
+    prog = random_program(rng, n_ops=150, n_in=4, n_out=3, wide=True)
+    data = random_inputs(rng, prog, 65)
+    ref = run_program(prog, data)
+    for mode in MODES:
+        ex = DaisExecutor(prog, mode=mode)
+        assert ex.use_i64, 'wide program must take the int64 path'
+        np.testing.assert_array_equal(ex(data), ref, err_msg=f'mode={mode}')
+    assert jax.config.read('jax_enable_x64') == flag_before
+
+
+def test_parity_traced_model_level(rng):
+    """Level mode on a real traced program (LUT via sin, relu, bit ops)."""
+    from da4ml_tpu.ir.dais_binary import decode
+
+    comb = _traced_model(rng)
+    prog = decode(comb.to_binary())
+    data = rng.uniform(-16, 16, (64, 8))
+    ref = comb.predict(data, backend='numpy')
+    for mode in MODES:
+        got = DaisExecutor(prog, mode=mode)(data)
+        np.testing.assert_array_equal(got, ref, err_msg=f'mode={mode}')
+
+
+def test_parity_packed_io_level():
+    """Packed int8/int16 host<->device lanes are bit-exact in level mode."""
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    rng = np.random.default_rng(12)
+    inp = FixedVariableArrayInput(6, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 2), np.full(6, 1))
+    w = rng.integers(-4, 4, (6, 3)).astype(np.float64)
+    comb = comb_trace(inp, (x @ w).relu(i=np.full(3, 5), f=np.full(3, 1)))
+    ex = DaisExecutor(decode(comb.to_binary()), mode='level')
+    assert ex._in_group in (2, 4) and ex._out_group in (2, 4)
+    data = rng.uniform(-4, 4, (64, 6))
+    np.testing.assert_array_equal(ex(data), comb.predict(data, backend='numpy'))
+
+
+def test_unroll_refuses_large_level_runs_it():
+    """Past UNROLL_LIMIT the unrolled jaxpr refuses; level compiles the same
+    program in O(depth × families) and matches scan and the numpy oracle."""
+    rng = np.random.default_rng(7)
+    big = random_program(rng, n_ops=20_500, n_in=16, n_out=8, n_levels=24)
+    assert big.n_ops > DaisExecutor.UNROLL_LIMIT
+    with pytest.raises(ValueError, match='unroll'):
+        DaisExecutor(big, mode='unroll')
+    data = random_inputs(rng, big, 64)
+    ref = run_program(big, data)
+    out_level = DaisExecutor(big, mode='level')(data)
+    out_scan = DaisExecutor(big, mode='scan')(data)
+    np.testing.assert_array_equal(out_level, ref)
+    np.testing.assert_array_equal(out_scan, ref)
+
+
+# ---------------------------------------------------------------------------
+# autotuner
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tuner_env(monkeypatch, tmp_path):
+    """Isolated decision cache + tiny autotune batch."""
+    import jax
+
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update('jax_compilation_cache_dir', str(tmp_path))
+    monkeypatch.setenv('DA4ML_RUN_AUTOTUNE_MIN_OPS', '0')
+    monkeypatch.setenv('DA4ML_RUN_AUTOTUNE_BATCH', '64')
+    saved = dict(jb._MODE_DECISIONS)
+    jb._MODE_DECISIONS.clear()
+    yield tmp_path
+    jb._MODE_DECISIONS.clear()
+    jb._MODE_DECISIONS.update(saved)
+    jax.config.update('jax_compilation_cache_dir', old)
+
+
+def test_autotune_decision_cached(tuner_env):
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot
+
+    enable_metrics()
+    rng = np.random.default_rng(21)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    ex1 = DaisExecutor(prog, mode='auto')
+    assert ex1.mode in MODES
+    n_tuned = metrics_snapshot().get('run.autotune', {}).get('value', 0)
+    assert n_tuned >= 1
+    files = list((tuner_env / 'da4ml-run-modes').glob('*.json'))
+    assert len(files) == 1, 'decision must persist next to the XLA cache'
+
+    # same process, memory cache cleared: the persisted decision is reused
+    jb._MODE_DECISIONS.clear()
+    ex2 = DaisExecutor(prog, mode='auto')
+    assert ex2.mode == ex1.mode
+    snap = metrics_snapshot()
+    assert snap.get('run.autotune', {}).get('value', 0) == n_tuned, 'no re-measure on cache hit'
+    assert snap.get('run.mode_cache_hit', {}).get('value', 0) >= 1
+
+
+def test_run_mode_env_forces(tuner_env, monkeypatch):
+    rng = np.random.default_rng(22)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    monkeypatch.setenv('DA4ML_RUN_MODE', 'scan')
+    ex = DaisExecutor(prog, mode='auto')
+    assert ex.mode == 'scan'
+    # explicit modes are not overridden
+    ex2 = DaisExecutor(prog, mode='level')
+    assert ex2.mode == 'level'
+
+
+def test_autotune_disabled_heuristic(tuner_env, monkeypatch):
+    monkeypatch.setenv('DA4ML_RUN_AUTOTUNE', '0')
+    rng = np.random.default_rng(23)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    assert DaisExecutor(prog, mode='auto').mode == 'unroll'
+
+
+# ---------------------------------------------------------------------------
+# batching: adaptive chunking, default sharding, donation knobs
+# ---------------------------------------------------------------------------
+
+
+def test_infer_chunks_bytes(monkeypatch):
+    monkeypatch.delenv('DA4ML_JAX_INFER_CHUNKS', raising=False)
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNK_BYTES', str(1 << 20))
+    assert jb._infer_chunks(1024, 16) == 1  # 16 KiB total: no chunking
+    assert jb._infer_chunks(1 << 18, 16) == 4  # 4 MiB / 1 MiB budget
+    assert jb._infer_chunks(1024, 1 << 16) == 16  # 64 MiB wide rows: capped
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNKS', '7')
+    assert jb._infer_chunks(1 << 18, 16) == 7  # explicit count wins
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNKS', '0')
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNK_BYTES', '256')
+    assert jb._infer_chunks(1024, 1) == 4  # 1 KiB / 256 B budget
+
+
+def test_chunked_sharded_call_bit_exact(monkeypatch):
+    """Chunking + default 8-device sharding + row padding are invisible:
+    bit-identical to the numpy oracle."""
+    rng = np.random.default_rng(31)
+    prog = random_program(rng, n_ops=200, n_in=6, n_out=4)
+    data = random_inputs(rng, prog, 1003)  # not divisible by chunks or devices
+    ref = run_program(prog, data)
+    ex = DaisExecutor(prog, mode='level')
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNKS', '7')
+    np.testing.assert_array_equal(ex(data), ref)
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNKS', '0')
+    monkeypatch.setenv('DA4ML_JAX_INFER_CHUNK_BYTES', '1024')
+    np.testing.assert_array_equal(ex(data), ref)
+    monkeypatch.setenv('DA4ML_RUN_SHARD', '0')
+    np.testing.assert_array_equal(ex(data), ref)
+
+
+def test_default_sharding_active():
+    import jax
+
+    assert jax.local_device_count() == 8, 'conftest provides the virtual mesh'
+    assert jb._active_sharding() is not None
+    assert int(jb._active_sharding().mesh.devices.size) == 8
+
+
+# ---------------------------------------------------------------------------
+# pipelines and the public entry points
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_case(rng):
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+
+    inp = FixedVariableArrayInput(8, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(8), np.full(8, 3), np.full(8, 2))
+    w1 = rng.integers(-8, 8, (8, 12)).astype(np.float64)
+    x = (x @ w1).relu(i=np.full(12, 6), f=np.full(12, 2))
+    w2 = rng.integers(-8, 8, (12, 4)).astype(np.float64)
+    comb = comb_trace(inp, x @ w2)
+    return comb, to_pipeline(comb, 3.0)
+
+
+def test_pipeline_chained_device_resident(rng):
+    """run_pipeline(fused=False): per-stage programs with device-resident
+    donated intermediates, bit-exact with the fused path and the oracle."""
+    from da4ml_tpu.runtime.jax_backend import run_pipeline
+
+    comb, pipe = _pipeline_case(rng)
+    assert len(pipe.stages) > 1
+    data = rng.uniform(-8, 8, (333, 8))
+    ref = comb.predict(data, backend='numpy')
+    chain = [s.to_binary() for s in pipe.stages]
+    np.testing.assert_array_equal(run_pipeline(chain, data), ref)
+    np.testing.assert_array_equal(run_pipeline(chain, data, fused=False), ref)
+
+
+def test_run_comb_mode_param(rng):
+    from da4ml_tpu.runtime import run_comb
+
+    comb = _traced_model(rng)
+    data = rng.uniform(-16, 16, (64, 8))
+    ref = comb.predict(data, backend='numpy')
+    np.testing.assert_array_equal(run_comb(comb, data, mode='level'), ref)
+    with pytest.raises(ValueError, match='mode'):
+        run_comb(comb, data, backend='cpp', mode='level')
+
+
+def test_run_metrics_emitted(rng):
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot
+
+    enable_metrics()
+    prog_rng = np.random.default_rng(41)
+    prog = random_program(prog_rng, n_ops=120, n_in=5, n_out=3)
+    ex = DaisExecutor(prog, mode='level')
+    ex(random_inputs(prog_rng, prog, 64))
+    snap = metrics_snapshot()
+    assert snap.get('run.mode.level', {}).get('value', 0) >= 1
+    assert 'run.samples_per_s' in snap
+    assert 'run.compile_s' in snap
+    assert snap.get('run.samples', {}).get('value', 0) >= 64
+
+
+def test_x64_warn_once_dedup():
+    from da4ml_tpu.telemetry.log import _warned_once, warn_once
+
+    key = 'test.warn_once_key'
+    _warned_once.discard(key)
+    assert warn_once(key, 'only once') is True
+    assert warn_once(key, 'only once') is False
+
+
+@pytest.mark.parametrize('env', ['0', '1'])
+def test_donate_env_knob(monkeypatch, env):
+    monkeypatch.setenv('DA4ML_RUN_DONATE', env)
+    dn = jb._donate_argnums()
+    if env == '0':
+        assert dn == ()
+    else:
+        import jax
+
+        assert dn == (() if jax.default_backend() == 'cpu' else (0,))
