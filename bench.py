@@ -727,17 +727,19 @@ def main():
         except Exception as e:
             detail['headline_fused_error'] = f'{type(e).__name__}: {e}'[:200]
 
-    print(
-        json.dumps(
-            {
-                'metric': 'cmvm_solve_throughput_16x16_int4',
-                'value': c1.get('jax_rate', 0.0),
-                'unit': 'matrices/s/chip',
-                'vs_baseline': c1.get('speedup', 0.0),
-                'detail': detail,
-            }
-        )
-    )
+    doc = {
+        'metric': 'cmvm_solve_throughput_16x16_int4',
+        'value': c1.get('jax_rate', 0.0),
+        'unit': 'matrices/s/chip',
+        'vs_baseline': c1.get('speedup', 0.0),
+        'detail': detail,
+    }
+    print(json.dumps(doc))
+    # --out: the same document as a file, the input `da4ml-tpu bench-diff`
+    # gates against a committed baseline (docs/observability.md#budgets)
+    if _OUT_PATH:
+        with open(_OUT_PATH, 'w') as fh:
+            json.dump(doc, fh)
 
 
 def _parse_cache_flags(argv: list[str]) -> list[str]:
@@ -748,6 +750,7 @@ def _parse_cache_flags(argv: list[str]) -> list[str]:
     (``--no-persistent-cache`` for a guaranteed-cold in-process compile,
     ``--cache-dir`` pointing at a shared path for cross-process warm runs).
     """
+    global _OUT_PATH
     out = []
     i = 0
     while i < len(argv):
@@ -759,10 +762,19 @@ def _parse_cache_flags(argv: list[str]) -> list[str]:
             i += 1
         elif a.startswith('--cache-dir='):
             os.environ['DA4ML_XLA_CACHE'] = a.split('=', 1)[1]
+        elif a == '--out' and i + 1 < len(argv):
+            _OUT_PATH = argv[i + 1]
+            i += 1
+        elif a.startswith('--out='):
+            _OUT_PATH = a.split('=', 1)[1]
         else:
             out.append(a)
         i += 1
     return out
+
+
+#: set by --out: also write the bench JSON document to this path
+_OUT_PATH: str | None = None
 
 
 if __name__ == '__main__':

@@ -12,7 +12,13 @@ src/da4ml/_cli/__init__.py:8-27):
   generated project directories (docs/analysis.md);
 - ``warmup`` — pre-compile the device-search shape classes;
 - ``stats`` — summarize a telemetry trace captured with ``--trace`` /
-  ``DA4ML_TRACE`` (docs/telemetry.md).
+  ``DA4ML_TRACE`` (docs/telemetry.md); ``--follow`` tails a streaming
+  JSONL trace live;
+- ``monitor`` — serve the live ``/metrics`` / ``/healthz`` / ``/statusz``
+  endpoints, optionally mirroring a followed trace
+  (docs/observability.md);
+- ``bench-diff`` — gate a BENCH/metrics snapshot against a baseline under
+  per-metric tolerance budgets (exit 1 on regression).
 """
 
 from __future__ import annotations
@@ -53,6 +59,18 @@ def main(argv: list[str] | None = None) -> int:
     p_stats = sub.add_parser('stats', help='Summarize a telemetry trace captured with --trace / DA4ML_TRACE')
     add_stats_args(p_stats)
     p_stats.set_defaults(func=stats_main)
+
+    from .monitor import add_monitor_args, monitor_main
+
+    p_mon = sub.add_parser('monitor', help='Serve the live /metrics /healthz /statusz observability endpoints')
+    add_monitor_args(p_mon)
+    p_mon.set_defaults(func=monitor_main)
+
+    from ..telemetry.obs.bench_diff import add_bench_diff_args, bench_diff_main
+
+    p_bd = sub.add_parser('bench-diff', help='Gate a BENCH/metrics snapshot against a baseline under tolerance budgets')
+    add_bench_diff_args(p_bd)
+    p_bd.set_defaults(func=bench_diff_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import time
 from pathlib import Path
 
 
@@ -87,9 +88,40 @@ def render_summary(summary: dict, metrics: dict, top: int = 0) -> str:
     return '\n'.join(lines)
 
 
+def _follow(args: argparse.Namespace) -> int:
+    """Tail a streaming JSONL trace: incrementally absorb new events and
+    re-render the summary every ``--interval`` seconds, so a long campaign
+    can be watched live without the HTTP endpoint. Stops after
+    ``--max-updates`` renders (0 = until Ctrl-C / EOF of a finished trace)."""
+    from ..telemetry import get_logger
+    from ..telemetry.obs.tailer import TraceTailer
+
+    path = Path(args.trace)
+    if path.suffix != '.jsonl':
+        get_logger('cli.stats').warning(f'--follow expects a streaming .jsonl trace, got {path}')
+        return 1
+    tailer = TraceTailer(path)
+    updates = 0
+    try:
+        while True:
+            n_new = tailer.poll()
+            if n_new or updates == 0:
+                updates += 1
+                summary = summarize_events(tailer.events)
+                print(f'--- update {updates}: {path} +{n_new} events ({len(tailer.events)} total) ---')
+                print(render_summary(summary, tailer.metrics, top=args.top))
+            if args.max_updates and updates >= args.max_updates:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def stats_main(args: argparse.Namespace) -> int:
     from ..telemetry import load_trace, validate_trace
 
+    if args.follow:
+        return _follow(args)
     path = Path(args.trace)
     if not path.is_file():
         from ..telemetry import get_logger
@@ -115,3 +147,8 @@ def add_stats_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         '--validate', action='store_true', help='Additionally check every event against the Chrome trace-event schema'
     )
+    parser.add_argument(
+        '--follow', action='store_true', help='Tail a growing .jsonl trace, re-rendering the summary as events stream in'
+    )
+    parser.add_argument('--interval', type=float, default=2.0, help='--follow: poll interval in seconds')
+    parser.add_argument('--max-updates', type=int, default=0, help='--follow: stop after N renders (0 = until Ctrl-C)')

@@ -365,7 +365,8 @@ def solve(
         if _metrics:
             telemetry.counter('solve.calls').inc()
             telemetry.histogram('solve.duration_s').observe(time.perf_counter() - _t0)
-            telemetry.histogram('solve.adders').observe(float(result.cost))
+            # adder counts are 1..1e6-scale: the count ladder, not seconds
+            telemetry.histogram('solve.adders', telemetry.COUNT_BUCKETS).observe(float(result.cost))
         if _sp:
             _sp.set(cost=float(result.cost))
         return result

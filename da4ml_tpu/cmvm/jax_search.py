@@ -50,6 +50,7 @@ from numpy.typing import NDArray
 from .. import telemetry
 from ..ir.comb import CombLogic, Pipeline
 from ..ir.types import QInterval
+from ..telemetry.obs import profile as _prof
 from .core import to_solution
 from .csd import csd_decompose
 from .state import DAState, Op, encode_digit
@@ -1461,7 +1462,8 @@ def solve_single_lanes(
                         # only needed for lanes that resume at a larger P
                         # (finished lanes' metadata is re-derived on host in
                         # f64 from the records) — a second fetch only then.
-                        h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                        with _prof.annotate('cmvm.rung.fetch'):
+                            h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
                     except Exception as e:
                         if select != 'fused':
                             raise
@@ -1491,6 +1493,17 @@ def solve_single_lanes(
                             else:
                                 telemetry.histogram('jit.execute_s').observe(_dt)
                             telemetry.counter('cse.device_rounds').inc()
+                            # per-rung device wall clock (dispatch->fetch) and
+                            # the device-resident footprint of the chunk — the
+                            # cost-model training signal (docs/observability.md)
+                            telemetry.histogram('sched.device_s').observe(_dt)
+                            try:
+                                _nb = sum(int(getattr(v, 'nbytes', 0)) for v in args)
+                                _nb += sum(int(getattr(v, 'nbytes', 0)) for v in outs)
+                            except Exception:
+                                _nb = 0
+                            if _nb:
+                                telemetry.histogram('sched.hbm_bytes', telemetry.BYTES_BUCKETS).observe(_nb)
                         if debug:
                             _logger.info(
                                 f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
@@ -1564,7 +1577,8 @@ def solve_single_lanes(
                     run = fn if sh is not None else _class_runner(spec, bucket, fn, args)
                     t0 = time.perf_counter() if _timed else 0.0
                     try:
-                        outs = run(*args)
+                        with _prof.annotate('cmvm.rung.dispatch'):
+                            outs = run(*args)
                     except Exception as e:
                         if select != 'fused':
                             raise

@@ -60,6 +60,25 @@ def shard_batch(x: np.ndarray, mesh: Mesh | None = None, axis_name: str = 'batch
     return jax.device_put(x, batch_sharding(mesh, axis_name)), n_pad
 
 
+def device_inventory() -> dict:
+    """Local device/process topology as a JSON-able dict — the ``/statusz``
+    ``devices`` section (docs/observability.md). Callers must only invoke
+    this when jax is already initialized: it touches the backend."""
+    devices = jax.local_devices()
+    try:
+        process_count = jax.process_count()
+    except Exception:
+        process_count = 1
+    return {
+        'backend': jax.default_backend(),
+        'process_count': process_count,
+        'local_device_count': len(devices),
+        'local_devices': [
+            {'id': d.id, 'platform': d.platform, 'kind': getattr(d, 'device_kind', '?')} for d in devices
+        ],
+    }
+
+
 from .distributed import global_mesh, initialize as initialize_distributed  # noqa: E402
 
 __all__ = [
@@ -70,4 +89,5 @@ __all__ = [
     'pad_to_multiple',
     'global_mesh',
     'initialize_distributed',
+    'device_inventory',
 ]

@@ -96,6 +96,14 @@ def breaker_for(name: str, fail_threshold: int = 3, reset_after: float = 30.0) -
         return br
 
 
+def breaker_states() -> dict[str, str]:
+    """Current state of every registered breaker — the ``/healthz`` feed
+    (docs/observability.md)."""
+    with _registry_lock:
+        breakers = list(_registry.values())
+    return {br.name: br.state for br in breakers}
+
+
 def reset_all_breakers() -> None:
     """Forget all breaker state (test isolation)."""
     with _registry_lock:

@@ -318,6 +318,10 @@ def solve_many(
         store = checkpoint if isinstance(checkpoint, CheckpointStore) else store_for(checkpoint)
     kernels = list(kernels)
     telemetry.gauge('campaign.total').set(len(kernels))
+    # first beat at campaign start: a worker that stalls on kernel 0 must
+    # still age out on /healthz (docs/observability.md)
+    telemetry.beat('campaign')
+    telemetry.gauge('campaign.heartbeat_age_s').set(0.0)
     results = []
     with telemetry.span('reliability.solve_many', n_kernels=len(kernels), backend=backend):
         for i, kern in enumerate(kernels):
@@ -332,9 +336,12 @@ def solve_many(
                     checkpoint=store,
                 )
             )
-            # campaign progress heartbeat: visible live in a JSONL trace tail
-            # and as a counter track in Perfetto
+            # campaign progress heartbeat: visible live in a JSONL trace tail,
+            # as a counter track in Perfetto, and as the /healthz liveness
+            # signal (campaign.heartbeat_age_s re-ages at every scrape)
             telemetry.gauge('campaign.done').set(i + 1)
+            telemetry.beat('campaign')
+            telemetry.gauge('campaign.heartbeat_age_s').set(0.0)
             telemetry.instant(
                 'campaign.progress', done=i + 1, total=len(kernels), checkpoint_hits=report.checkpoint_hits
             )

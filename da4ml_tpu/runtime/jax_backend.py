@@ -54,6 +54,7 @@ from numpy.typing import NDArray
 from .. import telemetry
 from ..ir.dais_binary import DaisProgram, decode
 from ..ir.schedule import levelize_program
+from ..telemetry.obs import profile as _prof
 
 #: concrete execution modes (``'auto'`` resolves to one of these)
 MODES = ('unroll', 'scan', 'level')
@@ -232,6 +233,12 @@ def _wrap_packed(raw, n_in: int, n_out: int, in_g: int, out_g: int, dtype):
 _MODE_DECISIONS: dict[str, str] = {}
 
 
+def mode_decisions() -> dict[str, str]:
+    """In-process autotune decisions (program digest -> mode), as shown by
+    the ``/statusz`` endpoint (docs/observability.md)."""
+    return dict(_MODE_DECISIONS)
+
+
 def _mode_cache_dir() -> str | None:
     """Directory for persisted autotune decisions, colocated with the
     persistent XLA compile cache (``ensure_compile_cache``)."""
@@ -284,7 +291,7 @@ def _store_mode_decision(digest: str, mode: str, info: dict) -> None:
         pass
 
 
-def _record_call(holder, n: int, dt: float) -> None:
+def _record_call(holder, n: int, dt: float, nbytes: int = 0) -> None:
     """run.* telemetry for one batch call; the first call of an executor
     includes its compile and is recorded as ``run.compile_s``."""
     if not holder._compile_recorded:
@@ -293,6 +300,13 @@ def _record_call(holder, n: int, dt: float) -> None:
     if telemetry.metrics_on() and dt > 0:
         telemetry.gauge('run.samples_per_s').set(n / dt)
         telemetry.histogram('run.batch_s').observe(dt)
+        # device wall clock + batch sample/byte sizes on the count/bytes
+        # bucket ladders (docs/observability.md): the per-rung timing signal
+        # the learned-cost-model direction consumes
+        telemetry.histogram('run.device_s').observe(dt)
+        telemetry.histogram('run.batch_samples', telemetry.COUNT_BUCKETS).observe(n)
+        if nbytes:
+            telemetry.histogram('run.hbm_bytes', telemetry.BYTES_BUCKETS).observe(nbytes)
         telemetry.counter('run.samples').inc(n)
 
 
@@ -1003,12 +1017,13 @@ class DaisExecutor:
 
     def __call__(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
         t0 = time.perf_counter()
-        with telemetry.span('run.call', mode=self.mode, n_samples=len(data)):
+        with telemetry.span('run.call', mode=self.mode, n_samples=len(data)) as sp:
             xp = self._pack_inputs_np(self._int_inputs(data))
-            raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self.use_i64)
+            with _prof.annotate('run.call', sp.span_id):
+                raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self.use_i64)
             out = self._unpack_outputs_np(np.asarray(raw))
             res = out.astype(np.float64) * self._out_scale()
-        _record_call(self, len(data), time.perf_counter() - t0)
+        _record_call(self, len(data), time.perf_counter() - t0, nbytes=xp.nbytes + out.nbytes)
         return res
 
     def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:
@@ -1111,13 +1126,14 @@ class PipelineExecutor:
 
     def __call__(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
         t0 = time.perf_counter()
-        with telemetry.span('run.call', mode='pipeline-fused', n_samples=len(data)):
+        with telemetry.span('run.call', mode='pipeline-fused', n_samples=len(data)) as sp:
             first, last = self.stages[0], self.stages[-1]
             xp = first._pack_inputs_np(first._int_inputs(data))
-            raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self._needs_x64)
+            with _prof.annotate('run.call', sp.span_id):
+                raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self._needs_x64)
             out = last._unpack_outputs_np(np.asarray(raw))
             res = out.astype(np.float64) * last._out_scale()
-        _record_call(self, len(data), time.perf_counter() - t0)
+        _record_call(self, len(data), time.perf_counter() - t0, nbytes=xp.nbytes + out.nbytes)
         return res
 
     def chained(self, data: NDArray[np.float64]) -> NDArray[np.float64]:
@@ -1144,10 +1160,10 @@ class PipelineExecutor:
             self._chain_fns = fns
         t0 = time.perf_counter()
         first, last = self.stages[0], self.stages[-1]
-        with telemetry.span('run.call', mode='pipeline-chained', n_samples=len(data)):
+        with telemetry.span('run.call', mode='pipeline-chained', n_samples=len(data)) as sp:
             x = first._int_inputs(data)
             sharding = _active_sharding()
-            with self._x64():
+            with self._x64(), _prof.annotate('run.call', sp.span_id):
                 if sharding is not None:
                     from ..parallel import pad_to_multiple
 
@@ -1159,7 +1175,7 @@ class PipelineExecutor:
                     xd = f(xd)
                 out = np.asarray(jax.device_get(xd))
             res = out[: len(data)].astype(np.float64) * last._out_scale()
-        _record_call(self, len(data), time.perf_counter() - t0)
+        _record_call(self, len(data), time.perf_counter() - t0, nbytes=x.nbytes + out.nbytes)
         return res
 
     def predict_sharded(self, data: NDArray[np.float64], mesh, axis_name: str | None = None) -> NDArray[np.float64]:

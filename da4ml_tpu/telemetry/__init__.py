@@ -26,8 +26,12 @@ Instrumentation API (all safe to call when disabled)::
 
 from .core import (
     Span,
+    active_spans,
     add_sink,
+    beat,
+    beat_age_s,
     collect_phases,
+    current_span,
     disable,
     enable,
     instant,
@@ -46,6 +50,8 @@ from .export import (
 )
 from .log import get_logger, warn_once
 from .metrics import (
+    BYTES_BUCKETS,
+    COUNT_BUCKETS,
     DEFAULT_BUCKETS,
     Counter,
     Gauge,
@@ -58,11 +64,27 @@ from .metrics import (
     timer,
 )
 
+def serve(port: int | None = None, host: str = '127.0.0.1'):
+    """Start the live observability endpoint (``/metrics`` OpenMetrics,
+    ``/healthz``, ``/statusz``) on a daemon thread and return the server
+    (docs/observability.md). Idempotent; also reachable via
+    ``DA4ML_METRICS_PORT=<port>`` or ``da4ml-tpu monitor``. Enables the
+    metrics registry so scrapes see data."""
+    from .obs.server import serve as _serve
+
+    return _serve(port=port, host=host)
+
+
 __all__ = [
     'Span',
     'span',
     'instant',
     'collect_phases',
+    'current_span',
+    'active_spans',
+    'beat',
+    'beat_age_s',
+    'serve',
     'enable',
     'disable',
     'reset',
@@ -85,6 +107,8 @@ __all__ = [
     'Gauge',
     'Histogram',
     'DEFAULT_BUCKETS',
+    'COUNT_BUCKETS',
+    'BYTES_BUCKETS',
     'get_logger',
     'warn_once',
 ]

@@ -39,20 +39,69 @@ def _now_us() -> float:
 
 
 class _State:
-    __slots__ = ('sinks', 'collectors', 'active', 'lock')
+    __slots__ = ('sinks', 'collectors', 'watchers', 'active', 'lock')
 
     def __init__(self):
         self.sinks: tuple = ()  # immutable tuple -> lock-free reads on the hot path
         self.collectors = 0  # process-wide count of open collect_phases() blocks
-        self.active = False  # sinks or collectors present
+        self.watchers = 0  # live span observers (the /statusz endpoint)
+        self.active = False  # sinks, collectors, or watchers present
         self.lock = threading.Lock()
 
     def refresh(self) -> None:
-        self.active = bool(self.sinks) or self.collectors > 0
+        self.active = bool(self.sinks) or self.collectors > 0 or self.watchers > 0
 
 
 _state = _State()
 _tls = threading.local()
+
+#: spans currently open anywhere in the process (span_id -> Span); only
+#: populated while telemetry is active (disabled spans are the shared no-op
+#: singleton and never registered). /statusz renders this live.
+_active_spans: dict[int, 'Span'] = {}
+
+#: liveness heartbeats: name -> last-beat monotonic clock. Written by
+#: long-running drivers (solve_many campaigns), read by the /healthz
+#: endpoint to detect stalled workers. Plain dict ops are atomic under the
+#: GIL; no lock needed.
+_heartbeats: dict[str, float] = {}
+
+
+def beat(name: str) -> None:
+    """Record a liveness heartbeat for ``name`` (monotonic clock). Unlike
+    metrics this is always on — it is one dict store, and health checks
+    must work even when the metrics registry is disabled."""
+    _heartbeats[name] = time.monotonic()
+
+
+def beat_age_s(name: str) -> float | None:
+    """Seconds since the last :func:`beat` for ``name``, or None if never."""
+    t = _heartbeats.get(name)
+    return None if t is None else time.monotonic() - t
+
+
+def current_span() -> 'Span | None':
+    """The innermost open span of the calling thread, or None."""
+    st = getattr(_tls, 'stack', None)
+    return st[-1] if st else None
+
+
+def active_spans() -> list[dict]:
+    """Snapshot of every span currently open in the process (any thread),
+    oldest first: ``{span_id, parent_id, name, age_s, attrs}``."""
+    now = time.perf_counter()
+    out = []
+    for sp in sorted(_active_spans.values(), key=lambda s: s.t0):
+        out.append(
+            {
+                'span_id': sp.span_id,
+                'parent_id': sp.parent_id,
+                'name': sp.name,
+                'age_s': round(now - sp.t0, 6) if sp.t0 else 0.0,
+                'attrs': {k: v for k, v in sp.attrs.items()},
+            }
+        )
+    return out
 
 
 def _stack() -> list:
@@ -109,10 +158,12 @@ class Span:
         st.append(self)
         self.t0 = time.perf_counter()
         self.ts_us = (self.t0 - _T0) * 1e6
+        _active_spans[self.span_id] = self
         return self
 
     def __exit__(self, exc_type, exc, tb):
         self.duration_s = time.perf_counter() - self.t0
+        _active_spans.pop(self.span_id, None)
         st = _stack()
         if st and st[-1] is self:
             st.pop()
@@ -244,6 +295,22 @@ def tracing_active() -> bool:
     return bool(_state.sinks)
 
 
+def add_span_watcher() -> None:
+    """Arm real (registered) spans without a trace sink, so ``active_spans``
+    reflects live work — held by the /statusz endpoint for its lifetime.
+    Spans still emit nothing; the only cost over the no-op path is the
+    per-span object and stack bookkeeping."""
+    with _state.lock:
+        _state.watchers += 1
+        _state.refresh()
+
+
+def remove_span_watcher() -> None:
+    with _state.lock:
+        _state.watchers = max(0, _state.watchers - 1)
+        _state.refresh()
+
+
 def enable(path: 'str | os.PathLike | None' = None, metrics: bool = True):
     """Turn telemetry on: enable the metrics registry and (optionally) open a
     trace sink at ``path`` (``.jsonl`` → JSONL event log, anything else →
@@ -287,9 +354,23 @@ def reset() -> None:
     from .metrics import reset_metrics
 
     reset_metrics()
+    _heartbeats.clear()
+    _active_spans.clear()
 
 
 def _init_from_env() -> None:
     path = os.environ.get('DA4ML_TRACE')
     if path:
         enable(path)
+    port = os.environ.get('DA4ML_METRICS_PORT')
+    if port:
+        # opt-in live endpoint; a bad port value or bind failure must never
+        # break the instrumented process at import time
+        try:
+            from .obs.server import serve
+
+            serve(port=int(port))
+        except Exception as e:
+            from .log import get_logger
+
+            get_logger('telemetry.obs').warning(f'DA4ML_METRICS_PORT={port!r}: could not start endpoint: {e}')

@@ -35,6 +35,18 @@ DEFAULT_BUCKETS: tuple[float, ...] = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
 )  # fmt: skip
 
+#: count-valued histograms (adder counts, batch sizes, substitutions):
+#: 1 .. 1M in a 1/2.5/5 ladder — the seconds buckets put every such sample
+#: in +Inf, which made the distributions invisible
+COUNT_BUCKETS: tuple[float, ...] = (
+    1, 2, 5, 10, 25, 50, 100, 250, 500, 1_000, 2_500, 5_000,
+    10_000, 25_000, 50_000, 100_000, 250_000, 500_000, 1_000_000,
+)  # fmt: skip
+
+#: byte-valued histograms (transfer sizes, HBM-resident estimates):
+#: 1KiB .. 16GiB in powers of four
+BYTES_BUCKETS: tuple[float, ...] = tuple(float(1024 * 4**k) for k in range(13))
+
 _registry: dict[str, 'Counter | Gauge | Histogram'] = {}
 _lock = threading.Lock()
 _enabled = False

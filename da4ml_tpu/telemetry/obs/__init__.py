@@ -1,0 +1,43 @@
+"""Live observability plane (docs/observability.md).
+
+PR-3 telemetry is post-hoc: spans and metrics land in a trace file read
+after the process exits. This package adds *live* introspection of a
+running process on three legs:
+
+- **Exposition** — :mod:`.openmetrics` renders the metrics registry in
+  OpenMetrics/Prometheus text format; :mod:`.server` serves it over a
+  stdlib ``http.server`` endpoint (``/metrics``, ``/healthz``,
+  ``/statusz``) started via ``telemetry.serve(port)``,
+  ``DA4ML_METRICS_PORT``, or ``da4ml-tpu monitor``. Off by default and
+  fork-safe like the rest of telemetry.
+- **Device-profile correlation** — :mod:`.profile` arms
+  ``jax.profiler`` around the CMVM device rungs and runtime batch calls
+  when ``DA4ML_PROFILE=<dir>`` is set, tagging XLA device events with the
+  owning telemetry span id.
+- **Regression gates** — :mod:`.bench_diff` compares BENCH/metrics
+  snapshots against per-metric tolerance budgets
+  (``da4ml-tpu bench-diff A.json B.json [--budget budgets.toml]``).
+
+Everything here imports lazily from ``da4ml_tpu.telemetry`` — importing
+the telemetry package never pulls in the HTTP server or jax.
+"""
+
+from .bench_diff import diff_metrics, load_bench_metrics, load_budgets
+from .health import health_snapshot, status_snapshot
+from .openmetrics import render_openmetrics, validate_openmetrics
+from .server import serve, server_port, stop_server
+from .tailer import TraceTailer
+
+__all__ = [
+    'render_openmetrics',
+    'validate_openmetrics',
+    'health_snapshot',
+    'status_snapshot',
+    'serve',
+    'server_port',
+    'stop_server',
+    'TraceTailer',
+    'load_bench_metrics',
+    'load_budgets',
+    'diff_metrics',
+]

@@ -1,0 +1,412 @@
+"""Perf-regression gates over BENCH/metrics snapshots.
+
+``da4ml-tpu bench-diff A.json B.json [--budget budgets.toml]`` flattens
+two snapshots into ``dotted.metric -> float`` maps, compares the
+intersection under per-metric tolerance budgets, and exits nonzero on any
+regression — so the perf claims committed in the ``BENCH_r0*.json``
+trajectory stop being unguarded prose.
+
+Accepted snapshot shapes (auto-detected):
+
+- ``bench.py`` output: ``{"metric", "value", "detail": {"configs": [...],
+  ...}}`` — configs flatten as ``configs.<name>.<key>``;
+- the driver-wrapped capture committed as ``BENCH_r0*.json``
+  (``{"n", "cmd", "rc", "tail", "parsed"}``): ``parsed`` when present,
+  otherwise metrics are **recovered from the truncated stdout tail** by
+  scanning for balanced JSON objects (config entries, named sections) and
+  trailing top-level scalars;
+- a ``telemetry.metrics_snapshot()`` dict (counters/gauges flatten to
+  their value, histograms to ``.mean`` / ``.count``).
+
+Budget semantics (docs/observability.md#budgets): metrics are classified
+by name — *exactness* (``exact``, ``bit_exact``: may never drop), *cost*
+(``*cost*``: lower-better, default +2% ceiling), *rate* (``*_rate``,
+``*_per_s``, ``speedup*``, the headline ``value``: higher-better, default
+-50% floor — wide because committed rounds span different machines; CI
+budgets tighten it). Wall-clock/compile-time metrics are reported but
+never gate by default (machine-dependent noise); a budgets file can add
+rules for them. TOML budgets override defaults per metric name or
+``fnmatch`` pattern.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import re
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# snapshot loading / flattening
+# ---------------------------------------------------------------------------
+
+_NUM = (int, float)
+
+
+def _flatten(obj, prefix: str, out: dict[str, float]) -> None:
+    if isinstance(obj, bool):
+        out[prefix] = 1.0 if obj else 0.0
+        return
+    if isinstance(obj, _NUM):
+        out[prefix] = float(obj)
+        return
+    if isinstance(obj, str):
+        m = re.fullmatch(r'(\d+)\s*/\s*(\d+)', obj)  # "16/16" exactness ratios
+        if m and int(m.group(2)):
+            out[prefix] = int(m.group(1)) / int(m.group(2))
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(v, f'{prefix}.{k}' if prefix else str(k), out)
+        return
+    if isinstance(obj, list):
+        for i, v in enumerate(obj):
+            key = f'{prefix}.{i}'
+            if isinstance(v, dict) and isinstance(v.get('config'), str):
+                key = f'{prefix}.{v["config"]}'
+            _flatten(v, key, out)
+
+
+def flatten_bench(doc: dict) -> dict[str, float]:
+    """One parsed snapshot document -> flat ``dotted.metric: float`` map."""
+    out: dict[str, float] = {}
+    if 'traceEvents' in doc:  # a Chrome trace: use its embedded metrics
+        doc = doc.get('otherData', {}).get('metrics', {})
+    if _looks_like_metrics_snapshot(doc):
+        for name, m in doc.items():
+            kind = m.get('type')
+            if kind in ('counter', 'gauge'):
+                out[name] = float(m.get('value', 0.0))
+            elif kind == 'histogram':
+                out[f'{name}.count'] = float(m.get('count', 0))
+                if m.get('count'):
+                    out[f'{name}.mean'] = float(m.get('mean', m.get('sum', 0.0) / m['count']))
+        return out
+    detail = doc.get('detail') if isinstance(doc.get('detail'), dict) else None
+    if detail is not None:
+        for k, v in doc.items():
+            if k != 'detail' and isinstance(v, _NUM):
+                out[k] = float(v)
+        _flatten_detail(detail, out)
+        return out
+    _flatten_detail(doc, out)
+    return out
+
+
+def _flatten_detail(detail: dict, out: dict[str, float]) -> None:
+    skip = {'last_known_tpu', 'config1_top4'}  # prior-round attachments, not this run
+    for k, v in detail.items():
+        if k in skip:
+            continue
+        _flatten(v, k, out)
+
+
+def _looks_like_metrics_snapshot(doc: dict) -> bool:
+    if not doc:
+        return False
+    vals = list(doc.values())
+    return all(isinstance(v, dict) and v.get('type') in ('counter', 'gauge', 'histogram') for v in vals)
+
+
+def _scan_tail(tail: str) -> dict[str, float]:
+    """Recover metrics from a *truncated* bench stdout tail.
+
+    The committed ``BENCH_r0*.json`` captures hold only the last N bytes
+    of the bench JSON line — unparsable as a document. Balanced JSON
+    objects are still recoverable: config entries (``{"config": ...}``),
+    named sections (``"quality_sweep": {...}``), and any top-level scalars
+    after the last recovered object."""
+    dec = json.JSONDecoder()
+    out: dict[str, float] = {}
+    pos = 0
+    last_end = 0
+    while True:
+        b = tail.find('{', pos)
+        if b < 0:
+            break
+        try:
+            obj, end = dec.raw_decode(tail, b)
+        except ValueError:
+            pos = b + 1
+            continue
+        if isinstance(obj, dict) and obj:
+            if isinstance(obj.get('config'), str):
+                _flatten(obj, f'configs.{obj["config"]}', out)
+            else:
+                # name the object from the `"key": ` immediately before it
+                m = re.search(r'"([A-Za-z0-9_.-]+)"\s*:\s*$', tail[:b])
+                if m:
+                    _flatten(obj, m.group(1), out)
+            last_end = max(last_end, end)
+        pos = end if end > b else b + 1
+    # trailing top-level scalars, e.g. `"full_model_cold_over_warm": 5.63}}`
+    for m in re.finditer(r'"([A-Za-z0-9_.-]+)"\s*:\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)', tail[last_end:]):
+        out.setdefault(m.group(1), float(m.group(2)))
+    return out
+
+
+def load_bench_metrics(path: 'str | Path') -> dict[str, float]:
+    """Load any accepted snapshot file into a flat metric map."""
+    text = Path(path).read_text()
+    doc = json.loads(text)
+    if not isinstance(doc, dict):
+        raise ValueError(f'{path}: expected a JSON object, got {type(doc).__name__}')
+    if 'tail' in doc and 'cmd' in doc:  # driver-wrapped BENCH_r0*.json capture
+        parsed = doc.get('parsed')
+        if isinstance(parsed, dict):
+            return flatten_bench(parsed)
+        tail = doc.get('tail') or ''
+        try:  # the tail may happen to be the complete JSON line
+            inner = json.loads(tail)
+            if isinstance(inner, dict):
+                return flatten_bench(inner)
+        except ValueError:
+            pass
+        return _scan_tail(tail)
+    return flatten_bench(doc)
+
+
+# ---------------------------------------------------------------------------
+# budgets
+# ---------------------------------------------------------------------------
+
+#: built-in tolerances; a budgets file overrides any of these per pattern
+DEFAULT_BUDGET = {
+    'rate_drop_pct': 50.0,  # higher-better metrics may drop this much
+    'cost_rise_pct': 2.0,  # lower-better quality metrics may rise this much
+    'exact_drop': 0.0,  # exactness ratios may never drop
+}
+
+_EXACT_LAST = ('exact', 'bit_exact', 'pipeline_bit_exact')
+_RATE_SUFFIX = ('_rate', '_per_s', '_throughput')
+
+
+def classify_metric(name: str) -> str:
+    """'exact' | 'cost' | 'rate' | 'info' from the dotted metric name."""
+    last = name.rsplit('.', 1)[-1]
+    if last in _EXACT_LAST:
+        return 'exact'
+    if 'cost' in last:
+        return 'cost'
+    if last.endswith(_RATE_SUFFIX) or last.startswith('speedup') or last == 'value':
+        return 'rate'
+    return 'info'
+
+
+class Budgets:
+    """Default tolerances + per-pattern rule overrides."""
+
+    def __init__(self, defaults: dict | None = None, rules: dict[str, dict] | None = None):
+        self.defaults = dict(DEFAULT_BUDGET, **(defaults or {}))
+        self.rules = dict(rules or {})  # pattern -> {max_drop_pct|max_rise_pct|ignore}
+
+    def rule_for(self, name: str) -> 'dict | None':
+        if name in self.rules:
+            return self.rules[name]
+        for pattern, rule in self.rules.items():
+            if fnmatch.fnmatchcase(name, pattern):
+                return rule
+        return None
+
+
+def _parse_toml_minimal(text: str) -> dict:
+    """Tiny TOML subset for budgets files on py<3.11 (no tomllib): table
+    headers (possibly with one quoted dotted part), ``key = value`` with
+    number / boolean / quoted-string values, comments, blank lines."""
+    doc: dict = {}
+    table = doc
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith('#'):
+            continue
+        if line.startswith('['):
+            if not line.endswith(']'):
+                raise ValueError(f'bad table header: {raw!r}')
+            header = line[1:-1].strip()
+            # split on dots outside quotes: rules."configs.*.jax_rate"
+            parts: list[str] = []
+            buf, quoted = '', False
+            for ch in header:
+                if ch == '"':
+                    quoted = not quoted
+                elif ch == '.' and not quoted:
+                    parts.append(buf)
+                    buf = ''
+                else:
+                    buf += ch
+            parts.append(buf)
+            table = doc
+            for part in parts:
+                table = table.setdefault(part, {})
+            continue
+        key, sep, val = line.partition('=')
+        if not sep:
+            raise ValueError(f'bad line in budgets file: {raw!r}')
+        key = key.strip().strip('"')
+        val = val.split('#', 1)[0].strip()
+        if val in ('true', 'false'):
+            table[key] = val == 'true'
+        elif val.startswith('"') and val.endswith('"') and len(val) >= 2:
+            table[key] = val[1:-1]
+        else:
+            try:
+                table[key] = int(val)
+            except ValueError:
+                table[key] = float(val)
+    return doc
+
+
+def load_budgets(path: 'str | Path | None') -> Budgets:
+    """Load a budgets TOML (None -> built-in defaults).
+
+    Format::
+
+        [default]
+        rate_drop_pct = 40.0
+        cost_rise_pct = 2.0
+
+        [rules."configs.*.jax_rate"]
+        max_drop_pct = 10.0
+
+        [rules."configs.*.jax_compile_s"]
+        max_rise_pct = 100.0       # opt a wall-clock metric into gating
+
+        [rules."configs.*.host_rate"]
+        ignore = true
+    """
+    if path is None:
+        return Budgets()
+    text = Path(path).read_text()
+    try:
+        import tomllib  # py3.11+
+
+        doc = tomllib.loads(text)
+    except ModuleNotFoundError:
+        doc = _parse_toml_minimal(text)
+    return Budgets(defaults=doc.get('default'), rules=doc.get('rules'))
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+
+def _pct(a: float, b: float) -> 'float | None':
+    if a == 0:
+        return None
+    return (b - a) / abs(a) * 100.0
+
+
+def diff_metrics(a: dict[str, float], b: dict[str, float], budgets: 'Budgets | None' = None) -> dict:
+    """Compare snapshot B against baseline A under the budgets.
+
+    Returns ``{'rows': [...], 'regressions': [...], 'n_compared': int,
+    'only_a': [...], 'only_b': [...]}``; each row is ``{metric, kind, a, b,
+    delta_pct, limit, status}`` with status ``ok`` / ``regressed`` /
+    ``info`` / ``ignored``."""
+    budgets = budgets or Budgets()
+    rows: list[dict] = []
+    common = sorted(set(a) & set(b))
+    for name in common:
+        va, vb = a[name], b[name]
+        kind = classify_metric(name)
+        rule = budgets.rule_for(name)
+        delta = _pct(va, vb)
+        row = {'metric': name, 'kind': kind, 'a': va, 'b': vb, 'delta_pct': None if delta is None else round(delta, 2)}
+        if rule is not None and rule.get('ignore'):
+            row.update(status='ignored', limit='ignored')
+            rows.append(row)
+            continue
+        limit: str | None = None
+        status = 'info'
+        max_drop = rule.get('max_drop_pct') if rule else None
+        max_rise = rule.get('max_rise_pct') if rule else None
+        if max_drop is None and max_rise is None:
+            # defaults by classification
+            if kind == 'exact':
+                max_drop = budgets.defaults['exact_drop']
+            elif kind == 'cost':
+                max_rise = budgets.defaults['cost_rise_pct']
+            elif kind == 'rate':
+                max_drop = budgets.defaults['rate_drop_pct']
+        if max_drop is not None:
+            limit = f'drop<={max_drop:g}%'
+            if kind == 'exact':
+                status = 'regressed' if va - vb > max_drop / 100.0 + 1e-12 else 'ok'
+            else:
+                status = 'regressed' if delta is not None and -delta > max_drop + 1e-9 else 'ok'
+                if delta is None and vb < va:
+                    status = 'regressed'  # baseline 0 -> any drop below is real
+        if max_rise is not None:
+            limit = (limit + ',' if limit else '') + f'rise<={max_rise:g}%'
+            if delta is not None and delta > max_rise + 1e-9:
+                status = 'regressed'
+            elif status == 'info':
+                status = 'ok'
+        row.update(status=status, limit=limit or '-')
+        rows.append(row)
+    regressions = [r for r in rows if r['status'] == 'regressed']
+    return {
+        'rows': rows,
+        'regressions': regressions,
+        'n_compared': len(common),
+        'only_a': sorted(set(a) - set(b)),
+        'only_b': sorted(set(b) - set(a)),
+    }
+
+
+def render_diff(result: dict, verbose: bool = False) -> str:
+    """Human-readable diff table; regressions always shown, ok/info rows
+    only under ``verbose``."""
+    lines: list[str] = []
+    shown = [r for r in result['rows'] if verbose or r['status'] == 'regressed']
+    if shown:
+        w = max(len('metric'), *(len(r['metric']) for r in shown))
+        lines.append(f'{"metric":<{w}}  {"kind":<6} {"baseline":>12}  {"current":>12}  {"delta":>8}  {"limit":>14}  status')
+        for r in shown:
+            delta = '-' if r['delta_pct'] is None else f'{r["delta_pct"]:+.1f}%'
+            lines.append(
+                f'{r["metric"]:<{w}}  {r["kind"]:<6} {r["a"]:>12.4g}  {r["b"]:>12.4g}'
+                f'  {delta:>8}  {r["limit"]:>14}  {r["status"]}'
+            )
+    n_reg = len(result['regressions'])
+    lines.append(
+        f'{result["n_compared"]} metrics compared, {n_reg} regression{"s" if n_reg != 1 else ""}'
+        f' ({len(result["only_a"])} only in baseline, {len(result["only_b"])} only in current)'
+    )
+    return '\n'.join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI (`da4ml-tpu bench-diff`)
+# ---------------------------------------------------------------------------
+
+
+def add_bench_diff_args(parser) -> None:
+    parser.add_argument('baseline', help='Baseline snapshot (bench JSON, BENCH_r0*.json capture, or metrics snapshot)')
+    parser.add_argument('current', help='Snapshot to gate against the baseline')
+    parser.add_argument('--budget', default=None, help='Budgets TOML overriding the default tolerances')
+    parser.add_argument('--json', action='store_true', help='Emit the full diff as JSON')
+    parser.add_argument('-v', '--verbose', action='store_true', help='Show all compared metrics, not just regressions')
+
+
+def bench_diff_main(args) -> int:
+    from ..log import get_logger
+
+    log = get_logger('cli.bench_diff')
+    try:
+        a = load_bench_metrics(args.baseline)
+        b = load_bench_metrics(args.current)
+        budgets = load_budgets(args.budget)
+    except (OSError, ValueError) as e:
+        log.warning(f'bench-diff: {e}')
+        return 2
+    if not a or not b:
+        log.warning(f'bench-diff: no numeric metrics recovered ({args.baseline}: {len(a)}, {args.current}: {len(b)})')
+        return 2
+    result = diff_metrics(a, b, budgets)
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(render_diff(result, verbose=args.verbose))
+    return 1 if result['regressions'] else 0

@@ -1,0 +1,177 @@
+"""Health and status snapshots for the live endpoints.
+
+``/healthz`` aggregates three signals into ``ok`` / ``degraded``:
+
+- **circuit breakers** (``reliability.breaker``): any open breaker means a
+  backend is currently being skipped;
+- **campaign heartbeat**: ``solve_many`` beats ``telemetry.beat('campaign')``
+  per kernel; an in-progress campaign whose last beat is older than
+  ``DA4ML_HEALTH_STALL_S`` (default 120 s) indicates a stalled worker;
+- **compile-cache hit ratio** (informational, never degrades health).
+
+``/statusz`` is the wide-angle JSON: run-mode autotune decisions,
+scheduler bucket occupancy, deadline workers, active spans, device
+inventory. Snapshots must be scrape-safe: they never initialize jax or
+import heavy modules that are not already loaded.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from .. import core
+from ..metrics import metrics_on, metrics_snapshot
+
+_T0 = time.monotonic()
+
+#: campaign heartbeat older than this (while a campaign is in progress)
+#: flips health to degraded
+DEFAULT_STALL_S = 120.0
+
+
+def _stall_threshold_s() -> float:
+    try:
+        return float(os.environ.get('DA4ML_HEALTH_STALL_S', '') or DEFAULT_STALL_S)
+    except ValueError:
+        return DEFAULT_STALL_S
+
+
+def _breaker_states() -> dict[str, str]:
+    """Live breaker states without forcing a reliability import on scrape."""
+    mod = sys.modules.get('da4ml_tpu.reliability.breaker')
+    if mod is None:
+        return {}
+    return mod.breaker_states()
+
+
+def _metric_value(snap: dict, name: str) -> float | None:
+    m = snap.get(name)
+    return None if m is None else m.get('value')
+
+
+def _campaign_check(snap: dict) -> dict:
+    done = _metric_value(snap, 'campaign.done')
+    total = _metric_value(snap, 'campaign.total')
+    age = core.beat_age_s('campaign')
+    in_progress = total is not None and total > 0 and (done is None or done < total)
+    stalled = bool(in_progress and age is not None and age > _stall_threshold_s())
+    return {
+        'status': 'degraded' if stalled else 'ok',
+        'in_progress': bool(in_progress),
+        'done': done,
+        'total': total,
+        'heartbeat_age_s': None if age is None else round(age, 3),
+        'stall_threshold_s': _stall_threshold_s(),
+    }
+
+
+def _cache_check(snap: dict) -> dict:
+    compiles = _metric_value(snap, 'jit.compile') or 0.0
+    loads = _metric_value(snap, 'jit.cache_load') or 0.0
+    first_calls = compiles + loads
+    return {
+        'status': 'ok',  # informational: a cold cache is not ill health
+        'compiles': compiles,
+        'cache_loads': loads,
+        'hit_ratio': round(loads / first_calls, 4) if first_calls else None,
+    }
+
+
+def refresh_computed_gauges() -> None:
+    """Materialize scrape-time values into the registry so ``/metrics`` and
+    ``metrics_snapshot()`` carry them: breaker states (set even before any
+    transition), campaign heartbeat age, compile-cache hit ratio, and the
+    aggregate health bit. No-op while metrics are disabled."""
+    if not metrics_on():
+        return
+    from ..metrics import gauge
+
+    state_code = {'closed': 0.0, 'half-open': 0.5, 'open': 1.0}
+    for name, state in _breaker_states().items():
+        gauge(f'breaker.state.{name}').set(state_code.get(state, -1.0))
+    age = core.beat_age_s('campaign')
+    if age is not None:
+        gauge('campaign.heartbeat_age_s').set(round(age, 6))
+    snap = metrics_snapshot()
+    ratio = _cache_check(snap)['hit_ratio']
+    if ratio is not None:
+        gauge('cache.hit_ratio').set(ratio)
+    gauge('health.status').set(0.0 if health_snapshot(snap)['status'] == 'ok' else 1.0)
+
+
+def health_snapshot(snap: dict | None = None) -> dict:
+    """The ``/healthz`` document. ``status`` is ``ok`` or ``degraded``."""
+    if snap is None:
+        snap = metrics_snapshot()
+    breakers = _breaker_states()
+    open_breakers = sorted(n for n, s in breakers.items() if s == 'open')
+    campaign = _campaign_check(snap)
+    checks = {
+        'breakers': {
+            'status': 'degraded' if open_breakers else 'ok',
+            'open': open_breakers,
+            'states': breakers,
+        },
+        'campaign': campaign,
+        'compile_cache': _cache_check(snap),
+    }
+    degraded = any(c['status'] == 'degraded' for c in checks.values())
+    return {
+        'status': 'degraded' if degraded else 'ok',
+        'checks': checks,
+        'pid': os.getpid(),
+        'uptime_s': round(time.monotonic() - _T0, 3),
+        'metrics_enabled': metrics_on(),
+    }
+
+
+def _run_mode_decisions() -> dict:
+    """Persisted/in-process autotune decisions, if the runtime is loaded."""
+    mod = sys.modules.get('da4ml_tpu.runtime.jax_backend')
+    if mod is None:
+        return {}
+    try:
+        return mod.mode_decisions()
+    except Exception:
+        return {}
+
+
+def _device_inventory() -> dict | None:
+    """Local device info — only when jax is already initialized (a scrape
+    must never pay, or trigger, backend startup)."""
+    if 'jax' not in sys.modules:
+        return None
+    try:
+        from ...parallel import device_inventory
+
+        return device_inventory()
+    except Exception:
+        return None
+
+
+def status_snapshot() -> dict:
+    """The ``/statusz`` document: everything a person debugging a live
+    process wants on one page."""
+    snap = metrics_snapshot()
+    sched = {k: v.get('value', v.get('count')) for k, v in snap.items() if k.startswith(('sched.', 'emit.'))}
+    run = {k: v.get('value', v.get('count')) for k, v in snap.items() if k.startswith('run.')}
+    deadline_workers = [t.name for t in threading.enumerate() if t.name.startswith('da4ml-deadline-')]
+    return {
+        'pid': os.getpid(),
+        'uptime_s': round(time.monotonic() - _T0, 3),
+        'telemetry': {
+            'metrics_enabled': metrics_on(),
+            'tracing_active': core.tracing_active(),
+            'n_metrics': len(snap),
+        },
+        'health': health_snapshot(snap),
+        'active_spans': core.active_spans(),
+        'run_modes': _run_mode_decisions(),
+        'scheduler': sched,
+        'runtime': run,
+        'deadline_workers': deadline_workers,
+        'devices': _device_inventory(),
+    }

@@ -1,0 +1,108 @@
+"""XLA device-profile correlation (``DA4ML_PROFILE=<dir>``).
+
+Setting ``DA4ML_PROFILE`` arms ``jax.profiler``: the first annotated
+region starts ``jax.profiler.start_trace(dir)`` (stopped atexit), and
+every CMVM device rung / runtime batch call is wrapped in a
+``jax.profiler.TraceAnnotation`` named ``da4ml:<span name>#span=<id>`` —
+the owning telemetry span id — so the resulting Perfetto/TensorBoard
+view shows host telemetry spans and XLA device kernels on one correlated
+timeline (load the xplane from ``<dir>`` next to the ``DA4ML_TRACE``
+Chrome trace).
+
+Disabled (no env var): :func:`annotate` costs one dict lookup and returns
+a shared ``nullcontext`` — the hot paths stay clean.
+
+The profiler start is best-effort: a missing/broken profiler plugin logs
+one warning and disarms for the process instead of failing the solve.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from contextlib import nullcontext
+
+_NULL = nullcontext()
+_lock = threading.Lock()
+_started = False
+_failed = False
+
+
+def profile_dir() -> str | None:
+    """The armed profile output directory, or None when profiling is off."""
+    return os.environ.get('DA4ML_PROFILE') or None
+
+
+def _stop_trace() -> None:
+    global _started
+    if not _started:
+        return
+    _started = False
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+
+
+def _ensure_started(d: str) -> bool:
+    """Start the process-wide profiler trace once; False if unavailable."""
+    global _started, _failed
+    if _started:
+        return True
+    if _failed:
+        return False
+    with _lock:
+        if _started:
+            return True
+        if _failed:
+            return False
+        try:
+            import jax
+
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+            _started = True
+            atexit.register(_stop_trace)
+        except Exception as e:
+            _failed = True
+            from ..log import warn_once
+
+            warn_once(
+                'obs.profile.start_failed',
+                f'DA4ML_PROFILE={d!r}: jax profiler unavailable, device profiling disabled: {e}',
+                logger='telemetry.obs',
+            )
+            return False
+    return True
+
+
+def annotate(name: str, span_id: 'int | None' = None):
+    """Context manager bracketing a device dispatch/fetch region.
+
+    When profiling is armed, returns a ``jax.profiler.TraceAnnotation``
+    tagged with the owning telemetry span id; otherwise a shared no-op
+    context. ``span_id=None`` falls back to the innermost open span of the
+    calling thread."""
+    d = profile_dir()
+    if not d or not _ensure_started(d):
+        return _NULL
+    if span_id is None:
+        from ..core import current_span
+
+        sp = current_span()
+        span_id = sp.span_id if sp is not None else None
+    try:
+        import jax
+
+        tag = f'da4ml:{name}' if span_id is None else f'da4ml:{name}#span={span_id}'
+        return jax.profiler.TraceAnnotation(tag)
+    except Exception:
+        return _NULL
+
+
+def profiling_active() -> bool:
+    """True once the process-wide profiler trace has been started."""
+    return _started

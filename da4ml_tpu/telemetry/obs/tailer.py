@@ -1,0 +1,75 @@
+"""Incremental reader for a growing JSONL telemetry trace.
+
+``DA4ML_TRACE=<x>.jsonl`` streams one event per line as spans close, so a
+long campaign can be watched from outside the process without the HTTP
+endpoint: ``da4ml-tpu stats --follow trace.jsonl`` re-renders the summary
+as the file grows, and ``da4ml-tpu monitor --follow trace.jsonl`` serves
+the mirrored metrics over ``/metrics``.
+
+:class:`TraceTailer` keeps a byte offset and a partial-line buffer, so
+each :meth:`poll` parses only the newly appended complete lines; a
+truncated/rotated file (size shrank) resets the reader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+
+class TraceTailer:
+    def __init__(self, path: 'str | os.PathLike'):
+        self.path = Path(path)
+        self.events: list[dict] = []
+        self.metrics: dict = {}
+        self.n_bad_lines = 0
+        self._pos = 0
+        self._buf = ''
+        self._last_new = time.monotonic()
+
+    def poll(self) -> int:
+        """Read any newly appended complete lines; returns the number of new
+        events absorbed (metrics records update :attr:`metrics` instead)."""
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return 0
+        if size < self._pos:  # truncated or rotated: start over
+            self._pos = 0
+            self._buf = ''
+            self.events.clear()
+            self.metrics = {}
+        if size == self._pos:
+            return 0
+        with open(self.path) as fh:
+            fh.seek(self._pos)
+            chunk = fh.read()
+            self._pos = fh.tell()
+        self._buf += chunk
+        lines = self._buf.split('\n')
+        self._buf = lines.pop()  # trailing partial line (or '')
+        n_new = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                self.n_bad_lines += 1
+                continue
+            if ev.get('ph') == 'M' and ev.get('name') == 'metrics':
+                self.metrics = ev.get('args', {}).get('metrics', {})
+            else:
+                self.events.append(ev)
+                n_new += 1
+        if n_new:
+            self._last_new = time.monotonic()
+        return n_new
+
+    @property
+    def staleness_s(self) -> float:
+        """Seconds since the last new event was absorbed."""
+        return time.monotonic() - self._last_new

@@ -1,0 +1,573 @@
+"""Live observability plane: exposition, endpoints, health, bench-diff.
+
+Covers the PR-6 acceptance surface (docs/observability.md):
+
+- OpenMetrics exposition validated line-by-line against the format
+  grammar (HELP/TYPE ordering, label escaping, cumulative buckets, EOF);
+- endpoint smoke over a real device solve on the 8-device CPU mesh;
+- /healthz reflecting breaker transitions and stalled-campaign heartbeats;
+- bench-diff pass / injected-regression fail / budget-override cases on
+  the committed BENCH trajectory;
+- disabled path: no server thread, no registry, unless explicitly armed;
+- stats --follow incremental tail of a streaming JSONL trace.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from da4ml_tpu import telemetry
+from da4ml_tpu.cmvm import solve
+from da4ml_tpu.telemetry.obs import (
+    TraceTailer,
+    diff_metrics,
+    health_snapshot,
+    load_bench_metrics,
+    load_budgets,
+    render_openmetrics,
+    serve,
+    server_port,
+    status_snapshot,
+    stop_server,
+    validate_openmetrics,
+)
+from da4ml_tpu.telemetry.obs.bench_diff import Budgets, classify_metric, flatten_bench
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs(monkeypatch):
+    """Server + telemetry are process-global: start and leave every test clean."""
+    monkeypatch.delenv('DA4ML_METRICS_PORT', raising=False)
+    monkeypatch.delenv('DA4ML_PROFILE', raising=False)
+    stop_server()
+    telemetry.reset()
+    from da4ml_tpu.reliability.breaker import reset_all_breakers
+
+    reset_all_breakers()
+    yield
+    stop_server()
+    telemetry.reset()
+    reset_all_breakers()
+
+
+def _small_kernel(seed=3, n=6, m=4):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-8, 8, (n, m)).astype(np.float64)
+
+
+def _get(url: str):
+    """(status, body) even for non-2xx responses."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition format
+# ---------------------------------------------------------------------------
+
+
+def test_exposition_valid_over_real_registry():
+    telemetry.enable(metrics=True)
+    solve(_small_kernel(), backend='cpu')
+    text = render_openmetrics()
+    fams = validate_openmetrics(text)
+    assert 'da4ml_solve_calls' in fams
+    assert fams['da4ml_solve_calls']['type'] == 'counter'
+    assert fams['da4ml_solve_calls']['samples']['da4ml_solve_calls_total'] == 1.0
+    # seconds rename + histogram triplet
+    dur = fams['da4ml_solve_duration_seconds']
+    assert dur['type'] == 'histogram'
+    assert any(k.startswith('da4ml_solve_duration_seconds_bucket') for k in dur['samples'])
+    assert dur['samples']['da4ml_solve_duration_seconds_count'] == 1.0
+    # count-valued histogram rides the count ladder, not seconds: the
+    # observed adder cost must land in a finite bucket
+    adders = fams['da4ml_solve_adders']
+    finite = [k for k in adders['samples'] if '_bucket' in k and '+Inf' not in k]
+    assert sum(adders['samples'][k] for k in finite) >= 1.0
+
+
+def test_exposition_label_folding_and_escaping():
+    telemetry.enable(metrics=True)
+    telemetry.gauge('breaker.state.native-threads').set(1.0)
+    telemetry.gauge('breaker.state.jax').set(0.0)
+    telemetry.gauge('run.mode.level').set(3.0)
+    fams = validate_openmetrics(render_openmetrics())
+    br = fams['da4ml_breaker_state']
+    assert br['samples']['da4ml_breaker_state{breaker="jax"}'] == 0.0
+    assert br['samples']['da4ml_breaker_state{breaker="native-threads"}'] == 1.0
+    assert fams['da4ml_run_mode']['samples']['da4ml_run_mode{mode="level"}'] == 3.0
+
+
+def test_exposition_escapes_hostile_label_values():
+    from da4ml_tpu.telemetry.obs.openmetrics import _labels_str
+
+    rendered = _labels_str({'breaker': 'a"b\\c\nd'})
+    # the validator must accept the escaped form and round-trip the value
+    text = f'# HELP da4ml_x x\n# TYPE da4ml_x gauge\nda4ml_x{rendered} 1\n# EOF\n'
+    fams = validate_openmetrics(text)
+    (key,) = fams['da4ml_x']['samples']
+    assert '\\"' in key and '\\\\' in key and '\\n' in key
+
+
+@pytest.mark.parametrize(
+    'bad',
+    [
+        'da4ml_x 1\n# EOF\n',  # sample before any HELP/TYPE
+        '# HELP da4ml_x x\n# TYPE da4ml_x gauge\nda4ml_x 1\n',  # missing EOF
+        '# HELP da4ml_x x\n# TYPE da4ml_x counter\nda4ml_x 1\n# EOF\n',  # counter w/o _total
+        '# HELP da4ml_x x\n# TYPE da4ml_x wat\nda4ml_x 1\n# EOF\n',  # unknown type
+        '# HELP da4ml_x x\n# TYPE da4ml_x gauge\nda4ml_x{le>="0"} 1\n# EOF\n',  # bad label
+        '# HELP da4ml_x x\n# TYPE da4ml_x gauge\nda4ml_x 1\nda4ml_x 2\n# EOF\n',  # duplicate
+        (
+            '# HELP da4ml_x x\n# TYPE da4ml_x histogram\n'
+            'da4ml_x_bucket{le="1"} 5\nda4ml_x_bucket{le="+Inf"} 3\n'
+            'da4ml_x_sum 1\nda4ml_x_count 3\n# EOF\n'
+        ),  # non-cumulative buckets
+        (
+            '# HELP da4ml_x x\n# TYPE da4ml_x histogram\n'
+            'da4ml_x_bucket{le="1"} 1\nda4ml_x_sum 1\nda4ml_x_count 1\n# EOF\n'
+        ),  # missing +Inf bucket
+    ],
+)
+def test_exposition_validator_rejects(bad):
+    with pytest.raises(ValueError):
+        validate_openmetrics(bad)
+
+
+def test_histogram_bucket_presets():
+    """Count/byte histograms must not dump everything into +Inf."""
+    assert telemetry.COUNT_BUCKETS[0] <= 1 and telemetry.COUNT_BUCKETS[-1] >= 1e6
+    assert telemetry.BYTES_BUCKETS[0] <= 4096 and telemetry.BYTES_BUCKETS[-1] >= 2**30
+    telemetry.enable(metrics=True)
+    telemetry.histogram('t.count', telemetry.COUNT_BUCKETS).observe(5000)
+    telemetry.histogram('t.bytes', telemetry.BYTES_BUCKETS).observe(2**20)
+    snap = telemetry.metrics_snapshot()
+    for name in ('t.count', 't.bytes'):
+        m = snap[name]
+        assert sum(m['buckets']) == 1, f'{name}: sample fell through to +Inf'
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_endpoint_smoke_over_device_solve():
+    """Acceptance: scraping /metrics during a live solve on the 8-device CPU
+    mesh yields valid OpenMetrics with solver, runtime, reliability, and
+    scheduler families."""
+    srv = serve(0)
+    assert server_port() == srv.port
+    solve(_small_kernel(5, 8, 8), backend='jax')  # device rungs on the mesh
+    # and one runtime batch so run.* families are live too
+    from da4ml_tpu.ir.synth import random_inputs, random_program
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+
+    rng = np.random.default_rng(0)
+    prog = random_program(rng, n_ops=40, n_in=4, n_out=2)
+    ex = DaisExecutor(prog, mode='scan')
+    ex(random_inputs(rng, prog, 64))
+
+    status, body = _get(srv.url + '/metrics')
+    assert status == 200
+    fams = validate_openmetrics(body)
+    assert 'da4ml_solve_calls' in fams  # solver
+    assert 'da4ml_cse_device_rounds' in fams
+    assert 'da4ml_sched_device_seconds' in fams  # scheduler: per-rung device timing
+    assert 'da4ml_run_device_seconds' in fams  # runtime
+    run_mode = fams.get('da4ml_run_mode', {'samples': {}})['samples']
+    assert any(k.startswith('da4ml_run_mode_total{mode=') for k in run_mode), run_mode
+    assert 'da4ml_breaker_state' in fams  # reliability, label-folded
+    assert 'da4ml_health_status' in fams
+
+    status, body = _get(srv.url + '/healthz')
+    assert status == 200
+    doc = json.loads(body)
+    assert doc['status'] == 'ok'
+    assert doc['checks']['breakers']['status'] == 'ok'
+
+    status, body = _get(srv.url + '/statusz')
+    assert status == 200
+    doc = json.loads(body)
+    assert doc['telemetry']['metrics_enabled'] is True
+    assert doc['scheduler'], 'statusz missing scheduler occupancy'
+
+    status, _ = _get(srv.url + '/nope')
+    assert status == 404
+
+
+def test_serve_idempotent_and_stop():
+    a = serve(0)
+    b = serve(0)
+    assert a is b
+    stop_server()
+    assert server_port() is None
+    c = serve(0)
+    assert c is not a
+    assert server_port() == c.port
+
+
+def test_healthz_reflects_breaker_transitions():
+    from da4ml_tpu.reliability.breaker import breaker_for
+
+    srv = serve(0)
+    br = breaker_for('obs-test-backend', fail_threshold=1, reset_after=60.0)
+    status, body = _get(srv.url + '/healthz')
+    assert status == 200 and json.loads(body)['status'] == 'ok'
+
+    br.record_failure()  # threshold 1: opens immediately
+    status, body = _get(srv.url + '/healthz')
+    doc = json.loads(body)
+    assert status == 503
+    assert doc['status'] == 'degraded'
+    assert 'obs-test-backend' in doc['checks']['breakers']['open']
+    # the open breaker is also a labeled gauge on /metrics
+    fams = validate_openmetrics(_get(srv.url + '/metrics')[1])
+    assert fams['da4ml_breaker_state']['samples']['da4ml_breaker_state{breaker="obs-test-backend"}'] == 1.0
+
+    br.record_success()
+    status, body = _get(srv.url + '/healthz')
+    assert status == 200 and json.loads(body)['status'] == 'ok'
+
+
+def test_healthz_stalled_campaign_degrades(monkeypatch):
+    """A worker that stops beating mid-campaign flips health to degraded."""
+    from da4ml_tpu.telemetry import core
+
+    telemetry.enable(metrics=True)
+    telemetry.gauge('campaign.total').set(3.0)
+    telemetry.gauge('campaign.done').set(1.0)
+    telemetry.beat('campaign')
+    doc = health_snapshot()
+    assert doc['checks']['campaign']['in_progress'] is True
+    assert doc['status'] == 'ok'
+
+    # age the heartbeat past the stall threshold without sleeping
+    core._heartbeats['campaign'] -= 500.0
+    doc = health_snapshot()
+    assert doc['checks']['campaign']['status'] == 'degraded'
+    assert doc['status'] == 'degraded'
+    assert doc['checks']['campaign']['heartbeat_age_s'] > 120.0
+
+    # a finished campaign stops gating no matter how old the beat is
+    telemetry.gauge('campaign.done').set(3.0)
+    assert health_snapshot()['status'] == 'ok'
+
+    # threshold is tunable
+    monkeypatch.setenv('DA4ML_HEALTH_STALL_S', '1e9')
+    telemetry.gauge('campaign.done').set(1.0)
+    assert health_snapshot()['status'] == 'ok'
+
+
+def test_campaign_heartbeat_age_gauge():
+    """solve_many beats per kernel; the age gauge lands on /metrics."""
+    from da4ml_tpu.reliability import solve_many
+
+    serve(0)
+    results, report = solve_many([_small_kernel(s) for s in range(2)], backend='pure-python')
+    assert len(results) == 2
+    assert telemetry.beat_age_s('campaign') is not None
+    fams = validate_openmetrics(render_openmetrics())
+    (age,) = fams['da4ml_campaign_heartbeat_age_seconds']['samples'].values()
+    assert 0.0 <= age < 60.0
+
+
+def test_statusz_active_spans():
+    """A live endpoint arms real spans even without a trace sink, so
+    /statusz shows what the process is doing right now."""
+    srv = serve(0)
+    with telemetry.span('obs.outer', probe=1):
+        doc = json.loads(_get(srv.url + '/statusz')[1])
+        names = [s['name'] for s in doc['active_spans']]
+        assert 'obs.outer' in names
+    assert all(s['name'] != 'obs.outer' for s in status_snapshot()['active_spans'])
+    stop_server()
+    # watcher released with the server: spans fall back to the no-op singleton
+    assert telemetry.span('a') is telemetry.span('b')
+
+
+def test_broken_provider_returns_500_not_dead_thread():
+    srv = serve(0, status_provider=lambda: (_ for _ in ()).throw(RuntimeError('boom')))
+    status, body = _get(srv.url + '/statusz')
+    assert status == 500 and 'boom' in body
+    # the serving thread survived: next scrape still answers
+    status, _ = _get(srv.url + '/metrics')
+    assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_no_server_thread():
+    """Acceptance: telemetry-disabled runs spawn no server and no registry."""
+    assert server_port() is None
+    solve(_small_kernel(), backend='cpu')
+    assert server_port() is None
+    assert not any(t.name == 'da4ml-obs-server' for t in threading.enumerate())
+    assert telemetry.metrics_snapshot() == {}
+
+
+def test_env_var_activation_subprocess(tmp_path):
+    """DA4ML_METRICS_PORT arms the endpoint at import with no code changes."""
+    code = (
+        'import os, urllib.request\n'
+        'import da4ml_tpu.telemetry as tm\n'
+        'from da4ml_tpu.telemetry.obs.server import server_port\n'
+        'p = server_port()\n'
+        'assert p, "endpoint not armed"\n'
+        'body = urllib.request.urlopen(f"http://127.0.0.1:{p}/metrics", timeout=10).read().decode()\n'
+        'from da4ml_tpu.telemetry.obs import validate_openmetrics\n'
+        'validate_openmetrics(body)\n'
+        'print("PORT_OK")\n'
+    )
+    env = dict(
+        __import__('os').environ, DA4ML_METRICS_PORT='0', JAX_PLATFORMS='cpu'
+    )
+    out = subprocess.run([sys.executable, '-c', code], capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert 'PORT_OK' in out.stdout
+
+
+def test_bad_metrics_port_does_not_break_import():
+    code = 'import da4ml_tpu.telemetry; print("IMPORT_OK")'
+    env = dict(__import__('os').environ, DA4ML_METRICS_PORT='not-a-port', JAX_PLATFORMS='cpu')
+    out = subprocess.run([sys.executable, '-c', code], capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert 'IMPORT_OK' in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# bench-diff regression gates
+# ---------------------------------------------------------------------------
+
+
+def test_bench_diff_committed_trajectory_passes():
+    """Acceptance: the committed r04 -> r05 round passes default budgets."""
+    a = load_bench_metrics(REPO / 'BENCH_r04.json')
+    b = load_bench_metrics(REPO / 'BENCH_r05.json')
+    assert len(a) > 20 and len(b) > 20, 'tail recovery found too few metrics'
+    result = diff_metrics(a, b)
+    assert result['n_compared'] > 20
+    assert result['regressions'] == []
+
+
+def test_bench_diff_detects_injected_regression(tmp_path):
+    base = {'metric': 'x', 'value': 10.0, 'detail': {'configs': [{'config': 'c1', 'jax_rate': 10.0, 'cost': 100}]}}
+    bad = {'metric': 'x', 'value': 2.0, 'detail': {'configs': [{'config': 'c1', 'jax_rate': 2.0, 'cost': 110}]}}
+    pa, pb = tmp_path / 'a.json', tmp_path / 'b.json'
+    pa.write_text(json.dumps(base))
+    pb.write_text(json.dumps(bad))
+    result = diff_metrics(load_bench_metrics(pa), load_bench_metrics(pb))
+    regressed = {r['metric'] for r in result['regressions']}
+    assert regressed == {'value', 'configs.c1.jax_rate', 'configs.c1.cost'}
+    # CLI exit codes: 1 regression, 0 after loosening the budgets
+    from da4ml_tpu._cli import main
+
+    assert main(['bench-diff', str(pa), str(pb)]) == 1
+    budget = tmp_path / 'budgets.toml'
+    budget.write_text('[default]\nrate_drop_pct = 90.0\ncost_rise_pct = 15.0\n')
+    assert main(['bench-diff', str(pa), str(pb), '--budget', str(budget)]) == 0
+
+
+def test_bench_diff_budget_rules(tmp_path):
+    budget = tmp_path / 'budgets.toml'
+    budget.write_text(
+        '[default]\nrate_drop_pct = 50.0\n\n'
+        '[rules."configs.*.jax_rate"]\nmax_drop_pct = 5.0\n\n'
+        '[rules."configs.*.host_rate"]\nignore = true\n\n'
+        '[rules."configs.*.compile_s"]\nmax_rise_pct = 10.0\n'
+    )
+    budgets = load_budgets(budget)
+    a = {'configs.c.jax_rate': 100.0, 'configs.c.host_rate': 100.0, 'configs.c.compile_s': 1.0}
+    b = {'configs.c.jax_rate': 90.0, 'configs.c.host_rate': 1.0, 'configs.c.compile_s': 1.5}
+    result = diff_metrics(a, b, budgets)
+    by_name = {r['metric']: r for r in result['rows']}
+    assert by_name['configs.c.jax_rate']['status'] == 'regressed'  # -10% > 5% rule
+    assert by_name['configs.c.host_rate']['status'] == 'ignored'
+    assert by_name['configs.c.compile_s']['status'] == 'regressed'  # +50% > 10% opt-in
+
+
+def test_bench_diff_exactness_never_drops():
+    assert classify_metric('quality_sweep.exact') == 'exact'
+    result = diff_metrics({'quality_sweep.exact': 1.0}, {'quality_sweep.exact': 0.9375})
+    assert len(result['regressions']) == 1
+    result = diff_metrics({'quality_sweep.exact': 1.0}, {'quality_sweep.exact': 1.0})
+    assert result['regressions'] == []
+
+
+def test_bench_diff_wallclock_is_info_by_default():
+    result = diff_metrics({'configs.c.jax_compile_s': 1.0}, {'configs.c.jax_compile_s': 50.0})
+    assert result['regressions'] == []
+    (row,) = result['rows']
+    assert row['status'] == 'info'
+
+
+def test_flatten_shapes():
+    # exactness ratio strings and config-keyed lists
+    flat = flatten_bench(
+        {'value': 5.0, 'detail': {'quality': {'exact': '16/16'}, 'configs': [{'config': 'a', 'rate': 2.0}]}}
+    )
+    assert flat['quality.exact'] == 1.0
+    assert flat['configs.a.rate'] == 2.0
+    # a telemetry metrics snapshot flattens counters/gauges/histograms
+    telemetry.enable(metrics=True)
+    telemetry.counter('c.x').inc(3)
+    telemetry.histogram('h.y').observe(0.5)
+    flat = flatten_bench(telemetry.metrics_snapshot())
+    assert flat['c.x'] == 3.0
+    assert flat['h.y.count'] == 1.0
+
+
+def test_bench_diff_unreadable_input(tmp_path):
+    from da4ml_tpu._cli import main
+
+    bad = tmp_path / 'bad.json'
+    bad.write_text('[]')
+    ok = tmp_path / 'ok.json'
+    ok.write_text(json.dumps({'value': 1.0, 'detail': {}}))
+    assert main(['bench-diff', str(bad), str(ok)]) == 2
+    assert main(['bench-diff', str(tmp_path / 'missing.json'), str(ok)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# trace tailing (stats --follow / monitor --follow)
+# ---------------------------------------------------------------------------
+
+
+def test_tailer_incremental_and_truncation(tmp_path):
+    path = tmp_path / 't.jsonl'
+    ev = {'ph': 'X', 'name': 'a', 'ts': 0, 'dur': 1, 'pid': 1, 'tid': 1}
+    with open(path, 'w') as fh:
+        fh.write(json.dumps(ev) + '\n')
+    tailer = TraceTailer(path)
+    assert tailer.poll() == 1
+    assert tailer.poll() == 0  # nothing new
+    with open(path, 'a') as fh:
+        fh.write(json.dumps(dict(ev, name='b')) + '\n')
+        fh.write('{"partial": ')  # incomplete trailing line must be buffered
+    assert tailer.poll() == 1
+    assert [e['name'] for e in tailer.events] == ['a', 'b']
+    with open(path, 'a') as fh:
+        fh.write('1}\n')  # completes the buffered line
+    assert tailer.poll() == 1
+    # metrics records update .metrics instead of .events
+    with open(path, 'a') as fh:
+        rec = {'ph': 'M', 'name': 'metrics', 'args': {'metrics': {'solve.calls': {'type': 'counter', 'value': 2}}}}
+        fh.write(json.dumps(rec) + '\n')
+    assert tailer.poll() == 0
+    assert tailer.metrics['solve.calls']['value'] == 2
+    # truncation resets
+    path.write_text(json.dumps(ev) + '\n')
+    assert tailer.poll() == 1
+    assert len(tailer.events) == 1
+
+
+def test_stats_follow_cli(tmp_path, capsys):
+    from da4ml_tpu._cli import main
+
+    path = tmp_path / 'trace.jsonl'
+    telemetry.enable(path)
+    solve(_small_kernel(), backend='cpu')
+    telemetry.disable()
+    rc = main(['stats', '--follow', str(path), '--max-updates', '1', '--interval', '0.01'])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert 'update 1' in out
+    assert 'cmvm.solve' in out
+    # non-jsonl rejected
+    assert main(['stats', '--follow', str(tmp_path / 'trace.json'), '--max-updates', '1']) == 1
+
+
+def test_monitor_follow_serves_mirrored_metrics(tmp_path):
+    import argparse
+
+    from da4ml_tpu._cli.monitor import monitor_main
+
+    path = tmp_path / 'trace.jsonl'
+    telemetry.enable(path)
+    solve(_small_kernel(), backend='cpu')
+    telemetry.reset()
+
+    args = argparse.Namespace(
+        port=0, host='127.0.0.1', follow=path, interval=0.05, duration=4.0, stall_after=60.0
+    )
+    t = threading.Thread(target=monitor_main, args=(args,), daemon=True)
+    t.start()
+    port = None
+    for _ in range(100):
+        port = server_port()
+        if port:
+            break
+        time.sleep(0.05)
+    assert port, 'monitor never bound'
+    fams = validate_openmetrics(_get(f'http://127.0.0.1:{port}/metrics')[1])
+    assert 'da4ml_solve_calls' in fams, 'mirrored solver metrics missing'
+    doc = json.loads(_get(f'http://127.0.0.1:{port}/statusz')[1])
+    assert doc['n_events'] > 0
+    t.join(timeout=30)
+    assert not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# device-profile correlation
+# ---------------------------------------------------------------------------
+
+
+def test_profile_annotate_disabled_is_noop(monkeypatch):
+    from contextlib import nullcontext
+
+    from da4ml_tpu.telemetry.obs import profile
+
+    monkeypatch.delenv('DA4ML_PROFILE', raising=False)
+    cm = profile.annotate('cmvm.rung.dispatch')
+    assert isinstance(cm, nullcontext)
+    with cm:
+        pass
+
+
+def test_profile_armed_writes_xplane(tmp_path):
+    """DA4ML_PROFILE correlates device events: a solve under the env var
+    produces an xplane capture next to the telemetry trace."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            '-c',
+            'import numpy as np\n'
+            'from da4ml_tpu.cmvm import solve\n'
+            'from da4ml_tpu.telemetry.obs import profile\n'
+            'm = np.random.default_rng(2).integers(-8, 8, (8, 8)).astype(np.float64)\n'
+            'solve(m, backend="jax")\n'
+            'assert profile.profiling_active(), "profiler did not arm"\n'
+            'print("PROF_OK")\n',
+        ],
+        capture_output=True,
+        text=True,
+        env=dict(__import__('os').environ, DA4ML_PROFILE=str(tmp_path / 'prof'), JAX_PLATFORMS='cpu'),
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    assert 'PROF_OK' in out.stdout
+    captures = list((tmp_path / 'prof').rglob('*.xplane.pb'))
+    assert captures, 'no xplane capture written'
+
+
+def test_budgets_defaults_and_rule_matching():
+    budgets = Budgets(rules={'configs.*.jax_rate': {'max_drop_pct': 5.0}})
+    assert budgets.rule_for('configs.c3.jax_rate') == {'max_drop_pct': 5.0}
+    assert budgets.rule_for('configs.c3.other') is None
+    assert budgets.defaults['rate_drop_pct'] == 50.0
