@@ -517,6 +517,48 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
             'host_rate': round(n / host_t, 2),
             'jax_rate': round(n / jt, 2),
         }
+    if name == 'campaign':
+        # fault-tolerant multi-worker campaign probe (docs/distributed.md):
+        # the same small corpus solved single-process (reference) and with
+        # 3 worker subprocesses over a shared-filesystem lease queue —
+        # scaling efficiency = t1 / (N * tN), byte-identity is the campaign
+        # invariant the chaos CI job gates harder
+        import tempfile
+
+        from da4ml_tpu.parallel import campaign as _camp
+
+        rng = np.random.default_rng(7000)
+        n = 8 if limited else 24
+        kernels = [_rand_kernel(rng, int(rng.integers(4, 13)), int(rng.integers(4, 13)), 4) for _ in range(n)]
+        workers = 3
+        with tempfile.TemporaryDirectory() as td:
+            ref_results, ref_rep = _camp.run_campaign(
+                kernels, workers=1, campaign_dir=os.path.join(td, 'ref'), backend='native-threads'
+            )
+            par_results, par_rep = _camp.run_campaign(
+                kernels,
+                workers=workers,
+                campaign_dir=os.path.join(td, 'par'),
+                backend='native-threads',
+                ttl_s=10.0,
+                poll_s=0.2,
+            )
+        ref_blobs = {d['key']: json.dumps(d['pipeline'], sort_keys=True) for d in ref_results}
+        par_blobs = {d['key']: json.dumps(d['pipeline'], sort_keys=True) for d in par_results}
+        t1, tn = ref_rep['wall_s'], par_rep['wall_s']
+        return {
+            'n_kernels': n,
+            'workers': workers,
+            'single_wall_s': round(t1, 3),
+            'campaign_wall_s': round(tn, 3),
+            # tn includes ~1s/worker interpreter+import startup, so small
+            # corpora under-report; the honest floor, not a headline
+            'scaling_efficiency': round(t1 / (workers * tn), 3) if tn > 0 else None,
+            'speedup': round(t1 / tn, 3) if tn > 0 else None,
+            'kernels_stolen': par_rep['kernels_stolen'],
+            'byte_identical': ref_blobs == par_blobs,
+            'mean_cost': round(float(np.mean([d['cost'] for d in par_results])), 3),
+        }
     if name == 'select_modes':
         # selection-mode microbench: top4 (XLA O(S*P) score cache) vs the
         # full-rescan xla path vs the single-kernel fused Pallas loop
@@ -548,7 +590,7 @@ _CONFIG_SECTIONS = (
     '4_qconv3x3_im2col',
     '5_full_model_trace',
 )
-_MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference')
+_MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference', 'campaign')
 
 
 def _run_section_child(name: str, n1: int, timeout: float, env: dict | None = None) -> dict:

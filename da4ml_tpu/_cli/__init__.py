@@ -18,7 +18,10 @@ src/da4ml/_cli/__init__.py:8-27):
   endpoints, optionally mirroring a followed trace
   (docs/observability.md);
 - ``bench-diff`` — gate a BENCH/metrics snapshot against a baseline under
-  per-metric tolerance budgets (exit 1 on regression).
+  per-metric tolerance budgets (exit 1 on regression);
+- ``campaign`` — fault-tolerant multi-process solve campaigns over a
+  shared-filesystem work queue, plus the SIGKILL chaos drill
+  (docs/distributed.md).
 """
 
 from __future__ import annotations
@@ -71,6 +74,12 @@ def main(argv: list[str] | None = None) -> int:
     p_bd = sub.add_parser('bench-diff', help='Gate a BENCH/metrics snapshot against a baseline under tolerance budgets')
     add_bench_diff_args(p_bd)
     p_bd.set_defaults(func=bench_diff_main)
+
+    from .campaign import add_campaign_args, campaign_main
+
+    p_camp = sub.add_parser('campaign', help='Run a fault-tolerant multi-worker solve campaign (or its chaos drill)')
+    add_campaign_args(p_camp)
+    p_camp.set_defaults(func=campaign_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

@@ -1,0 +1,179 @@
+"""``da4ml-tpu campaign`` — fault-tolerant multi-process solve campaigns.
+
+Front end of :mod:`da4ml_tpu.parallel.campaign` (docs/distributed.md).
+Three shapes:
+
+- ``da4ml-tpu campaign corpus.npz --workers 3 --dir /shared/run1 --resume``
+  — solve a kernel corpus with N local worker processes over a
+  shared-filesystem work queue; a killed worker's kernels are stolen by
+  survivors, and re-running the same command resumes the directory.
+- ``da4ml-tpu campaign --status /shared/run1`` — live progress/liveness
+  view of a campaign directory from any process.
+- ``da4ml-tpu campaign --chaos`` — the deterministic kill-a-worker drill
+  (CI job ``campaign-chaos``): SIGKILL a fault-parked worker mid-solve and
+  assert survivors finish the corpus byte-identical to the single-process
+  reference. Exit 0 iff every check passes.
+
+Corpus formats for ``<kernels>``: ``.npz`` (one kernel per array),
+``.npy`` (one 2-D kernel, or a 3-D stack), ``.json`` (list of matrices),
+a directory of those, or the synthetic specs ``quality:N`` (the bench
+``quality_1000`` distribution, seed 1000) and ``drill:N`` (the chaos-drill
+corpus).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def add_campaign_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        'kernels',
+        nargs='?',
+        default=None,
+        help='Corpus: .npz/.npy/.json file, directory of those, or quality:N / drill:N synthetic spec',
+    )
+    parser.add_argument('--workers', '-w', type=int, default=3, help='Local worker processes (1 = in-process)')
+    parser.add_argument(
+        '--dir',
+        dest='campaign_dir',
+        default=None,
+        help='Campaign directory (shared filesystem for multi-host; default: a fresh temp dir)',
+    )
+    parser.add_argument('--resume', action='store_true', help='Continue a campaign directory with prior results')
+    parser.add_argument('--backend', default='auto', help='Solver backend (auto/jax/native-threads/pure-python)')
+    parser.add_argument('--ttl', type=float, default=30.0, help='Lease TTL seconds (steal latency ~ ttl + grace)')
+    parser.add_argument('--poll', type=float, default=0.5, help='Idle worker poll interval seconds')
+    parser.add_argument('--deadline', type=float, default=None, help='Per-solve wall-clock deadline seconds')
+    parser.add_argument('--timeout', type=float, default=3600.0, help='Whole-campaign timeout seconds')
+    parser.add_argument('--trace', action='store_true', help='Per-worker JSONL traces under <dir>/traces/')
+    parser.add_argument('--out', type=Path, default=None, help='Write the campaign report JSON to a file')
+    parser.add_argument('--json', action='store_true', help='Print the full report as JSON (default: summary line)')
+    parser.add_argument('--status', metavar='DIR', default=None, help='Print live status of a campaign directory')
+    parser.add_argument('--chaos', action='store_true', help='Run the SIGKILL chaos drill instead of a campaign')
+    parser.add_argument('--seed', type=int, default=1000, help='Seed for synthetic quality:N corpora')
+
+
+def load_corpus(spec: str, seed: int = 1000) -> list:
+    """Resolve a corpus spec (file / directory / synthetic) to kernel arrays."""
+    import numpy as np
+
+    if spec.startswith('quality:'):
+        n = int(spec.split(':', 1)[1])
+        # the exact quality_1000 sampling order (bench.py / tests_tpu)
+        rng = np.random.default_rng(seed)
+        kernels = []
+        for _ in range(n):
+            d1, d2 = int(rng.integers(2, 33)), int(rng.integers(2, 33))
+            bits = int(rng.integers(1, 9))
+            mag = rng.integers(0, 2**bits, (d1, d2)).astype(np.float64)
+            kernels.append(mag * rng.choice([-1.0, 1.0], (d1, d2)))
+        return kernels
+    if spec.startswith('drill:'):
+        from ..parallel.campaign import _drill_corpus
+
+        return _drill_corpus(n=int(spec.split(':', 1)[1]))
+
+    path = Path(spec)
+    if path.is_dir():
+        out = []
+        for p in sorted(path.iterdir()):
+            if p.suffix in ('.npy', '.npz', '.json'):
+                out.extend(load_corpus(str(p), seed=seed))
+        if not out:
+            raise ValueError(f'no .npy/.npz/.json kernels under {path}')
+        return out
+    if path.suffix == '.npz':
+        with np.load(path) as z:
+            return [np.asarray(z[name], dtype=np.float64) for name in z.files]
+    if path.suffix == '.npy':
+        arr = np.asarray(np.load(path), dtype=np.float64)
+        if arr.ndim == 2:
+            return [arr]
+        if arr.ndim == 3:
+            return [a for a in arr]
+        raise ValueError(f'{path}: expected a 2-D kernel or 3-D stack, got shape {arr.shape}')
+    if path.suffix == '.json':
+        doc = json.loads(path.read_text())
+        if isinstance(doc, dict):  # a single saved {'kernel': ...} doc
+            doc = [doc]
+        return [np.asarray(k.get('kernel', k) if isinstance(k, dict) else k, dtype=np.float64) for k in doc]
+    raise ValueError(f'unrecognized corpus spec {spec!r} (file not found or unknown suffix)')
+
+
+def campaign_main(args: argparse.Namespace) -> int:
+    from ..parallel import campaign as C
+    from ..telemetry import get_logger
+
+    log = get_logger('cli.campaign')
+
+    if args.status is not None:
+        print(json.dumps(C.campaign_status(args.status), indent=2))
+        return 0
+
+    if args.chaos:
+        kernels = load_corpus(args.kernels, seed=args.seed) if args.kernels else None
+        rep = C.chaos_drill(
+            kernels,
+            workers=max(2, args.workers),
+            base_dir=args.campaign_dir,
+            backend=args.backend if args.backend != 'auto' else 'pure-python',
+            timeout_s=args.timeout,
+            trace=args.trace,
+        )
+        if args.out is not None:
+            args.out.write_text(json.dumps(rep, indent=2, default=str))
+        print(json.dumps(rep if args.json else {'ok': rep['ok'], **rep['checks']}, indent=2, default=str))
+        return 0 if rep['ok'] else 1
+
+    if args.kernels is None:
+        log.warning('no corpus given: pass <kernels>, --status DIR, or --chaos')
+        return 2
+    try:
+        kernels = load_corpus(args.kernels, seed=args.seed)
+    except (OSError, ValueError) as exc:
+        log.warning(f'cannot load corpus {args.kernels!r}: {exc}')
+        return 2
+    try:
+        results, report = C.run_campaign(
+            kernels,
+            workers=args.workers,
+            campaign_dir=args.campaign_dir,
+            backend=args.backend,
+            resume=args.resume or args.campaign_dir is None,
+            ttl_s=args.ttl,
+            poll_s=args.poll,
+            deadline_per_solve=args.deadline,
+            timeout_s=args.timeout,
+            trace=args.trace,
+        )
+    except C.CampaignError as exc:
+        log.warning(f'campaign failed: {exc}')
+        return 1
+    if args.out is not None:
+        args.out.write_text(json.dumps(report, indent=2, default=str))
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(
+            json.dumps(
+                {
+                    'dir': report['dir'],
+                    'n_kernels': report['n_kernels'],
+                    'workers': report['workers'],
+                    'kernels_stolen': report['kernels_stolen'],
+                    'wall_s': report['wall_s'],
+                    'total_cost': sum(c for c in report['costs'] if c is not None),
+                }
+            )
+        )
+    return 0
+
+
+if __name__ == '__main__':  # pragma: no cover - convenience entry
+    ap = argparse.ArgumentParser(prog='da4ml-tpu campaign')
+    add_campaign_args(ap)
+    sys.exit(campaign_main(ap.parse_args()))

@@ -81,6 +81,29 @@ def device_inventory() -> dict:
 
 from .distributed import global_mesh, initialize as initialize_distributed  # noqa: E402
 
+_CAMPAIGN_API = (
+    'run_campaign',
+    'participate',
+    'worker_loop',
+    'chaos_drill',
+    'create_campaign',
+    'collect_results',
+    'results_to_pipelines',
+    'campaign_status',
+    'CampaignError',
+)
+
+
+def __getattr__(name):
+    # the campaign driver pulls in the solver + reliability stack; resolve
+    # lazily so mesh utilities stay cheap to import
+    if name in _CAMPAIGN_API:
+        from . import campaign
+
+        return getattr(campaign, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
 __all__ = [
     'default_mesh',
     'batch_sharding',
@@ -90,4 +113,5 @@ __all__ = [
     'global_mesh',
     'initialize_distributed',
     'device_inventory',
+    *_CAMPAIGN_API,
 ]

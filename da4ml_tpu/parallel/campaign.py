@@ -1,0 +1,738 @@
+"""Pod-scale solve campaigns: shared-filesystem work queue + work stealing.
+
+One campaign = one directory on a filesystem every worker can reach::
+
+    <campaign>/manifest.json        corpus definition (written once, O_EXCL)
+    <campaign>/kernels/<key>.json   kernel bytes, one file per unique kernel
+    <campaign>/leases/<key>.lease   live claims (reliability.lease)
+    <campaign>/results/<key>.json   finished solves, atomic + durable
+    <campaign>/failures/<key>.<n>   bounded cross-fleet retry accounting
+    <campaign>/workers/<owner>.json worker heartbeats (epoch seconds)
+
+Workers are plain processes — ``run_campaign`` spawns them locally,
+``participate`` joins the calling process (e.g. one call per
+``jax.distributed`` rank against a shared NFS/GCS-fuse dir). There is no
+coordinator: a worker loops *claim an unfinished kernel → solve → write
+result → release*, and every step is crash-safe:
+
+- a kernel is **claimed** through a lease file with a deadline; a worker
+  renews at ``ttl/3`` while solving, so a SIGKILL at any instruction lets
+  the lease expire and a survivor **steal** the kernel
+  (``campaign.kernels_stolen``);
+- a **result** is one per-kernel file written tmp+fsync+rename+dirfsync
+  (:func:`~..reliability.checkpoint.atomic_write_bytes`) — it either exists
+  completely or not at all, so a restart resumes byte-identically;
+- the corpus is **content-addressed** (:func:`~..reliability.checkpoint.kernel_key`
+  over kernel bytes + solver options): resume validates the manifest and
+  duplicate kernels collapse onto one solve.
+
+Determinism: within one backend a solve is a pure function of the kernel
+and options, so per-kernel results — and therefore the whole campaign — are
+byte-identical no matter how kernels are partitioned, stolen, or resumed.
+The chaos drill (:func:`chaos_drill`, CI job ``campaign-chaos``) asserts
+exactly that with a real mid-solve SIGKILL. Precedent: TVM's decoupled
+task-distribution model for autotuning campaigns (arxiv 1802.04799) and the
+search campaigns of arxiv 1805.08166.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import threading
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+from .. import telemetry
+from ..reliability.checkpoint import atomic_write_bytes, exclusive_create, kernel_key
+from ..reliability.faults import fault_check
+from ..reliability.lease import (
+    DEFAULT_GRACE_S,
+    claim_lease,
+    default_owner,
+    list_leases,
+    release_lease,
+    renew_lease,
+)
+from ..reliability.report import SolveReport
+
+_VERSION = 1
+
+#: a key is declared failed after this many distinct solve failures
+#: across the whole fleet (each is a full fallback-chain walk already)
+DEFAULT_MAX_FAILURES = 3
+
+#: campaign dir currently driven by this process (health endpoint reads it)
+_ACTIVE_DIR: str | None = None
+
+
+class CampaignError(RuntimeError):
+    """A campaign could not complete: corpus mismatch on resume, kernels
+    failed on every backend fleet-wide, or workers died without survivors."""
+
+
+# --------------------------------------------------------------- layout
+
+
+def _dirs(campaign_dir: str | os.PathLike) -> dict[str, Path]:
+    root = Path(campaign_dir)
+    return {
+        'root': root,
+        'kernels': root / 'kernels',
+        'leases': root / 'leases',
+        'results': root / 'results',
+        'failures': root / 'failures',
+        'workers': root / 'workers',
+        'traces': root / 'traces',
+    }
+
+
+def _jsonable_options(solver_options: dict | None) -> dict:
+    opts = dict(solver_options or {})
+    if opts.get('qintervals'):
+        opts['qintervals'] = [list(t) for t in opts['qintervals']]
+    return opts
+
+
+def create_campaign(
+    campaign_dir: str | os.PathLike,
+    kernels,
+    solver_options: dict | None = None,
+    backend: str = 'auto',
+    fallback=None,
+    resume: bool = False,
+) -> dict:
+    """Lay out (or rejoin) a campaign directory; returns the manifest.
+
+    The manifest is written through the O_EXCL gate, so any number of
+    processes may call this concurrently with the same corpus — one writes,
+    the rest validate. A corpus/options mismatch against an existing
+    manifest raises :class:`CampaignError` unless the directory is fresh;
+    ``resume=False`` additionally refuses a manifest with results already
+    present (guards against accidentally extending the wrong directory).
+    """
+    d = _dirs(campaign_dir)
+    for p in d.values():
+        p.mkdir(parents=True, exist_ok=True)
+    opts = _jsonable_options(solver_options)
+    kernels = [np.asarray(k, dtype=np.float64) for k in kernels]
+    id_opts = {'solver_options': opts, 'backend': backend}
+    key_per_kernel = [kernel_key(k, id_opts) for k in kernels]
+    keys = list(dict.fromkeys(key_per_kernel))  # unique work queue, order kept
+    manifest = {
+        'version': _VERSION,
+        'backend': backend,
+        'fallback': fallback,
+        'solver_options': opts,
+        'n_kernels': len(kernels),
+        'keys': keys,
+        'key_per_kernel': key_per_kernel,
+    }
+    payload = json.dumps(manifest, sort_keys=True)
+    man_path = d['root'] / 'manifest.json'
+    if not exclusive_create(man_path, payload.encode()):
+        existing = json.loads(man_path.read_text())
+        if {k: existing.get(k) for k in ('keys', 'solver_options', 'backend')} != {
+            'keys': keys,
+            'solver_options': opts,
+            'backend': backend,
+        }:
+            raise CampaignError(
+                f'campaign dir {campaign_dir} holds a different corpus/options manifest; '
+                f'use a fresh directory or pass the original corpus to resume'
+            )
+        if not resume and any(d['results'].glob('*.json')):
+            raise CampaignError(f'campaign dir {campaign_dir} has prior results; pass resume=True to continue it')
+        manifest = existing
+    for key, kern in zip(key_per_kernel, kernels):
+        path = d['kernels'] / f'{key}.json'
+        if not path.exists():
+            atomic_write_bytes(path, json.dumps({'key': key, 'kernel': kern.tolist()}).encode())
+    return manifest
+
+
+def load_manifest(campaign_dir: str | os.PathLike) -> dict:
+    return json.loads((Path(campaign_dir) / 'manifest.json').read_text())
+
+
+def _load_kernel(campaign_dir: str | os.PathLike, key: str) -> np.ndarray:
+    doc = json.loads((_dirs(campaign_dir)['kernels'] / f'{key}.json').read_text())
+    return np.asarray(doc['kernel'], dtype=np.float64)
+
+
+def _read_result(results_dir: Path, key: str) -> dict | None:
+    try:
+        return json.loads((results_dir / f'{key}.json').read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _done_keys(results_dir: Path) -> set[str]:
+    try:
+        return {p.name[:-5] for p in results_dir.glob('*.json')}
+    except OSError:
+        return set()
+
+
+# --------------------------------------------------------------- heartbeats
+
+
+def _safe_owner(owner: str) -> str:
+    return owner.replace(os.sep, '_')
+
+
+def _beat_worker(workers_dir: Path, owner: str, done: int) -> None:
+    """Cross-process liveness: one atomically-rewritten file per worker
+    carrying a wall-clock stamp, plus the in-process telemetry beat that
+    feeds this process's own ``/healthz``."""
+    doc = {'owner': owner, 'pid': os.getpid(), 'ts': round(time.time(), 3), 'done': done}
+    atomic_write_bytes(workers_dir / f'{_safe_owner(owner)}.json', json.dumps(doc).encode())
+    telemetry.beat('campaign')
+    telemetry.gauge('campaign.heartbeat_age_s').set(0.0)
+
+
+def _workers_seen(workers_dir: Path) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    try:
+        entries = sorted(workers_dir.glob('*.json'))
+    except OSError:
+        return out
+    now = time.time()
+    for p in entries:
+        try:
+            doc = json.loads(p.read_text())
+            doc['age_s'] = round(now - float(doc.get('ts', 0.0)), 3)
+            out[doc.get('owner', p.stem)] = doc
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def campaign_status(campaign_dir: str | os.PathLike, stall_s: float = 60.0) -> dict:
+    """Live view of a campaign directory (any process, scrape-safe)."""
+    d = _dirs(campaign_dir)
+    try:
+        n_total = len(load_manifest(campaign_dir)['keys'])
+    except (OSError, ValueError, KeyError):
+        n_total = None
+    done = len(_done_keys(d['results']))
+    workers = _workers_seen(d['workers'])
+    stalled = sorted(o for o, w in workers.items() if w['age_s'] > stall_s)
+    in_progress = n_total is not None and done < n_total
+    return {
+        'dir': str(d['root']),
+        'done': done,
+        'total': n_total,
+        'in_progress': in_progress,
+        'workers_alive': len(workers) - len(stalled),
+        'workers': {o: {'age_s': w['age_s'], 'done': w.get('done')} for o, w in workers.items()},
+        'stalled': stalled,
+        'leases': len(list_leases(d['leases'])),
+    }
+
+
+def worker_health(stall_s: float = 60.0) -> dict | None:
+    """Campaign worker liveness for ``/healthz`` (None outside a campaign).
+    Read via ``sys.modules`` by ``telemetry.obs.health`` so a scrape never
+    imports this module."""
+    if _ACTIVE_DIR is None:
+        return None
+    try:
+        return campaign_status(_ACTIVE_DIR, stall_s=stall_s)
+    except OSError:  # pragma: no cover - campaign dir vanished mid-scrape
+        return None
+
+
+# --------------------------------------------------------------- worker
+
+
+class _Renewer(threading.Thread):
+    """Renews one held lease at ttl/3 cadence until stopped (daemon: dies
+    with the process, which is exactly what lets survivors steal)."""
+
+    def __init__(self, lease, interval_s: float):
+        super().__init__(name=f'da4ml-lease-renew-{lease.key[:8]}', daemon=True)
+        self.lease = lease
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if not renew_lease(self.lease):
+                return  # stolen out from under us; solve result stays idempotent
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _record_failure(d: dict[str, Path], key: str, owner: str, exc: BaseException, max_failures: int) -> int:
+    """Bounded fleet-wide retry: one O_EXCL marker per failure. Returns the
+    failure count; at ``max_failures`` a terminal failed-result doc is
+    written so the campaign completes instead of ping-ponging forever."""
+    doc = json.dumps({'key': key, 'owner': owner, 'error': f'{type(exc).__name__}: {exc}'[:300]}).encode()
+    for n in range(max_failures):
+        if exclusive_create(d['failures'] / f'{key}.{n}.json', doc):
+            count = n + 1
+            break
+    else:
+        count = max_failures
+    if count >= max_failures and not (d['results'] / f'{key}.json').exists():
+        atomic_write_bytes(
+            d['results'] / f'{key}.json',
+            json.dumps(
+                {'version': _VERSION, 'key': key, 'failed': True, 'error': f'{type(exc).__name__}: {exc}'[:300]}
+            ).encode(),
+        )
+    return count
+
+
+def worker_loop(
+    campaign_dir: str | os.PathLike,
+    owner: str | None = None,
+    ttl_s: float = 30.0,
+    poll_s: float = 0.5,
+    grace_s: float | None = None,
+    deadline_per_solve: float | None = None,
+    max_kernels: int | None = None,
+    max_failures: int = DEFAULT_MAX_FAILURES,
+) -> dict:
+    """Drive one worker until the campaign is complete; returns a summary
+    ``{'owner', 'solved', 'stolen', 'duration_s', ...}``.
+
+    Safe to run in any number of processes against the same directory.
+    ``max_kernels`` bounds this worker's own contribution (tests; draining
+    a worker before maintenance).
+    """
+    global _ACTIVE_DIR
+    from ..reliability.orchestrator import solve_orchestrated
+
+    d = _dirs(campaign_dir)
+    manifest = load_manifest(campaign_dir)
+    keys: list[str] = list(manifest['keys'])
+    owner = owner or default_owner('w')
+    grace = grace_s if grace_s is not None else max(DEFAULT_GRACE_S, ttl_s / 3)
+    # rotate the scan order per owner so a fleet doesn't hammer key 0
+    i0 = zlib.crc32(owner.encode()) % max(1, len(keys))
+    order = keys[i0:] + keys[:i0]
+
+    _ACTIVE_DIR = str(d['root'])
+    solved: list[str] = []
+    stolen = 0
+    report = SolveReport()
+    t0 = time.monotonic()
+    telemetry.gauge('campaign.total').set(len(keys))
+    with telemetry.span('campaign.worker', owner=owner, n_kernels=len(keys)):
+        while True:
+            done = _done_keys(d['results'])
+            _beat_worker(d['workers'], owner, len(done))
+            telemetry.gauge('campaign.done').set(len(done))
+            telemetry.gauge('campaign.workers_alive').set(campaign_status(campaign_dir, stall_s=3 * ttl_s)['workers_alive'])
+            missing = [k for k in order if k not in done]
+            if not missing or (max_kernels is not None and len(solved) >= max_kernels):
+                break
+            lease = None
+            for key in missing:
+                lease = claim_lease(d['leases'], key, owner=owner, ttl_s=ttl_s, grace_s=grace)
+                if lease is not None:
+                    break
+            if lease is None:
+                # everything unfinished is live-leased by someone else:
+                # wait for results to land or leases to expire
+                time.sleep(poll_s)
+                continue
+            telemetry.counter('campaign.claims').inc()
+            if lease.stolen_from:
+                stolen += 1
+                telemetry.counter('campaign.kernels_stolen').inc()
+                telemetry.instant('campaign.steal', key=lease.key, owner=owner, stolen_from=lease.stolen_from)
+            renewer = _Renewer(lease, interval_s=ttl_s / 3.0)
+            renewer.start()
+            try:
+                # chaos-drill site: a planned sleep here parks the worker
+                # mid-solve with the lease held (renewed by the daemon
+                # thread), the exact state a SIGKILL must recover from
+                fault_check('campaign.solve')
+                t_k = time.monotonic()
+                with telemetry.span('campaign.kernel', key=lease.key, owner=owner):
+                    try:
+                        pipe = solve_orchestrated(
+                            _load_kernel(campaign_dir, lease.key),
+                            dict(manifest['solver_options']),
+                            backend=manifest['backend'],
+                            fallback=manifest.get('fallback'),
+                            deadline=deadline_per_solve,
+                            report=report,
+                        )
+                    except Exception as exc:
+                        n_fail = _record_failure(d, lease.key, owner, exc, max_failures)
+                        telemetry.counter('campaign.kernel_failures').inc()
+                        telemetry.instant('campaign.kernel_failed', key=lease.key, n=n_fail, error=type(exc).__name__)
+                        continue
+                doc = {
+                    'version': _VERSION,
+                    'key': lease.key,
+                    'cost': float(pipe.cost),
+                    'backend': report.backend_used,
+                    'owner': owner,
+                    'stolen_from': lease.stolen_from,
+                    'duration_s': round(time.monotonic() - t_k, 6),
+                    'pipeline': pipe.to_dict(),
+                }
+                atomic_write_bytes(d['results'] / f'{lease.key}.json', json.dumps(doc).encode())
+                solved.append(lease.key)
+                # kill-after-durable-result drill point (mirrors
+                # checkpoint.post_save): the result above survives this
+                fault_check('campaign.post_result')
+            finally:
+                renewer.stop()
+                release_lease(lease)
+    done = _done_keys(d['results'])
+    _beat_worker(d['workers'], owner, len(done))
+    telemetry.gauge('campaign.done').set(len(done))
+    return {
+        'owner': owner,
+        'solved': solved,
+        'n_solved': len(solved),
+        'stolen': stolen,
+        'checkpoint_hits': len(keys) - len(solved),
+        'duration_s': round(time.monotonic() - t0, 6),
+        'complete': len(done) >= len(keys),
+    }
+
+
+def participate(
+    campaign_dir: str | os.PathLike,
+    kernels,
+    solver_options: dict | None = None,
+    backend: str = 'auto',
+    **worker_kw,
+) -> tuple[list, dict]:
+    """Join the calling process to a shared campaign: ensure the manifest
+    (O_EXCL; all participants must pass the same corpus), run a worker to
+    completion, and collect. This is the one call per ``jax.distributed``
+    rank — the work queue partitions dynamically over however many ranks
+    show up, and survivors absorb dead ranks' kernels."""
+    create_campaign(campaign_dir, kernels, solver_options, backend=backend, resume=True)
+    summary = worker_loop(campaign_dir, **worker_kw)
+    return collect_results(campaign_dir), summary
+
+
+# --------------------------------------------------------------- collect
+
+
+def collect_results(campaign_dir: str | os.PathLike, allow_failed: bool = False) -> list[dict]:
+    """Result docs in original corpus order (duplicates fan back out).
+
+    Raises :class:`CampaignError` on missing results (campaign still in
+    flight / workers all died) or terminally-failed kernels (unless
+    ``allow_failed``). Every doc carries ``key``/``cost``/``backend``/
+    ``owner``/``pipeline`` — byte-stable per key regardless of which worker
+    produced it.
+    """
+    d = _dirs(campaign_dir)
+    manifest = load_manifest(campaign_dir)
+    out, missing, failed = [], [], []
+    for key in manifest['key_per_kernel']:
+        doc = _read_result(d['results'], key)
+        if doc is None:
+            missing.append(key)
+        elif doc.get('failed'):
+            failed.append(key)
+            out.append(doc)
+        else:
+            out.append(doc)
+    if missing:
+        raise CampaignError(f'campaign incomplete: {len(missing)}/{len(manifest["key_per_kernel"])} results missing')
+    if failed and not allow_failed:
+        raise CampaignError(f'{len(failed)} kernels failed on every backend fleet-wide: {failed[:4]}')
+    return out
+
+
+def results_to_pipelines(results: list[dict]):
+    from ..ir.comb import Pipeline
+
+    return [Pipeline.from_dict(doc['pipeline']) for doc in results]
+
+
+# --------------------------------------------------------------- driver
+
+
+def _repo_pythonpath(env: dict) -> dict:
+    """Child processes must resolve the same da4ml_tpu this parent runs."""
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    env['PYTHONPATH'] = pkg_root + os.pathsep + env.get('PYTHONPATH', '') if env.get('PYTHONPATH') else pkg_root
+    return env
+
+
+def _spawn_worker(
+    campaign_dir: str | os.PathLike,
+    owner: str,
+    ttl_s: float,
+    poll_s: float,
+    deadline_per_solve: float | None,
+    env: dict | None = None,
+    trace: bool = False,
+) -> subprocess.Popen:
+    cmd = [
+        sys.executable,
+        '-m',
+        'da4ml_tpu.parallel.campaign',
+        '--worker',
+        str(campaign_dir),
+        '--owner',
+        owner,
+        '--ttl',
+        str(ttl_s),
+        '--poll',
+        str(poll_s),
+    ]
+    if deadline_per_solve is not None:
+        cmd += ['--deadline', str(deadline_per_solve)]
+    env = _repo_pythonpath(dict(os.environ if env is None else env))
+    # children never inherit the parent's trace file or metrics port: N
+    # workers appending one trace (or binding one port) corrupts both.
+    # Worker tracing is opt-in and lands per-owner under <campaign>/traces/.
+    env.pop('DA4ML_METRICS_PORT', None)
+    if trace:
+        env['DA4ML_TRACE'] = str(_dirs(campaign_dir)['traces'] / f'{_safe_owner(owner)}.jsonl')
+    else:
+        env.pop('DA4ML_TRACE', None)
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed((text or '').strip().splitlines()):
+        if line.startswith('{'):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def run_campaign(
+    kernels,
+    workers: int = 3,
+    campaign_dir: str | os.PathLike | None = None,
+    solver_options: dict | None = None,
+    backend: str = 'auto',
+    fallback=None,
+    resume: bool = True,
+    ttl_s: float = 30.0,
+    poll_s: float = 0.5,
+    deadline_per_solve: float | None = None,
+    timeout_s: float = 3600.0,
+    trace: bool = False,
+) -> tuple[list[dict], dict]:
+    """Solve a corpus with ``workers`` local processes; returns
+    ``(result docs in corpus order, campaign report)``.
+
+    ``workers <= 1`` runs in-process (the single-process reference the chaos
+    drill compares against). A worker crash mid-campaign is absorbed: its
+    leases expire and survivors steal the kernels; only losing *every*
+    worker raises (and even then the directory resumes where it stopped).
+    """
+    global _ACTIVE_DIR
+    if campaign_dir is None:
+        import tempfile
+
+        campaign_dir = tempfile.mkdtemp(prefix='da4ml-campaign-')
+    create_campaign(campaign_dir, kernels, solver_options, backend=backend, fallback=fallback, resume=resume)
+    t0 = time.monotonic()
+    report: dict = {'dir': str(campaign_dir), 'workers': workers}
+    with telemetry.span('campaign.run', n_kernels=len(load_manifest(campaign_dir)['keys']), workers=workers):
+        if workers <= 1:
+            summary = worker_loop(
+                campaign_dir, ttl_s=ttl_s, poll_s=poll_s, deadline_per_solve=deadline_per_solve
+            )
+            report['worker_summaries'] = [summary]
+        else:
+            _ACTIVE_DIR = str(campaign_dir)
+            procs = [
+                _spawn_worker(campaign_dir, f'{default_owner()}:w{i}', ttl_s, poll_s, deadline_per_solve, trace=trace)
+                for i in range(workers)
+            ]
+            summaries, failures = [], []
+            deadline = time.monotonic() + timeout_s
+            try:
+                for p in procs:
+                    try:
+                        out, err = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        out, err = p.communicate()
+                        failures.append({'pid': p.pid, 'rc': 'timeout'})
+                        continue
+                    summary = _last_json_line(out)
+                    if p.returncode == 0 and summary is not None:
+                        summaries.append(summary)
+                    else:
+                        failures.append(
+                            {'pid': p.pid, 'rc': p.returncode, 'stderr': (err or '').strip()[-300:]}
+                        )
+            finally:
+                for p in procs:
+                    if p.poll() is None:  # pragma: no cover - timeout cleanup
+                        p.kill()
+            report['worker_summaries'] = summaries
+            if failures:
+                report['worker_failures'] = failures
+            if not summaries and failures:
+                raise CampaignError(f'every campaign worker died: {failures}')
+    results = collect_results(campaign_dir)
+    report['n_kernels'] = len(results)
+    report['kernels_stolen'] = sum(s.get('stolen', 0) for s in report['worker_summaries'])
+    report['wall_s'] = round(time.monotonic() - t0, 6)
+    report['costs'] = [doc.get('cost') for doc in results]
+    telemetry.instant('campaign.complete', **{k: report[k] for k in ('n_kernels', 'kernels_stolen', 'wall_s')})
+    return results, report
+
+
+# --------------------------------------------------------------- chaos drill
+
+
+def _drill_corpus(n: int = 6, dim: int = 8, bits: int = 3) -> list[np.ndarray]:
+    rng = np.random.default_rng(20260804)
+    return [
+        (rng.integers(0, 2**bits, (dim, dim)) * rng.choice([-1.0, 1.0], (dim, dim))).astype(np.float64)
+        for _ in range(n)
+    ]
+
+
+def chaos_drill(
+    kernels=None,
+    workers: int = 3,
+    base_dir: str | os.PathLike | None = None,
+    backend: str = 'pure-python',
+    solver_options: dict | None = None,
+    ttl_s: float = 2.0,
+    poll_s: float = 0.2,
+    victim_stall_s: float = 120.0,
+    timeout_s: float = 420.0,
+    trace: bool = False,
+) -> dict:
+    """Deterministic kill-a-worker drill; returns a report with ``ok``.
+
+    Sequence: (1) solve the corpus single-process — the byte-identity
+    reference; (2) start ``workers`` subprocess workers on a fresh campaign
+    dir, with worker 0 (the victim) fault-injected to park mid-solve
+    (``campaign.solve=sleep``) while its lease renews; (3) wait until the
+    victim provably holds a lease, then SIGKILL it; (4) survivors steal the
+    victim's kernel after lease expiry and finish the corpus. Passes iff the
+    corpus completed, at least one kernel was stolen, nothing was lost or
+    double-reported, and every per-kernel result is byte-identical to the
+    single-process reference.
+    """
+    import tempfile
+
+    kernels = _drill_corpus() if kernels is None else list(kernels)
+    base = Path(base_dir) if base_dir is not None else Path(tempfile.mkdtemp(prefix='da4ml-chaos-'))
+    report: dict = {'base_dir': str(base), 'workers': workers, 'n_kernels': len(kernels)}
+
+    # (1) single-process reference
+    ref_results, ref_report = run_campaign(
+        kernels, workers=1, campaign_dir=base / 'reference', solver_options=solver_options, backend=backend
+    )
+    ref_blobs = {doc['key']: json.dumps(doc['pipeline'], sort_keys=True) for doc in ref_results}
+    report['reference_wall_s'] = ref_report['wall_s']
+
+    # (2) the drill campaign: victim + survivors
+    drill_dir = base / 'drill'
+    create_campaign(drill_dir, kernels, solver_options, backend=backend)
+    victim_owner = f'{default_owner()}:victim'
+    victim_env = dict(os.environ, DA4ML_FAULT_INJECT=f'campaign.solve=sleep:1:{victim_stall_s}')
+    victim = _spawn_worker(drill_dir, victim_owner, ttl_s, poll_s, None, env=victim_env, trace=trace)
+    survivors = [
+        _spawn_worker(drill_dir, f'{default_owner()}:survivor{i}', ttl_s, poll_s, None, trace=trace)
+        for i in range(workers - 1)
+    ]
+    deadline = time.monotonic() + timeout_s
+    try:
+        # (3) SIGKILL the victim only once it provably holds a lease
+        victim_key = None
+        while time.monotonic() < deadline and victim_key is None:
+            for key, doc in list_leases(_dirs(drill_dir)['leases']).items():
+                if doc.get('owner') == victim_owner:
+                    victim_key = key
+                    break
+            if victim_key is None:
+                if victim.poll() is not None:
+                    raise CampaignError(f'victim exited before claiming a lease: {victim.communicate()[1][-300:]}')
+                time.sleep(0.05)
+        report['victim_claimed_key'] = victim_key
+        if victim_key is None:
+            raise CampaignError('victim never claimed a lease within the drill timeout')
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.communicate()
+        report['victim_rc'] = victim.returncode
+
+        # (4) survivors must finish the corpus alone
+        summaries = []
+        for p in survivors:
+            out, err = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
+            if p.returncode != 0:
+                raise CampaignError(f'survivor rc={p.returncode}: {(err or "")[-300:]}')
+            summaries.append(_last_json_line(out) or {})
+    finally:
+        for p in [victim, *survivors]:
+            if p.poll() is None:
+                p.kill()
+
+    results = collect_results(drill_dir)
+    blobs = {doc['key']: json.dumps(doc['pipeline'], sort_keys=True) for doc in results}
+    owners = {doc['key']: doc['owner'] for doc in results}
+    report['survivor_summaries'] = summaries
+    report['kernels_stolen'] = sum(s.get('stolen', 0) for s in summaries)
+    report['victim_kernel_owner'] = owners.get(victim_key)
+    report['n_results'] = len(results)
+    report['unique_keys'] = len(blobs)
+    report['byte_identical'] = blobs == ref_blobs
+    report['costs'] = [doc['cost'] for doc in results]
+    report['checks'] = {
+        'corpus_complete': len(results) == len(kernels) and len(blobs) == len(ref_blobs),
+        'byte_identical_to_reference': report['byte_identical'],
+        'victim_killed': report['victim_rc'] != 0,
+        'kernel_stolen': report['kernels_stolen'] >= 1,
+        'victim_kernel_rescued': owners.get(victim_key) not in (None, victim_owner),
+    }
+    report['ok'] = all(report['checks'].values())
+    return report
+
+
+# --------------------------------------------------------------- worker entry
+
+
+def _worker_main(argv: list[str]) -> int:
+    """``python -m da4ml_tpu.parallel.campaign --worker <dir> ...`` — the
+    subprocess entry behind ``run_campaign`` / the campaign CLI. Prints one
+    JSON summary line (last-line-wins, like bench sections)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog='da4ml_tpu.parallel.campaign')
+    ap.add_argument('--worker', required=True, metavar='DIR')
+    ap.add_argument('--owner', default=None)
+    ap.add_argument('--ttl', type=float, default=30.0)
+    ap.add_argument('--poll', type=float, default=0.5)
+    ap.add_argument('--deadline', type=float, default=None)
+    ap.add_argument('--max-kernels', type=int, default=None)
+    args = ap.parse_args(argv)
+    summary = worker_loop(
+        args.worker,
+        owner=args.owner,
+        ttl_s=args.ttl,
+        poll_s=args.poll,
+        deadline_per_solve=args.deadline,
+        max_kernels=args.max_kernels,
+    )
+    print(json.dumps(summary), flush=True)
+    return 0 if summary['complete'] else 3
+
+
+if __name__ == '__main__':
+    sys.exit(_worker_main(sys.argv[1:]))

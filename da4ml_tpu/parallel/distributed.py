@@ -19,6 +19,32 @@ from __future__ import annotations
 
 import os
 
+#: rendezvous budget defaults; override with DA4ML_DIST_CONNECT_RETRIES /
+#: DA4ML_DIST_CONNECT_TIMEOUT_S (docs/distributed.md)
+DEFAULT_CONNECT_RETRIES = 3
+DEFAULT_CONNECT_TIMEOUT_S = 60.0
+
+
+def connect_budget() -> tuple[int, float]:
+    """(retries, timeout_s) for the coordinator rendezvous, env-overridable.
+
+    ``DA4ML_DIST_CONNECT_RETRIES`` bounds how many times a transient connect
+    failure is retried (0 disables retry); ``DA4ML_DIST_CONNECT_TIMEOUT_S``
+    bounds each attempt (forwarded to ``jax.distributed.initialize`` as
+    ``initialization_timeout`` when the running jax supports it) and shapes
+    the backoff ceiling. Bad values fall back to the defaults rather than
+    failing a pod bring-up over a typo.
+    """
+    try:
+        retries = int(os.environ.get('DA4ML_DIST_CONNECT_RETRIES', '') or DEFAULT_CONNECT_RETRIES)
+    except ValueError:
+        retries = DEFAULT_CONNECT_RETRIES
+    try:
+        timeout_s = float(os.environ.get('DA4ML_DIST_CONNECT_TIMEOUT_S', '') or DEFAULT_CONNECT_TIMEOUT_S)
+    except ValueError:
+        timeout_s = DEFAULT_CONNECT_TIMEOUT_S
+    return max(0, retries), max(1.0, timeout_s)
+
 
 def initialize(
     coordinator_address: str | None = None,
@@ -73,10 +99,22 @@ def initialize(
 
     # Explicitly configured rendezvous: the coordinator may not be listening
     # yet (worker raced ahead of rank 0, pod still scheduling) — a transient,
-    # not a config error. Retry with backoff + jitter before surfacing;
-    # DA4ML_DIST_CONNECT_RETRIES overrides the budget (0 disables).
+    # not a config error. Retry with backoff + jitter before surfacing
+    # (each retry sleep lands in the `retry.sleeps` / `retry.delay_s`
+    # metrics via retry_call); DA4ML_DIST_CONNECT_RETRIES /
+    # DA4ML_DIST_CONNECT_TIMEOUT_S override the budget (connect_budget).
     from ..reliability.faults import fault_check
     from ..reliability.retry import retry_call
+
+    retries, timeout_s = connect_budget()
+    if 'initialization_timeout' not in kwargs:
+        import inspect
+
+        try:
+            if 'initialization_timeout' in inspect.signature(jax.distributed.initialize).parameters:
+                kwargs['initialization_timeout'] = int(timeout_s)
+        except (TypeError, ValueError):  # pragma: no cover - exotic jax builds
+            pass
 
     def _connect():
         fault_check('distributed.init')
@@ -95,8 +133,10 @@ def initialize(
         msg = str(exc).lower()  # gRPC surfaces as RuntimeError; match the
         return any(m in msg for m in ('connect', 'deadline', 'unavailable', 'timed out'))  # rendezvous flakes only
 
-    retries = int(os.environ.get('DA4ML_DIST_CONNECT_RETRIES', '3') or 0)
-    retry_call(_connect, retries=retries, base_delay=0.5, max_delay=10.0, retry_on=_is_connect_flake)
+    # backoff ceiling scales with the per-attempt budget so the whole walk
+    # (attempts + sleeps) stays within the same order as the configured
+    # timeout instead of a hardcoded 10 s cap
+    retry_call(_connect, retries=retries, base_delay=0.5, max_delay=max(1.0, timeout_s / 4.0), retry_on=_is_connect_flake)
     return jax.process_count() > 1
 
 

@@ -11,7 +11,10 @@ pieces:
 - :mod:`.breaker`     — per-backend circuit breakers
 - :mod:`.orchestrator`— the ``jax → native-threads → pure-python`` chain,
   :class:`SolveReport`, checkpointed ``solve_many``, runtime ``run_program``
-- :mod:`.checkpoint`  — atomic-write JSON campaign checkpoints
+- :mod:`.checkpoint`  — atomic-write JSON campaign checkpoints + durable
+  write primitives (``atomic_write_bytes``, ``exclusive_create``)
+- :mod:`.lease`       — lease-file work claims with expiry + work stealing
+  (the coordination primitive of ``parallel.campaign``)
 - :mod:`.faults`      — ``DA4ML_FAULT_INJECT`` + :class:`fault_injection`
 
 ``cmvm.api.solve`` routes through this layer by default (disable with
@@ -20,7 +23,15 @@ usable standalone.
 """
 
 from .breaker import CircuitBreaker, breaker_for, reset_all_breakers
-from .checkpoint import CheckpointStore, kernel_key, reset_store_cache, store_for
+from .checkpoint import (
+    CheckpointStore,
+    atomic_write_bytes,
+    exclusive_create,
+    fsync_dir,
+    kernel_key,
+    reset_store_cache,
+    store_for,
+)
 from .deadline import run_with_deadline
 from .errors import (
     BackendUnavailable,
@@ -31,6 +42,15 @@ from .errors import (
     classify,
 )
 from .faults import fault_active, fault_check, fault_injection, parse_spec
+from .lease import (
+    Lease,
+    claim_lease,
+    default_owner,
+    list_leases,
+    read_lease,
+    release_lease,
+    renew_lease,
+)
 from .orchestrator import (
     DEFAULT_CHAIN,
     canonical_backend,
@@ -59,6 +79,16 @@ __all__ = [
     'kernel_key',
     'store_for',
     'reset_store_cache',
+    'atomic_write_bytes',
+    'exclusive_create',
+    'fsync_dir',
+    'Lease',
+    'claim_lease',
+    'renew_lease',
+    'release_lease',
+    'read_lease',
+    'list_leases',
+    'default_owner',
     'fault_check',
     'fault_active',
     'fault_injection',

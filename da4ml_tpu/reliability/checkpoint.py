@@ -37,6 +37,61 @@ from .faults import fault_active, fault_check
 _VERSION = 1
 
 
+def fsync_dir(path: str | os.PathLike) -> None:
+    """fsync a directory so a rename/create inside it is durable.
+
+    Without this, ``os.replace`` is atomic against crashes of *this process*
+    but the new directory entry may still be lost to power loss or a
+    container kill — the fsync'd file contents survive, the name does not.
+    Best-effort: some filesystems refuse O_RDONLY dir fsync.
+    """
+    try:
+        dfd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - platform-dependent
+        pass
+
+
+def atomic_write_bytes(path: str | os.PathLike, payload: bytes) -> None:
+    """Durable atomic file write: tmp in the same directory, fsync, rename
+    over the target, fsync the parent directory. A kill at any instruction
+    leaves either the old complete file or the new complete file."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + f'.tmp{os.getpid()}')
+    with open(tmp, 'wb') as fh:
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path.parent)
+
+
+def exclusive_create(path: str | os.PathLike, payload: bytes) -> bool:
+    """Atomically create ``path`` with ``payload`` iff it does not exist.
+
+    The ``O_EXCL`` claim primitive behind lease files (:mod:`.lease`): of any
+    number of concurrent callers exactly one returns True. The payload is
+    fsync'd and the parent directory fsync'd before returning, so a claim
+    that this process observed as won is durable.
+    """
+    path = Path(path)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, payload)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    fsync_dir(path.parent)
+    return True
+
+
 def kernel_key(kernel, opts: dict | None = None) -> str:
     """Content hash of a kernel matrix + the solver options that shape its
     solution. Two campaigns agree on a key iff the solve would be identical."""
@@ -108,21 +163,7 @@ class CheckpointStore:
         payload = json.dumps(doc)
         if fault_active('checkpoint.write', 'corrupt'):
             payload = payload[: max(1, len(payload) // 2)]  # torn write
-        tmp = self.path.with_suffix(self.path.suffix + f'.tmp{os.getpid()}')
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        with open(tmp, 'w') as fh:
-            fh.write(payload)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self.path)
-        try:  # make the rename itself durable
-            dfd = os.open(self.path.parent, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:  # pragma: no cover - platform-dependent
-            pass
+        atomic_write_bytes(self.path, payload.encode())
 
 
 _store_cache: dict[str, CheckpointStore] = {}

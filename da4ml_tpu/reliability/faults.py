@@ -24,7 +24,10 @@ Spec grammar (comma-separated entries)::
 Instrumented sites (kept in docs/reliability.md): ``cmvm.solve``,
 ``cmvm.jax``, ``cmvm.native``, ``cmvm.cpu``, ``native.load_lib``,
 ``runtime.jax``, ``distributed.init``, ``checkpoint.write``,
-``checkpoint.post_save``, and ``ir.mutate.<corruption>`` (mode ``corrupt``;
+``checkpoint.post_save``, ``lease.claim``, ``campaign.solve`` (a planned
+``sleep`` here parks a campaign worker mid-solve with its lease held — the
+chaos drill's SIGKILL target), ``campaign.post_result`` (kill-after-durable
+-result resume drills), and ``ir.mutate.<corruption>`` (mode ``corrupt``;
 arms one entry of the IR verifier's mutation catalog, analysis/mutation.py).
 """
 

@@ -1,0 +1,246 @@
+"""Lease-based work claims over a shared filesystem.
+
+The coordination primitive behind pod-scale solve campaigns
+(``parallel.campaign``, docs/distributed.md): each unit of work (a kernel)
+is guarded by one *lease file* in a shared directory. A worker owns a
+kernel iff it holds a live lease on it; a worker that dies or stalls simply
+stops renewing, its lease expires, and a survivor **steals** the kernel.
+No coordinator process, no network protocol — only three filesystem
+primitives that are atomic on POSIX (and NFSv3+):
+
+- **claim**  — ``open(O_CREAT|O_EXCL)`` of the lease file: of any number of
+  concurrent claimants exactly one wins (:func:`~.checkpoint.exclusive_create`).
+- **renew**  — durable rewrite (tmp+fsync+rename+dirfsync) extending the
+  deadline; owners renew at ``ttl/3`` cadence while working.
+- **steal**  — ``rename`` of an *expired* lease file to a per-stealer
+  tombstone: two racing stealers cannot both succeed (the second rename
+  fails with ENOENT), and the winner then re-claims through the same
+  O_EXCL gate.
+
+Lease file format (JSON, one object)::
+
+    {"version": 1, "key": "<work key>", "owner": "<host>:<pid>[:tag]",
+     "pid": 1234, "host": "worker-3", "created_at": <epoch s>,
+     "renewed_at": <epoch s>, "expires_at": <epoch s>, "generation": 2,
+     "stolen_from": "<previous owner>" | null}
+
+Deadlines are wall-clock epoch seconds: leases must be comparable across
+processes *and hosts* sharing the filesystem, which rules out per-boot
+monotonic clocks. Two safety margins absorb clock skew and renew/steal
+races: a lease is only stealable ``grace_s`` past ``expires_at``, and the
+deadline only moves forward (a renewal never shortens it). An owner learns
+it lost a stolen lease at the next :func:`renew_lease` (returns False) —
+with renew cadence ``ttl/3 < grace_s`` an owner that can still run renews
+long before anyone may steal, so a steal implies the owner was dead or
+stalled for at least ``ttl/3 + grace_s``.
+
+Duplicate solves are possible by design in one corner — owner stalls past
+the grace, then wakes — and harmless: campaign results are idempotent
+per-key files, and a solve is deterministic per backend, so the last
+writer rewrites identical bytes (docs/distributed.md#failure-model).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .. import telemetry
+from .checkpoint import atomic_write_bytes, exclusive_create, fsync_dir
+from .faults import fault_check
+
+_VERSION = 1
+
+#: a lease is stealable this many seconds past its deadline (clock-skew +
+#: renewal-latency margin; keep > ttl/3, the renew cadence)
+DEFAULT_GRACE_S = 1.0
+
+
+def default_owner(tag: str | None = None) -> str:
+    """A process-unique owner id: ``<host>:<pid>`` (+ optional tag)."""
+    base = f'{socket.gethostname()}:{os.getpid()}'
+    return f'{base}:{tag}' if tag else base
+
+
+@dataclass
+class Lease:
+    """A held claim on one work key. Returned by :func:`claim_lease`;
+    pass back to :func:`renew_lease` / :func:`release_lease`."""
+
+    path: Path
+    key: str
+    owner: str
+    ttl_s: float
+    expires_at: float
+    generation: int = 0
+    stolen_from: str | None = None
+    lost: bool = field(default=False, compare=False)
+
+    def remaining_s(self) -> float:
+        return self.expires_at - time.time()
+
+    def _doc(self) -> dict:
+        return {
+            'version': _VERSION,
+            'key': self.key,
+            'owner': self.owner,
+            'pid': os.getpid(),
+            'host': socket.gethostname(),
+            'created_at': round(self.expires_at - self.ttl_s, 6),
+            'renewed_at': round(time.time(), 6),
+            'expires_at': round(self.expires_at, 6),
+            'generation': self.generation,
+            'stolen_from': self.stolen_from,
+        }
+
+
+def read_lease(path: str | os.PathLike) -> dict | None:
+    """Parse a lease file; None when absent or torn (a crash between the
+    O_EXCL create and the payload write leaves an empty file)."""
+    try:
+        text = Path(path).read_text()
+        doc = json.loads(text)
+        if not isinstance(doc, dict) or 'owner' not in doc or 'expires_at' not in doc:
+            return None
+        return doc
+    except (OSError, ValueError):
+        return None
+
+
+def _stealable(path: Path, doc: dict | None, grace_s: float) -> bool:
+    """Expired (or unreadable-and-stale) leases may be stolen."""
+    if doc is not None:
+        return time.time() > float(doc['expires_at']) + grace_s
+    # torn/empty lease: no deadline to read — steal once the *file* is old
+    # enough that no live claimant can still be between create and write
+    try:
+        return time.time() - path.stat().st_mtime > grace_s
+    except OSError:
+        return False  # vanished: released or stolen; re-claim via O_EXCL
+
+
+def claim_lease(
+    lease_dir: str | os.PathLike,
+    key: str,
+    owner: str | None = None,
+    ttl_s: float = 30.0,
+    steal: bool = True,
+    grace_s: float = DEFAULT_GRACE_S,
+) -> Lease | None:
+    """Try to claim ``key``; returns a held :class:`Lease` or None.
+
+    An expired lease is reclaimed (``steal=True``): the stale file is
+    atomically renamed to a tombstone — exactly one stealer wins the rename
+    — and the winner claims fresh through the O_EXCL gate.
+    ``lease.stolen_from`` records the previous owner for the campaign's
+    ``campaign.kernels_stolen`` accounting.
+    """
+    fault_check('lease.claim')
+    owner = owner or default_owner()
+    lease_dir = Path(lease_dir)
+    lease_dir.mkdir(parents=True, exist_ok=True)
+    path = lease_dir / f'{key}.lease'
+    lease = Lease(path=path, key=key, owner=owner, ttl_s=ttl_s, expires_at=time.time() + ttl_s)
+    if exclusive_create(path, json.dumps(lease._doc()).encode()):
+        telemetry.counter('lease.claims').inc()
+        return lease
+    doc = read_lease(path)
+    if doc is not None and doc.get('owner') == owner:
+        # our own live lease (e.g. claim retried after a crash-resume
+        # within the ttl): adopt it instead of waiting out the deadline
+        lease.expires_at = float(doc['expires_at'])
+        lease.generation = int(doc.get('generation', 0))
+        lease.stolen_from = doc.get('stolen_from')
+        return lease if renew_lease(lease) else None
+    if not steal or not _stealable(path, doc, grace_s):
+        return None
+    # Single-winner steal. The lease slot is never emptied: stealers
+    # serialize on a short-lived `.steal-lock` (O_EXCL, single winner),
+    # re-verify expiry under the lock (the owner may have renewed since our
+    # read), then atomically *replace* the expired lease file via rename —
+    # so a plain claimant's O_EXCL create can never slip in mid-steal, and
+    # a racing stealer never clobbers a fresh lease. A stealer that dies
+    # holding the lock leaves a stale lock broken by mtime after its ttl.
+    lock = lease_dir / f'{key}.steal-lock'
+    lock_ttl = max(grace_s, 2.0)
+    try:
+        if time.time() - lock.stat().st_mtime > lock_ttl:
+            lock.unlink()  # break a dead stealer's lock (missing_ok below)
+    except OSError:
+        pass
+    if not exclusive_create(lock, json.dumps({'owner': owner, 'ts': time.time()}).encode()):
+        return None  # another stealer is mid-steal; retry on the next poll
+    try:
+        doc = read_lease(path)
+        if not _stealable(path, doc, grace_s):
+            return None
+        lease.stolen_from = (doc or {}).get('owner', '?')
+        lease.expires_at = time.time() + ttl_s
+        atomic_write_bytes(path, json.dumps(lease._doc()).encode())
+    finally:
+        try:
+            lock.unlink()
+        except OSError:  # pragma: no cover
+            pass
+        fsync_dir(lease_dir)
+    telemetry.counter('lease.claims').inc()
+    telemetry.counter('lease.steals').inc()
+    telemetry.instant('lease.steal', key=key, owner=owner, stolen_from=lease.stolen_from)
+    return lease
+
+
+def renew_lease(lease: Lease, ttl_s: float | None = None) -> bool:
+    """Extend a held lease's deadline. False (and ``lease.lost``) when the
+    lease was stolen or released out from under us — the owner must treat
+    the work as forfeit for exclusivity purposes.
+
+    The ownership check and the rewrite are not one atomic step; the
+    steal-side grace (``grace_s > ttl/3`` renew cadence) is what makes the
+    window unreachable for a healthy owner (module docstring).
+    """
+    doc = read_lease(lease.path)
+    if doc is None or doc.get('owner') != lease.owner:
+        lease.lost = True
+        telemetry.counter('lease.lost').inc()
+        return False
+    lease.ttl_s = ttl_s if ttl_s is not None else lease.ttl_s
+    # deadlines only move forward, even under a skewed wall clock
+    lease.expires_at = max(lease.expires_at, time.time() + lease.ttl_s)
+    lease.generation = int(doc.get('generation', 0)) + 1
+    atomic_write_bytes(lease.path, json.dumps(lease._doc()).encode())
+    telemetry.counter('lease.renewals').inc()
+    return True
+
+
+def release_lease(lease: Lease) -> None:
+    """Drop a held lease (idempotent). Only the current owner's file is
+    removed; a stolen-then-released lease leaves the thief's file alone."""
+    doc = read_lease(lease.path)
+    if doc is None or doc.get('owner') != lease.owner:
+        lease.lost = True
+        return
+    try:
+        lease.path.unlink()
+    except OSError:
+        return
+    fsync_dir(lease.path.parent)
+
+
+def list_leases(lease_dir: str | os.PathLike) -> dict[str, dict]:
+    """All readable leases in a directory, keyed by work key (monitoring)."""
+    out: dict[str, dict] = {}
+    try:
+        entries = sorted(os.listdir(lease_dir))
+    except OSError:
+        return out
+    for name in entries:
+        if not name.endswith('.lease'):
+            continue
+        doc = read_lease(Path(lease_dir) / name)
+        if doc is not None:
+            out[name[: -len('.lease')]] = doc
+    return out

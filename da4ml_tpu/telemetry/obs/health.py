@@ -52,13 +52,27 @@ def _metric_value(snap: dict, name: str) -> float | None:
     return None if m is None else m.get('value')
 
 
+def _campaign_workers() -> dict | None:
+    """Cross-process worker liveness of an active multi-worker campaign
+    (``parallel.campaign.worker_health``: heartbeat files in the shared
+    campaign dir). Resolved via ``sys.modules`` — a scrape never imports
+    the campaign driver."""
+    mod = sys.modules.get('da4ml_tpu.parallel.campaign')
+    if mod is None:
+        return None
+    try:
+        return mod.worker_health(stall_s=_stall_threshold_s())
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
 def _campaign_check(snap: dict) -> dict:
     done = _metric_value(snap, 'campaign.done')
     total = _metric_value(snap, 'campaign.total')
     age = core.beat_age_s('campaign')
     in_progress = total is not None and total > 0 and (done is None or done < total)
     stalled = bool(in_progress and age is not None and age > _stall_threshold_s())
-    return {
+    out = {
         'status': 'degraded' if stalled else 'ok',
         'in_progress': bool(in_progress),
         'done': done,
@@ -66,6 +80,14 @@ def _campaign_check(snap: dict) -> dict:
         'heartbeat_age_s': None if age is None else round(age, 3),
         'stall_threshold_s': _stall_threshold_s(),
     }
+    workers = _campaign_workers()
+    if workers is not None:
+        out['workers'] = workers
+        # a stalled *worker* degrades health even while this process's own
+        # loop beats on time — its kernels sit leased-but-dead until expiry
+        if workers.get('in_progress') and workers.get('stalled'):
+            out['status'] = 'degraded'
+    return out
 
 
 def _cache_check(snap: dict) -> dict:

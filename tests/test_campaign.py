@@ -1,0 +1,479 @@
+"""Lease + campaign fault-tolerance suite (docs/distributed.md).
+
+Fast, CPU-only: lease claim/renew/expire/steal races under thread and
+subprocess contention, crash-safe per-kernel results, resume-after-SIGKILL
+byte-identity, a real two-worker steal drill, ``/healthz`` worker
+degradation, the campaign CLI, and the configurable distributed connect
+budget satellite. All solves use the ``pure-python`` backend so results
+are deterministic without device warmup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.parallel import campaign as camp
+from da4ml_tpu.reliability import (
+    atomic_write_bytes,
+    claim_lease,
+    exclusive_create,
+    read_lease,
+    release_lease,
+    renew_lease,
+)
+from da4ml_tpu.reliability.lease import list_leases
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _corpus(n=3, dim=5, bits=3, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, 2**bits, (dim, dim)) * rng.choice([-1.0, 1.0], (dim, dim))).astype(np.float64)
+        for _ in range(n)
+    ]
+
+
+def _blobs(results):
+    return {d['key']: json.dumps(d['pipeline'], sort_keys=True) for d in results}
+
+
+@pytest.fixture(autouse=True)
+def _no_active_campaign():
+    yield
+    camp._ACTIVE_DIR = None
+
+
+# ------------------------------------------------------------------ durability
+
+
+def test_atomic_write_replaces_whole_file(tmp_path):
+    p = tmp_path / 'a' / 'doc.json'
+    atomic_write_bytes(p, b'{"v": 1}')
+    atomic_write_bytes(p, b'{"v": 2}')
+    assert json.loads(p.read_text()) == {'v': 2}
+    assert list(p.parent.glob('*.tmp*')) == []  # no tmp litter
+
+
+def test_exclusive_create_single_winner_threads(tmp_path):
+    p = tmp_path / 'claim'
+    wins = []
+    barrier = threading.Barrier(12)
+
+    def worker(i):
+        barrier.wait()
+        if exclusive_create(p, f'{i}'.encode()):
+            wins.append(i)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(wins) == 1
+    assert p.read_text() == str(wins[0])
+
+
+# ------------------------------------------------------------------ leases
+
+
+def test_claim_is_exclusive_and_releasable(tmp_path):
+    a = claim_lease(tmp_path, 'k', owner='a', ttl_s=10.0)
+    assert a is not None and a.remaining_s() > 5
+    assert claim_lease(tmp_path, 'k', owner='b', ttl_s=10.0) is None
+    release_lease(a)
+    b = claim_lease(tmp_path, 'k', owner='b', ttl_s=10.0)
+    assert b is not None and b.stolen_from is None
+
+
+def test_same_owner_reclaims_own_live_lease(tmp_path):
+    a = claim_lease(tmp_path, 'k', owner='a', ttl_s=10.0)
+    again = claim_lease(tmp_path, 'k', owner='a', ttl_s=10.0)
+    assert again is not None and again.key == 'k'
+    doc = read_lease(a.path)
+    assert doc['owner'] == 'a' and doc['generation'] >= 1  # adopted via renew
+
+
+def test_renew_extends_and_detects_loss(tmp_path):
+    a = claim_lease(tmp_path, 'k', owner='a', ttl_s=0.2)
+    assert renew_lease(a, ttl_s=10.0)
+    assert a.remaining_s() > 5
+    a.path.unlink()  # simulate release/steal out from under the owner
+    assert not renew_lease(a)
+    assert a.lost
+
+
+def test_expired_lease_is_stolen_with_attribution(tmp_path):
+    dead = claim_lease(tmp_path, 'k', owner='dead', ttl_s=0.05)
+    time.sleep(0.3)
+    thief = claim_lease(tmp_path, 'k', owner='thief', ttl_s=10.0, grace_s=0.1)
+    assert thief is not None and thief.stolen_from == 'dead'
+    assert read_lease(thief.path)['owner'] == 'thief'
+    assert not renew_lease(dead) and dead.lost
+    release_lease(dead)  # must not remove the thief's lease
+    assert read_lease(thief.path)['owner'] == 'thief'
+
+
+def test_live_lease_is_not_stealable(tmp_path):
+    claim_lease(tmp_path, 'k', owner='a', ttl_s=30.0)
+    assert claim_lease(tmp_path, 'k', owner='b', ttl_s=30.0, grace_s=0.1) is None
+
+
+def test_steal_disabled(tmp_path):
+    claim_lease(tmp_path, 'k', owner='a', ttl_s=0.05)
+    time.sleep(0.2)
+    assert claim_lease(tmp_path, 'k', owner='b', ttl_s=5.0, steal=False, grace_s=0.05) is None
+
+
+def test_torn_lease_file_stolen_after_grace(tmp_path):
+    # a crash between O_EXCL create and payload write leaves an empty file
+    (tmp_path / 'k.lease').touch()
+    assert claim_lease(tmp_path, 'k', owner='b', ttl_s=5.0, grace_s=0.2) is None  # too fresh
+    time.sleep(0.4)
+    lease = claim_lease(tmp_path, 'k', owner='b', ttl_s=5.0, grace_s=0.2)
+    assert lease is not None
+
+
+def test_dead_stealers_lock_is_broken(tmp_path):
+    claim_lease(tmp_path, 'k', owner='dead', ttl_s=0.05)
+    lock = tmp_path / 'k.steal-lock'
+    lock.write_text('{"owner": "crashed-stealer"}')
+    old = time.time() - 60
+    os.utime(lock, (old, old))
+    time.sleep(0.2)
+    lease = claim_lease(tmp_path, 'k', owner='b', ttl_s=5.0, grace_s=0.1)
+    assert lease is not None and lease.stolen_from == 'dead'
+    assert not lock.exists()
+
+
+def test_steal_race_threads_single_winner(tmp_path):
+    for rnd in range(5):
+        d = tmp_path / f'r{rnd}'
+        claim_lease(d, 'k', owner='victim', ttl_s=0.01)
+        time.sleep(0.15)
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def worker(i, d=d, wins=wins, barrier=barrier):
+            barrier.wait()
+            lease = claim_lease(d, 'k', owner=f's{i}', ttl_s=10.0, grace_s=0.05)
+            if lease is not None:
+                wins.append(lease)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(wins) == 1, f'round {rnd}: {len(wins)} steal winners'
+        assert wins[0].stolen_from == 'victim'
+        assert read_lease(wins[0].path)['owner'] == wins[0].owner
+
+
+def test_claim_contention_subprocesses(tmp_path):
+    """8 keys, 4 racing processes: every key claimed exactly once fleet-wide."""
+    keys = [f'k{i}' for i in range(8)]
+    script = (
+        'import json,sys\n'
+        f'sys.path.insert(0, {str(REPO_ROOT)!r})\n'
+        'from da4ml_tpu.reliability.lease import claim_lease\n'
+        'd, owner = sys.argv[1], sys.argv[2]\n'
+        f'won = [k for k in {keys!r} if claim_lease(d, k, owner=owner, ttl_s=30.0)]\n'
+        'print(json.dumps(won))\n'
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, '-c', script, str(tmp_path), f'p{i}'],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(4)
+    ]
+    won: list[str] = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0
+        won.extend(json.loads(out.strip().splitlines()[-1]))
+    assert sorted(won) == sorted(keys)  # no key double-claimed, none lost
+    assert sorted(list_leases(tmp_path)) == sorted(keys)
+
+
+# ------------------------------------------------------------------ campaign core
+
+
+def test_create_campaign_manifest_is_exclusive_and_validated(tmp_path):
+    kernels = _corpus(3)
+    m1 = camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python')
+    m2 = camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python', resume=True)
+    assert m1['keys'] == m2['keys']
+    with pytest.raises(camp.CampaignError, match='different corpus'):
+        camp.create_campaign(tmp_path / 'c', _corpus(3, seed=99), backend='pure-python')
+
+
+def test_create_campaign_refuses_stale_results_without_resume(tmp_path):
+    kernels = _corpus(2)
+    camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python')
+    (tmp_path / 'c' / 'results' / 'junk.json').write_text('{}')
+    with pytest.raises(camp.CampaignError, match='resume=True'):
+        camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python')
+
+
+def test_single_worker_loop_solves_corpus_and_collects_in_order(tmp_path):
+    kernels = _corpus(3)
+    kernels.append(kernels[0].copy())  # duplicate collapses onto one solve
+    manifest = camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python')
+    assert len(manifest['keys']) == 3 and len(manifest['key_per_kernel']) == 4
+    summary = camp.worker_loop(tmp_path / 'c', ttl_s=10.0)
+    assert summary['complete'] and summary['n_solved'] == 3
+    results = camp.collect_results(tmp_path / 'c')
+    assert len(results) == 4  # duplicates fan back out in corpus order
+    assert results[0]['key'] == results[3]['key']
+    assert results[0]['pipeline'] == results[3]['pipeline']
+    pipes = camp.results_to_pipelines(results)
+    assert len(pipes) == 4 and all(p.cost == r['cost'] for p, r in zip(pipes, results))
+
+
+def test_collect_incomplete_campaign_raises(tmp_path):
+    camp.create_campaign(tmp_path / 'c', _corpus(2), backend='pure-python')
+    with pytest.raises(camp.CampaignError, match='incomplete'):
+        camp.collect_results(tmp_path / 'c')
+
+
+def test_terminal_failure_completes_campaign(tmp_path):
+    """A kernel failing on every backend fleet-wide lands a failed-result
+    doc after max_failures, so the campaign terminates instead of looping."""
+    kernels = _corpus(2)
+    camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python')
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv('DA4ML_FAULT_INJECT', 'cmvm.solve=error')
+        summary = camp.worker_loop(tmp_path / 'c', ttl_s=10.0, max_failures=2)
+    assert summary['complete'] and summary['n_solved'] == 0
+    with pytest.raises(camp.CampaignError, match='failed on every backend'):
+        camp.collect_results(tmp_path / 'c')
+    results = camp.collect_results(tmp_path / 'c', allow_failed=True)
+    assert all(doc.get('failed') for doc in results)
+    assert len(list((tmp_path / 'c' / 'failures').glob('*.json'))) == 2 * 2
+
+
+def test_run_campaign_two_workers_byte_identical_to_single(tmp_path):
+    kernels = _corpus(4, dim=6)
+    ref, _ = camp.run_campaign(kernels, workers=1, campaign_dir=tmp_path / 'ref', backend='pure-python')
+    par, rep = camp.run_campaign(
+        kernels, workers=2, campaign_dir=tmp_path / 'par', backend='pure-python', ttl_s=10.0, poll_s=0.1
+    )
+    assert _blobs(ref) == _blobs(par)
+    assert rep['n_kernels'] == 4 and len(rep['worker_summaries']) == 2
+    owners = {doc['owner'] for doc in par}
+    assert all(doc['owner'] in owners for doc in par)
+    assert sum(s['n_solved'] for s in rep['worker_summaries']) == len(camp.load_manifest(tmp_path / 'par')['keys'])
+
+
+def test_resume_after_sigkill_byte_identity(tmp_path):
+    """Worker hard-killed right after its first durable result; a resumed
+    worker finishes the corpus and the results are byte-identical to an
+    uninterrupted single-process run (no kernel lost, none solved twice)."""
+    kernels = _corpus(3, dim=6)
+    ref, _ = camp.run_campaign(kernels, workers=1, campaign_dir=tmp_path / 'ref', backend='pure-python')
+
+    drill = tmp_path / 'drill'
+    camp.create_campaign(drill, kernels, backend='pure-python')
+    env = dict(os.environ, DA4ML_FAULT_INJECT='campaign.post_result=kill:1')
+    proc = camp._spawn_worker(drill, 'victim', ttl_s=5.0, poll_s=0.1, deadline_per_solve=None, env=env)
+    proc.communicate(timeout=180)
+    assert proc.returncode != 0  # died mid-campaign
+    assert len(camp._done_keys(drill / 'results')) == 1  # exactly one durable result
+
+    summary = camp.worker_loop(drill, owner='rescuer', ttl_s=2.0, grace_s=0.5, poll_s=0.1)
+    assert summary['complete'] and summary['n_solved'] == 2  # only the remainder
+    assert _blobs(camp.collect_results(drill)) == _blobs(ref)
+
+
+@pytest.mark.parametrize('seed', [20260804])
+def test_two_worker_steal_drill_sigkill(tmp_path, seed):
+    """A real SIGKILL steal: the victim subprocess parks mid-solve holding a
+    renewing lease; an in-process survivor steals the kernel after expiry
+    and finishes the corpus byte-identical to the reference."""
+    kernels = _corpus(3, dim=6, seed=seed)
+    ref, _ = camp.run_campaign(kernels, workers=1, campaign_dir=tmp_path / 'ref', backend='pure-python')
+
+    drill = tmp_path / 'drill'
+    camp.create_campaign(drill, kernels, backend='pure-python')
+    env = dict(os.environ, DA4ML_FAULT_INJECT='campaign.solve=sleep:1:120')
+    victim = camp._spawn_worker(drill, 'victim', ttl_s=1.0, poll_s=0.1, deadline_per_solve=None, env=env)
+    try:
+        deadline = time.monotonic() + 60
+        victim_key = None
+        while victim_key is None and time.monotonic() < deadline:
+            for key, doc in list_leases(drill / 'leases').items():
+                if doc.get('owner') == 'victim':
+                    victim_key = key
+            time.sleep(0.05)
+        assert victim_key is not None, 'victim never claimed a lease'
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.communicate(timeout=60)
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+
+    summary = camp.worker_loop(drill, owner='survivor', ttl_s=1.0, grace_s=0.4, poll_s=0.1)
+    assert summary['complete'] and summary['stolen'] >= 1
+    results = camp.collect_results(drill)
+    assert _blobs(results) == _blobs(ref)
+    owners = {doc['key']: doc['owner'] for doc in results}
+    assert owners[victim_key] == 'survivor'  # the victim's kernel was rescued
+    stolen_docs = [d for d in results if d.get('stolen_from')]
+    assert any(d['key'] == victim_key for d in stolen_docs)
+
+
+def test_campaign_jax_backend_on_mesh(tmp_path):
+    """A campaign through the device chain on the 8-device virtual CPU mesh
+    (conftest): results land durable + resume is a pure checkpoint read."""
+    kernels = _corpus(2, dim=4, bits=2)
+    camp.create_campaign(tmp_path / 'c', kernels, backend='jax')
+    summary = camp.worker_loop(tmp_path / 'c', ttl_s=60.0)
+    assert summary['complete'] and summary['n_solved'] == 2
+    first = camp.collect_results(tmp_path / 'c')
+    assert all(doc['backend'] == 'jax' for doc in first)
+    # a second worker over the finished directory solves nothing
+    again = camp.worker_loop(tmp_path / 'c', owner='late-joiner', ttl_s=60.0)
+    assert again['complete'] and again['n_solved'] == 0
+    assert _blobs(camp.collect_results(tmp_path / 'c')) == _blobs(first)
+
+
+# ------------------------------------------------------------------ health plane
+
+
+def test_campaign_status_and_healthz_degrade_on_stalled_worker(tmp_path):
+    kernels = _corpus(2)
+    camp.create_campaign(tmp_path / 'c', kernels, backend='pure-python')
+    d = camp._dirs(tmp_path / 'c')
+    camp._beat_worker(d['workers'], 'live-worker', done=0)
+    stale = {'owner': 'dead-worker', 'pid': 1, 'ts': time.time() - 900, 'done': 1}
+    (d['workers'] / 'dead-worker.json').write_text(json.dumps(stale))
+
+    st = camp.campaign_status(tmp_path / 'c', stall_s=60.0)
+    assert st['in_progress'] and st['total'] == 2 and st['done'] == 0
+    assert st['stalled'] == ['dead-worker'] and st['workers_alive'] == 1
+
+    # /healthz: a stalled worker of the active campaign degrades health
+    from da4ml_tpu.telemetry.obs.health import health_snapshot
+
+    camp._ACTIVE_DIR = str(tmp_path / 'c')
+    doc = health_snapshot()
+    assert doc['status'] == 'degraded'
+    assert doc['checks']['campaign']['workers']['stalled'] == ['dead-worker']
+    camp._ACTIVE_DIR = None
+    assert camp.worker_health() is None
+    assert health_snapshot()['checks']['campaign'].get('workers') is None
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_load_corpus_specs(tmp_path):
+    from da4ml_tpu._cli.campaign import load_corpus
+
+    q = load_corpus('quality:3')
+    assert len(q) == 3 and all(k.ndim == 2 for k in q)
+    assert _blobs([]) == {}  # sanity: helper tolerates empty
+    np.testing.assert_array_equal(load_corpus('quality:3')[0], q[0])  # deterministic
+
+    npz = tmp_path / 'c.npz'
+    np.savez(npz, a=q[0], b=q[1])
+    loaded = load_corpus(str(npz))
+    assert len(loaded) == 2
+
+    stack = tmp_path / 's.npy'
+    np.save(stack, np.stack([np.ones((3, 3)), np.zeros((3, 3))]))
+    assert len(load_corpus(str(stack))) == 2
+
+    js = tmp_path / 'k.json'
+    js.write_text(json.dumps([[[1, 2], [3, 4]]]))
+    assert load_corpus(str(js))[0].shape == (2, 2)
+
+    assert len(load_corpus(str(tmp_path))) == 5  # directory walk
+    with pytest.raises(ValueError, match='unrecognized corpus'):
+        load_corpus(str(tmp_path / 'missing.txt'))
+
+
+def test_cli_campaign_run_and_status(tmp_path, capsys):
+    from da4ml_tpu._cli import main
+
+    rc = main(
+        [
+            'campaign',
+            'drill:2',
+            '--workers',
+            '1',
+            '--backend',
+            'pure-python',
+            '--dir',
+            str(tmp_path / 'c'),
+            '--out',
+            str(tmp_path / 'report.json'),
+        ]
+    )
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line['n_kernels'] == 2 and line['total_cost'] > 0
+    report = json.loads((tmp_path / 'report.json').read_text())
+    assert report['workers'] == 1 and len(report['costs']) == 2
+
+    rc = main(['campaign', '--status', str(tmp_path / 'c')])
+    assert rc == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st['done'] == 2 and not st['in_progress']
+
+    # resume of a finished dir is a fast no-op with identical results
+    rc = main(
+        ['campaign', 'drill:2', '--workers', '1', '--backend', 'pure-python', '--dir', str(tmp_path / 'c'), '--resume']
+    )
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.strip().splitlines()[-1])['total_cost'] == line['total_cost']
+
+
+def test_cli_campaign_bad_corpus_exit_code(tmp_path, capsys):
+    from da4ml_tpu._cli import main
+
+    assert main(['campaign', str(tmp_path / 'nope.npz')]) == 2
+    assert main(['campaign']) == 2
+
+
+# ------------------------------------------------------------------ satellites
+
+
+def test_connect_budget_env_overrides(monkeypatch):
+    from da4ml_tpu.parallel.distributed import (
+        DEFAULT_CONNECT_RETRIES,
+        DEFAULT_CONNECT_TIMEOUT_S,
+        connect_budget,
+    )
+
+    monkeypatch.delenv('DA4ML_DIST_CONNECT_RETRIES', raising=False)
+    monkeypatch.delenv('DA4ML_DIST_CONNECT_TIMEOUT_S', raising=False)
+    assert connect_budget() == (DEFAULT_CONNECT_RETRIES, DEFAULT_CONNECT_TIMEOUT_S)
+    monkeypatch.setenv('DA4ML_DIST_CONNECT_RETRIES', '7')
+    monkeypatch.setenv('DA4ML_DIST_CONNECT_TIMEOUT_S', '120')
+    assert connect_budget() == (7, 120.0)
+    monkeypatch.setenv('DA4ML_DIST_CONNECT_RETRIES', 'junk')
+    monkeypatch.setenv('DA4ML_DIST_CONNECT_TIMEOUT_S', '-3')
+    retries, timeout_s = connect_budget()
+    assert retries == DEFAULT_CONNECT_RETRIES and timeout_s == 1.0  # clamped floor
+
+
+def test_checkpoint_write_still_durable_roundtrip(tmp_path):
+    """The checkpoint satellite: saves still round-trip through the new
+    atomic_write_bytes path (tmp+fsync+rename+dirfsync)."""
+    from da4ml_tpu.reliability import CheckpointStore
+
+    store = CheckpointStore(tmp_path / 'ck.json')
+    store.put('k1', {'cost': 3.0})
+    fresh = CheckpointStore(tmp_path / 'ck.json')
+    assert fresh.records['k1'] == {'cost': 3.0}
+    assert list(tmp_path.glob('*.tmp*')) == []
