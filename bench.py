@@ -559,6 +559,55 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
             'byte_identical': ref_blobs == par_blobs,
             'mean_cost': round(float(np.mean([d['cost'] for d in par_results])), 3),
         }
+    if name == 'serve':
+        # resilient serving probe (docs/serving.md): closed-loop load over
+        # the in-process engine — p50/p99 latency + sustained samples/s,
+        # every response bit-exact vs the numpy oracle, and (after the
+        # canonical-grid warmup) zero serve batches landing on a new XLA
+        # shape; plus the 10x overload burst proving the admission ceiling
+        from da4ml_tpu.cmvm import solve as _solve
+        from da4ml_tpu.runtime.numpy_backend import run_binary as _np_run
+        from da4ml_tpu.serve import ServeConfig, ServeEngine
+        from da4ml_tpu.serve.loadgen import burst, closed_loop, engine_infer_fn, make_request_pool
+        from da4ml_tpu.telemetry.metrics import metrics_snapshot
+
+        rng = np.random.default_rng(9000)
+        pipe = _solve(_rand_kernel(rng, 12, 8, 4), backend=host_backend)
+        cfg = ServeConfig(max_batch_rows=64, max_latency_ms=1.0, queue_cap_rows=512, default_deadline_ms=2000.0)
+        engine = ServeEngine(cfg)
+        engine.load_model('bench', pipe)  # prewarms the canonical batch grid
+        bins = engine._state('bench').binaries
+
+        def oracle(x):
+            out = np.asarray(x, np.float64)
+            for b in bins:
+                out = _np_run(b, out)
+            return out
+
+        pool = make_request_pool(oracle, engine._state('bench').n_in, rows_choices=(1, 2, 4, 8, 16), pool=40)
+        infer = engine_infer_fn(engine, 'bench')
+        duration = 2.0 if limited else 6.0
+        load = closed_loop(infer, pool, workers=8, duration_s=duration, deadline_ms=2000.0)
+        snap = metrics_snapshot()
+        shape_miss = int(snap.get('serve.shape_miss', {}).get('value', 0))
+        sustainable = max(int((load['samples_per_s'] or 1) * 0.1), 32)
+        overload = burst(infer, pool, n_requests=min(10 * max(sustainable, 1), 400), deadline_ms=2000.0)
+        drained = engine.close()
+        return {
+            'p50_ms': load['p50_ms'],
+            'p99_ms': load['p99_ms'],
+            'samples_per_s': load['samples_per_s'],
+            'requests': load['requests'],
+            'availability': load['availability'],
+            'bit_exact': load['mismatches'] == 0 and overload['mismatches'] == 0,
+            'shed': load['shed'],
+            'shape_miss_after_warmup': shape_miss,
+            'burst_requests': overload['requests'],
+            'burst_ok': overload['ok'],
+            'burst_shed': overload['shed'],
+            'burst_resolved_all': overload['resolved_all'],
+            'drained_clean': drained,
+        }
     if name == 'select_modes':
         # selection-mode microbench: top4 (XLA O(S*P) score cache) vs the
         # full-rescan xla path vs the single-kernel fused Pallas loop
@@ -590,7 +639,7 @@ _CONFIG_SECTIONS = (
     '4_qconv3x3_im2col',
     '5_full_model_trace',
 )
-_MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference', 'campaign')
+_MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference', 'campaign', 'serve')
 
 
 def _run_section_child(name: str, n1: int, timeout: float, env: dict | None = None) -> dict:

@@ -21,7 +21,10 @@ src/da4ml/_cli/__init__.py:8-27):
   per-metric tolerance budgets (exit 1 on regression);
 - ``campaign`` — fault-tolerant multi-process solve campaigns over a
   shared-filesystem work queue, plus the SIGKILL chaos drill
-  (docs/distributed.md).
+  (docs/distributed.md);
+- ``serve`` — resilient HTTP inference front-end: deadline-aware dynamic
+  batching, admission control/shedding, per-model breakers with graceful
+  degradation, plus its own chaos drill (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -80,6 +83,12 @@ def main(argv: list[str] | None = None) -> int:
     p_camp = sub.add_parser('campaign', help='Run a fault-tolerant multi-worker solve campaign (or its chaos drill)')
     add_campaign_args(p_camp)
     p_camp.set_defaults(func=campaign_main)
+
+    from .serve import add_serve_args, serve_main
+
+    p_serve = sub.add_parser('serve', help='Serve models over HTTP with dynamic batching and admission control')
+    add_serve_args(p_serve)
+    p_serve.set_defaults(func=serve_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

@@ -1,0 +1,134 @@
+"""``da4ml-tpu serve`` — the resilient HTTP inference front-end.
+
+Serves one or more saved models (``name=path.json`` or bare paths, names
+defaulting to the file stem) behind deadline-aware dynamic batching with
+admission control (docs/serving.md):
+
+    da4ml-tpu serve examples/kernels/cmvm_pipeline.json --port 8080
+    da4ml-tpu serve mlp=model.json --max-batch-rows 512 --shed-policy deadline-edf
+
+Prints one JSON line with the bound URL + loaded models once warm, then
+runs until SIGTERM/SIGINT (or ``--duration``). Shutdown is graceful:
+admission stops, every accepted request is served, and the process exits
+0 with zero lost accepted requests. ``--chaos`` runs the breaker-trip +
+reload drill instead and exits 0/1 on its gate (the CI ``serve-chaos``
+job).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+
+def add_serve_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument('models', nargs='*', help="Models to serve: 'name=path.json' or bare paths (name = stem)")
+    parser.add_argument('--port', type=int, default=0, help='Bind port (0 = ephemeral, printed on the ready line)')
+    parser.add_argument('--host', default='127.0.0.1', help='Bind host (default 127.0.0.1)')
+    parser.add_argument('--max-batch-rows', type=int, default=256, help='Row budget per coalesced device batch')
+    parser.add_argument('--max-latency-ms', type=float, default=5.0, help='Batch coalescing window')
+    parser.add_argument('--queue-cap-rows', type=int, default=1024, help='Hard admission ceiling (rows) per model')
+    parser.add_argument(
+        '--shed-policy', choices=('reject-newest', 'deadline-edf'), default='reject-newest', help='Overload shed policy'
+    )
+    parser.add_argument('--deadline-ms', type=float, default=1000.0, help='Default per-request deadline (0 = unbounded)')
+    parser.add_argument('--hedge-ms', type=float, default=0.0, help='Straggler hedge delay (0 = off)')
+    parser.add_argument(
+        '--degraded', choices=('fallback', 'shed'), default='fallback', help='Open-breaker mode (docs/serving.md)'
+    )
+    parser.add_argument('--degraded-max-rows', type=int, default=32, help='Row budget while degraded')
+    parser.add_argument('--breaker-threshold', type=int, default=3, help='Consecutive failures that open the breaker')
+    parser.add_argument('--breaker-reset-s', type=float, default=5.0, help='Breaker cooldown before a half-open probe')
+    parser.add_argument('--no-prewarm', action='store_true', help='Skip the canonical-grid warmup on load')
+    parser.add_argument('--duration', type=float, default=0.0, help='Serve for N seconds then drain (0 = until signal)')
+    parser.add_argument('--chaos', action='store_true', help='Run the breaker-trip + reload chaos drill and exit')
+    parser.add_argument('--drill-duration', type=float, default=6.0, help='--chaos: load duration in seconds')
+    parser.add_argument('--json', action='store_true', dest='as_json', help='--chaos: print the full report as JSON')
+    parser.add_argument('--out', type=Path, default=None, help='--chaos: also write the report JSON here')
+
+
+def _parse_models(specs: list[str]) -> list[tuple[str, str]]:
+    out = []
+    for spec in specs:
+        if '=' in spec:
+            name, path = spec.split('=', 1)
+        else:
+            name, path = Path(spec).stem, spec
+        out.append((name, path))
+    return out
+
+
+def serve_main(args: argparse.Namespace) -> int:
+    from ..serve.engine import ServeConfig, ServeEngine
+    from ..telemetry import get_logger
+
+    log = get_logger('cli.serve')
+    config = ServeConfig(
+        max_batch_rows=args.max_batch_rows,
+        max_latency_ms=args.max_latency_ms,
+        queue_cap_rows=args.queue_cap_rows,
+        shed_policy=args.shed_policy,
+        default_deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None,
+        hedge_ms=args.hedge_ms,
+        degraded=args.degraded,
+        degraded_max_rows=args.degraded_max_rows,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        prewarm=not args.no_prewarm,
+    )
+
+    if args.chaos:
+        from ..serve.chaos import chaos_drill
+
+        source = args.models[0].split('=', 1)[-1] if args.models else None
+        report = chaos_drill(source, duration_s=args.drill_duration, config=None)
+        text = json.dumps(report if args.as_json else report['checks'], indent=1)
+        log.info(text)
+        if args.out is not None:
+            args.out.write_text(json.dumps(report, indent=1))
+        return 0 if report['ok'] else 1
+
+    if not args.models:
+        log.warning('no models given (pass name=path.json); nothing to serve')
+        return 2
+
+    engine = ServeEngine(config)
+    for name, path in _parse_models(args.models):
+        engine.load_model(name, path)
+
+    from ..serve.http import ServeServer
+
+    server = ServeServer(engine, port=args.port, host=args.host)
+    ready = {
+        'serving': server.url,
+        'models': [m['name'] for m in engine.models()['models']],
+        'endpoints': ['/v1/infer', '/v1/models', '/metrics', '/healthz', '/statusz'],
+    }
+    log.info(json.dumps(ready))
+    sys.stdout.flush()
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    prev_term = signal.signal(signal.SIGTERM, _graceful)
+    prev_int = signal.signal(signal.SIGINT, _graceful)
+    deadline = time.monotonic() + args.duration if args.duration > 0 else None
+    try:
+        while not stop.is_set() and (deadline is None or time.monotonic() < deadline):
+            stop.wait(0.2)
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+        # graceful drain: stop admitting, serve everything accepted, then
+        # close — the zero-lost-accepted-requests exit contract
+        drained = engine.drain(timeout=30.0)
+        server.close()
+        log.info(json.dumps({'drained': drained, 'exit': 0 if drained else 1}))
+    return 0 if drained else 1

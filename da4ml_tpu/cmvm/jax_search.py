@@ -90,30 +90,16 @@ def executable_classes() -> int:
     return len(_SEEN_CLASSES)
 
 
-def _next_pow2(x: int) -> int:
-    """Smallest power of two >= max(x, 1)."""
-    return 1 << (max(x, 1) - 1).bit_length()
+# canonical 2^k / 3·2^k / 5·2^k shape grid, shared with the serve batcher
+# (parallel/shapes.py): the scheduler's setting keeps the grid even since B
+# buckets to even counts. Kept under the historical names — this module's
+# tests and docs refer to them.
+from ..parallel.shapes import canon_dim as _shared_canon_dim, next_pow2 as _next_pow2  # noqa: E402
 
 
 def _canon_dim(x: int, lo: int = 2) -> int:
-    """Round a shape-class dim up to the canonical 2^k / 3*2^k grid.
-
-    The grid (…, lo, 4, 6, 8, 10, 12, 16, 20, 24, 32, 40, 48, …) is batch-independent:
-    a matrix always lands in the same (O, B) class no matter what else is in
-    the batch, so thousands of heterogeneous matrices share a small set of
-    compiled executables — and the persistent XLA cache makes those classes
-    one-time costs per machine, not per process. 3*2^k rungs (kept even,
-    since B buckets to even counts) halve the worst-case padding waste of a
-    pure pow2 grid; the per-iteration search cost scales with O*B^2, so the
-    padding quantum matters.
-    """
-    x = max(x, lo)
-    p2 = _next_pow2(x)
-    best = p2
-    for c in ((p2 // 4) * 3, (p2 // 8) * 5):
-        if x <= c and c >= lo and c % 2 == 0 and c < best:
-            best = c
-    return best
+    """Round a shape-class dim up to the canonical grid (``parallel.shapes.canon_dim``)."""
+    return _shared_canon_dim(x, lo=lo, even=True)
 
 
 def ensure_compile_cache() -> str | None:

@@ -1,0 +1,84 @@
+"""Canonical shape grid shared by the CMVM scheduler and the serve batcher.
+
+The PR-4 device scheduler buckets every compiled shape onto a
+``2^k / 3·2^k / 5·2^k`` grid so heterogeneous workloads share a small set
+of XLA executables and the persistent compile cache turns each class into
+a one-time cost per machine (``docs/api.md`` scheduler knobs,
+``docs/cmvm.md``). The serving layer reuses the same grid on the *sample*
+axis: a coalesced request batch is padded up to the nearest grid rung, so
+every batch a warm server dispatches lands on an already-compiled shape
+(``docs/serving.md``).
+
+Numpy-only on purpose: importable by both ``cmvm.jax_search`` and
+``serve.batching`` without touching jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from numpy.typing import NDArray
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= max(x, 1)."""
+    return 1 << (max(x, 1) - 1).bit_length()
+
+
+def canon_dim(x: int, lo: int = 2, even: bool = True) -> int:
+    """Round a shape dim up to the canonical 2^k / 3·2^k / 5·2^k grid.
+
+    The grid (…, lo, 4, 6, 8, 10, 12, 16, 20, 24, 32, 40, 48, …) is
+    batch-independent: a matrix always lands in the same (O, B) class no
+    matter what else is in the batch, so thousands of heterogeneous
+    matrices share a small set of compiled executables — and the
+    persistent XLA cache makes those classes one-time costs per machine,
+    not per process. 3·2^k / 5·2^k rungs halve the worst-case padding
+    waste of a pure pow2 grid; the per-iteration search cost scales with
+    O·B², so the padding quantum matters.
+
+    ``even=True`` (the CMVM scheduler's setting) keeps odd 3·2^0 / 5·2^0
+    rungs off the grid, since B buckets to even counts. The serve batcher
+    uses ``even=False, lo=1`` so tiny request batches (1, 2, 3, 5 rows)
+    are not padded up to the even grid.
+    """
+    x = max(x, lo)
+    p2 = next_pow2(x)
+    best = p2
+    for c in ((p2 // 4) * 3, (p2 // 8) * 5):
+        if x <= c and c >= lo and (not even or c % 2 == 0) and c < best:
+            best = c
+    return best
+
+
+def grid_rungs(max_dim: int, lo: int = 1, even: bool = False) -> list[int]:
+    """Every canonical grid value in ``[lo, canon_dim(max_dim)]``, ascending.
+
+    This is the serve warmup ladder: pre-dispatching one batch per rung
+    means a warm server never meets a new XLA shape
+    (``serve.ServeEngine.warmup``).
+    """
+    rungs: set[int] = set()
+    d = lo
+    top = canon_dim(max_dim, lo=lo, even=even)
+    while d <= top:
+        c = canon_dim(d, lo=lo, even=even)
+        rungs.add(c)
+        d = c + 1
+    return sorted(rungs)
+
+
+def pad_rows(x: NDArray, lo: int = 1, even: bool = False) -> tuple[NDArray, int]:
+    """Pad the sample axis (axis 0) up to the canonical grid with zero rows.
+
+    Returns ``(padded, n)`` where ``n`` is the original row count. Row-wise
+    kernels (every DAIS program is one) give bit-identical results on the
+    first ``n`` rows — proven through ``DaisExecutor.__call__`` by
+    ``tests/test_serve.py``.
+    """
+    x = np.asarray(x)
+    n = x.shape[0]
+    target = canon_dim(n, lo=lo, even=even)
+    if target == n:
+        return x, n
+    widths = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, widths), n

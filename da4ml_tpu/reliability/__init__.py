@@ -36,6 +36,7 @@ from .deadline import run_with_deadline
 from .errors import (
     BackendUnavailable,
     CheckpointCorrupt,
+    InvalidInputError,
     ReliabilityError,
     SolveTimeout,
     TransientError,
@@ -68,6 +69,7 @@ __all__ = [
     'SolveTimeout',
     'BackendUnavailable',
     'TransientError',
+    'InvalidInputError',
     'CheckpointCorrupt',
     'classify',
     'run_with_deadline',

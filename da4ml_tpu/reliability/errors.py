@@ -39,6 +39,15 @@ class TransientError(ReliabilityError):
     cache race. Classified ``retryable``."""
 
 
+class InvalidInputError(ReliabilityError, ValueError):
+    """An inference input batch is malformed: wrong feature width, non-2D
+    shape, or non-finite (NaN/inf) values. Raised by the runtime executors
+    *before* dispatch so callers see a structured, typed error instead of a
+    bare XLA broadcast failure — the serving layer maps it to HTTP 400
+    (client error), never 500. Classified ``fatal`` (it is a ValueError):
+    every backend would reject the same request identically."""
+
+
 class CheckpointCorrupt(ReliabilityError):
     """A checkpoint file exists but cannot be parsed (torn write, injected
     corruption). Non-strict stores quarantine and restart; strict stores

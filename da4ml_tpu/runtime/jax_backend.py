@@ -291,6 +291,38 @@ def _store_mode_decision(digest: str, mode: str, info: dict) -> None:
         pass
 
 
+def validate_batch(data, n_in: int, what: str = 'DaisExecutor') -> NDArray[np.float64]:
+    """Validate an inference batch before dispatch, raising the reliability
+    taxonomy's :class:`~da4ml_tpu.reliability.errors.InvalidInputError`
+    (a ValueError, classified *fatal*) instead of a bare XLA broadcast or
+    cast error deep inside the device call:
+
+    - the batch must be 2-D ``(n_samples, n_features)``;
+    - the feature width must match the program's ``n_in``;
+    - every value must be finite (NaN/inf floor to undefined integers).
+
+    The serving layer depends on the typed error to answer HTTP 400, not
+    500 (docs/serving.md); returns the batch as a float64 array.
+    """
+    from ..reliability.errors import InvalidInputError
+
+    try:
+        arr = np.asarray(data, dtype=np.float64)
+    except (TypeError, ValueError) as e:
+        raise InvalidInputError(f'{what}: input is not a numeric array: {e}') from e
+    if arr.ndim != 2:
+        raise InvalidInputError(
+            f'{what}: input must be 2-D (n_samples, n_features), got shape {arr.shape}; '
+            f'flatten per-sample features to {n_in} columns first'
+        )
+    if arr.shape[1] != n_in:
+        raise InvalidInputError(f'{what}: feature width mismatch: program expects {n_in} inputs, got {arr.shape[1]}')
+    if arr.size and not np.isfinite(arr).all():
+        bad = int(np.count_nonzero(~np.isfinite(arr)))
+        raise InvalidInputError(f'{what}: input contains {bad} non-finite (NaN/inf) value(s)')
+    return arr
+
+
 def _record_call(holder, n: int, dt: float, nbytes: int = 0) -> None:
     """run.* telemetry for one batch call; the first call of an executor
     includes its compile and is recorded as ``run.compile_s``."""
@@ -964,12 +996,13 @@ class DaisExecutor:
 
     def _int_inputs(self, data: NDArray[np.float64]) -> NDArray:
         prog = self.prog
+        arr = validate_batch(data, prog.n_in, what=type(self).__name__)
         scale = np.zeros(prog.n_in, dtype=np.float64)
         for i in range(prog.n_ops):
             if prog.opcode[i] == -1:
                 i0 = int(prog.id0[i])
                 scale[i0] = 2.0 ** (int(prog.inp_shifts[i0]) + int(prog.fractionals[i]))
-        x = np.floor(np.asarray(data, dtype=np.float64).reshape(len(data), -1) * scale)
+        x = np.floor(arr * scale)
         return x.astype(np.int64 if self.use_i64 else np.int32)
 
     def _out_scale(self) -> NDArray[np.float64]:

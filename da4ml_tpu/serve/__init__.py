@@ -1,0 +1,67 @@
+"""Resilient serving layer: deadline-aware dynamic batching over the DAIS
+runtime executors (docs/serving.md).
+
+The "millions of users" front-end of the north star: concurrent requests
+coalesce into the runtime's canonical batch shapes (``parallel.shapes``
+grid — a warm server never meets a new XLA compile), behind a robustness
+envelope built from the ``reliability`` primitives:
+
+- per-request **deadlines** (expired work rejected before dispatch),
+- a **bounded admission queue** with configurable shed policy
+  (``reject-newest`` / ``deadline-edf``) and Retry-After backpressure,
+- a per-model **circuit breaker** that drops the path into degraded mode
+  (smaller batches on the bit-exact ``run_program`` fallback chain, or
+  structured 503s),
+- optional **hedged dispatch** for straggler batches,
+- **graceful drain / hot reload** of an LRU-bounded multi-model registry
+  with canonical-grid prewarm.
+
+Architecture model: TVM's graph-runtime split (compiled executors below a
+thin request plane, PAPERS.md arXiv:1802.04799) with Clipper-style
+adaptive batching. Entry points: :class:`ServeEngine` (in-process),
+:class:`ServeServer` / ``da4ml-tpu serve`` (HTTP), ``serve.chaos`` (the
+drill), ``serve.loadgen`` (closed-loop load + overload burst).
+"""
+
+from .batching import (
+    AdmissionQueue,
+    DeadlineExpired,
+    Draining,
+    InferRequest,
+    ModelNotFound,
+    ModelUnavailable,
+    QueueFull,
+    ServeRejected,
+)
+from .engine import ServeConfig, ServeEngine, serve_health, serve_status
+
+__all__ = [
+    'ServeConfig',
+    'ServeEngine',
+    'ServeServer',
+    'serve_health',
+    'serve_status',
+    'AdmissionQueue',
+    'InferRequest',
+    'ServeRejected',
+    'QueueFull',
+    'DeadlineExpired',
+    'ModelUnavailable',
+    'ModelNotFound',
+    'Draining',
+    'chaos_drill',
+]
+
+
+def __getattr__(name):
+    # the HTTP server and chaos drill pull in heavier stacks; lazy-resolve
+    # so `from da4ml_tpu.serve import ServeEngine` stays light
+    if name == 'ServeServer':
+        from .http import ServeServer
+
+        return ServeServer
+    if name == 'chaos_drill':
+        from .chaos import chaos_drill
+
+        return chaos_drill
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

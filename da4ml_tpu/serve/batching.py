@@ -1,0 +1,326 @@
+"""Request admission and deadline-aware dynamic batching.
+
+The serve plane's request path (docs/serving.md): a request enters a
+*bounded* admission queue (overload holds a hard ceiling — shed, never
+grow), a per-model batcher thread coalesces queued requests into one
+device batch under a max-latency budget, the sample axis is padded to the
+canonical ``2^k/3·2^k/5·2^k`` grid (``parallel.shapes``) so every batch a
+warm server dispatches lands on an already-compiled XLA shape, and
+requests whose deadline has already passed are rejected *before* dispatch
+— a dead request must not spend device time.
+
+Shed policies (Clipper-style adaptive batching, PAPERS.md):
+
+- ``reject-newest`` — a full queue rejects the arriving request (cheapest,
+  keeps FIFO latency order);
+- ``deadline-edf``  — service order is earliest-deadline-first and a full
+  queue evicts the queued request with the *most* slack if the arriving
+  one is more urgent (the arriving request is rejected otherwise).
+
+Every rejection carries a machine-readable ``retry_after_s`` backpressure
+hint derived from the queue's current drain horizon.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import numpy as np
+from numpy.typing import NDArray
+
+from ..reliability.errors import ReliabilityError
+
+_req_ids = itertools.count(1)
+
+
+# ---------------------------------------------------------------------------
+# structured rejection taxonomy (HTTP mapping in serve.http)
+# ---------------------------------------------------------------------------
+
+
+class ServeRejected(ReliabilityError):
+    """Base class for structured request rejections.
+
+    ``http_status`` is the canonical wire mapping; ``retry_after_s`` (when
+    not None) is the backpressure hint surfaced as a ``Retry-After``
+    header. Rejections are *bounded shedding*, never corruption: a request
+    either gets the bit-exact answer or one of these.
+    """
+
+    http_status = 503
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+    def to_doc(self) -> dict:
+        doc = {'type': type(self).__name__, 'message': str(self), 'http_status': self.http_status}
+        if self.retry_after_s is not None:
+            doc['retry_after_s'] = round(self.retry_after_s, 3)
+        return doc
+
+
+class QueueFull(ServeRejected):
+    """The bounded admission queue is at capacity (HTTP 429)."""
+
+    http_status = 429
+
+
+class DeadlineExpired(ServeRejected):
+    """The request's deadline passed before dispatch (HTTP 504). Expired
+    requests are dropped *before* the device call — never after."""
+
+    http_status = 504
+
+
+class ModelUnavailable(ServeRejected):
+    """The model's serve path is degraded and configured to shed
+    (breaker open, ``degraded='shed'``) — HTTP 503 with Retry-After."""
+
+    http_status = 503
+
+
+class Draining(ServeRejected):
+    """The server is draining for shutdown/reload: accepted work completes,
+    new work is rejected (HTTP 503)."""
+
+    http_status = 503
+
+
+class ModelNotFound(ServeRejected):
+    """No such model in the registry (HTTP 404)."""
+
+    http_status = 404
+
+    def __init__(self, name: str, known: list[str]):
+        super().__init__(f'unknown model {name!r} (loaded: {sorted(known)})')
+
+
+class InferRequest:
+    """One admitted inference request: a block of sample rows plus its
+    deadline, resolved to either a result batch or a structured error."""
+
+    __slots__ = ('id', 'x', 'n_rows', 'deadline', 't_enq', 't_done', 'served_by', '_done', '_result', '_error')
+
+    def __init__(self, x: NDArray[np.float64], deadline_s: float | None):
+        self.id = next(_req_ids)
+        self.x = x
+        self.n_rows = int(x.shape[0])
+        now = time.monotonic()
+        self.t_enq = now
+        self.t_done: float | None = None
+        self.deadline = now + deadline_s if deadline_s is not None and deadline_s > 0 else None
+        self.served_by: str | None = None
+        self._done = threading.Event()
+        self._result: NDArray[np.float64] | None = None
+        self._error: BaseException | None = None
+
+    # -- producer side -----------------------------------------------------
+
+    def set_result(self, y: NDArray[np.float64], served_by: str) -> None:
+        self._result = y
+        self.served_by = served_by
+        self.t_done = time.monotonic()
+        self._done.set()
+
+    def set_error(self, exc: BaseException) -> None:
+        self._error = exc
+        self.t_done = time.monotonic()
+        self._done.set()
+
+    # -- consumer side -----------------------------------------------------
+
+    def expired(self, now: float | None = None) -> bool:
+        return self.deadline is not None and (now if now is not None else time.monotonic()) > self.deadline
+
+    def slack_s(self, now: float) -> float:
+        """Seconds until the deadline (inf when unbounded)."""
+        return float('inf') if self.deadline is None else self.deadline - now
+
+    def result(self, timeout: float | None = None) -> NDArray[np.float64]:
+        """Block for the outcome; re-raises the structured error on reject."""
+        if not self._done.wait(timeout):
+            raise DeadlineExpired(f'request {self.id}: no response within {timeout}s wait')
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+    @property
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    def wait_s(self) -> float:
+        """Queue wait + service time (enqueue -> resolution)."""
+        return (self.t_done if self.t_done is not None else time.monotonic()) - self.t_enq
+
+
+class AdmissionQueue:
+    """Bounded request queue with configurable shed policy.
+
+    Capacity is counted in sample *rows*, not requests — the device cost
+    and the memory ceiling both scale with rows. ``push`` either admits,
+    sheds a queued victim (``deadline-edf``), or raises :class:`QueueFull`;
+    ``take_batch`` blocks for the coalescing window and returns the next
+    batch in service order.
+    """
+
+    def __init__(self, cap_rows: int, policy: str = 'reject-newest'):
+        if policy not in ('reject-newest', 'deadline-edf'):
+            raise ValueError(f"shed policy must be 'reject-newest' or 'deadline-edf', got {policy!r}")
+        self.cap_rows = int(cap_rows)
+        self.policy = policy
+        self._items: list[InferRequest] = []
+        self._rows = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.shed_total = 0
+        self.admitted_total = 0
+
+    # -- admission ----------------------------------------------------------
+
+    def _retry_after(self, rate_rows_s: float | None) -> float:
+        """Backpressure hint: time to drain the current backlog at the
+        recent service rate (conservative 100 ms floor)."""
+        if not rate_rows_s or rate_rows_s <= 0:
+            return 1.0
+        return max(self._rows / rate_rows_s, 0.1)
+
+    def push(self, req: InferRequest, rate_rows_s: float | None = None) -> InferRequest | None:
+        """Admit ``req``; returns an evicted victim (already rejected via
+        ``set_error``) under ``deadline-edf``, or None. Raises
+        :class:`QueueFull` when the request itself is shed."""
+        with self._cond:
+            if req.n_rows > self.cap_rows:
+                raise QueueFull(
+                    f'request of {req.n_rows} rows exceeds the queue capacity of {self.cap_rows} rows '
+                    f'(split the batch client-side)'
+                )
+            victim = None
+            if self._rows + req.n_rows > self.cap_rows:
+                self.shed_total += 1
+                if self.policy == 'reject-newest':
+                    raise QueueFull(
+                        f'admission queue full ({self._rows}/{self.cap_rows} rows)',
+                        retry_after_s=self._retry_after(rate_rows_s),
+                    )
+                # deadline-edf: evict the queued request with the most slack
+                # if the arrival is strictly more urgent, else reject arrival
+                now = time.monotonic()
+                idx = max(range(len(self._items)), key=lambda i: self._items[i].slack_s(now))
+                if self._items[idx].slack_s(now) <= req.slack_s(now):
+                    raise QueueFull(
+                        f'admission queue full ({self._rows}/{self.cap_rows} rows) and every queued '
+                        f'request is at least as urgent',
+                        retry_after_s=self._retry_after(rate_rows_s),
+                    )
+                victim = self._items.pop(idx)
+                self._rows -= victim.n_rows
+                if self._rows + req.n_rows > self.cap_rows:
+                    # a single eviction must make room (victim at least as
+                    # large is not guaranteed): keep the ceiling hard
+                    self._items.append(victim)
+                    self._rows += victim.n_rows
+                    raise QueueFull(
+                        f'admission queue full ({self._rows}/{self.cap_rows} rows); eviction cannot fit '
+                        f'a {req.n_rows}-row request',
+                        retry_after_s=self._retry_after(rate_rows_s),
+                    )
+            self._items.append(req)
+            self._rows += req.n_rows
+            self.admitted_total += 1
+            self._cond.notify()
+        if victim is not None:
+            victim.set_error(
+                QueueFull('shed by deadline-edf policy: a more urgent request arrived', retry_after_s=0.5)
+            )
+        return victim
+
+    # -- service ------------------------------------------------------------
+
+    def _next_idx_locked(self, now: float) -> int:
+        if self.policy == 'deadline-edf':
+            return min(range(len(self._items)), key=lambda i: self._items[i].slack_s(now))
+        return 0
+
+    def _pop_locked(self, idx: int) -> InferRequest:
+        req = self._items.pop(idx)
+        self._rows -= req.n_rows
+        return req
+
+    def take_batch(
+        self,
+        max_rows: int,
+        window_s: float,
+        stop: threading.Event,
+        poll_s: float = 0.05,
+    ) -> list[InferRequest]:
+        """Block until work arrives, then coalesce for up to ``window_s``.
+
+        The window opens when the first request is taken; more requests
+        join until the row budget fills or the window closes. A request
+        that would overshoot the row budget stays queued for the next
+        batch (so every dispatched batch fits the prewarmed canonical
+        grid) — except the first, which is always taken. Returns [] when
+        ``stop`` is set and the queue is empty (shutdown path) — queued
+        work is always drained before the batcher exits.
+        """
+        batch: list[InferRequest] = []
+        rows = 0
+        with self._cond:
+            while not self._items:
+                if stop.is_set():
+                    return []
+                self._cond.wait(poll_s)
+            t_open = time.monotonic()
+            full = False
+            while True:
+                now = time.monotonic()
+                while self._items:
+                    idx = self._next_idx_locked(now)
+                    if batch and rows + self._items[idx].n_rows > max_rows:
+                        full = True
+                        break
+                    req = self._pop_locked(idx)
+                    batch.append(req)
+                    rows += req.n_rows
+                    if rows >= max_rows:
+                        full = True
+                        break
+                if full or stop.is_set():
+                    break
+                remaining = window_s - (time.monotonic() - t_open)
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, poll_s))
+        return batch
+
+    # -- introspection -------------------------------------------------------
+
+    def depth_rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+    def depth_requests(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def oldest_age_s(self) -> float:
+        """Age of the oldest queued request (0 when empty) — the /healthz
+        queue-stall signal."""
+        with self._lock:
+            if not self._items:
+                return 0.0
+            return time.monotonic() - min(r.t_enq for r in self._items)
+
+    def flush(self, exc_factory) -> int:
+        """Reject every queued request with ``exc_factory()`` (hard-stop
+        path only; graceful drain serves the queue instead). Returns the
+        number rejected."""
+        with self._cond:
+            items, self._items, self._rows = self._items, [], 0
+        for r in items:
+            r.set_error(exc_factory())
+        return len(items)

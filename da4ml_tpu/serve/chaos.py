@@ -1,0 +1,175 @@
+"""Serve-plane chaos drill: breaker trip + hot reload under sustained load.
+
+The acceptance contract (ISSUE 8 / docs/serving.md#chaos): with a
+closed-loop load running against a warm server,
+
+1. injected device-dispatch failures (``serve.dispatch`` fault site) trip
+   the model's circuit breaker — the serve path degrades to the bit-exact
+   fallback chain (or bounded 503s), ``/healthz`` reports degraded while
+   the breaker is open, and a half-open probe recovers it WITHOUT a
+   process restart;
+2. a hot executor reload mid-load drops no queued work;
+3. across the whole drill: availability of in-deadline requests stays
+   ≥ 99%, every response is bit-exact vs the numpy oracle, and every
+   rejection is structured (429/503/504) — zero wrong answers, zero hangs.
+
+Run via ``da4ml-tpu serve --chaos`` (the CI ``serve-chaos`` job) or
+programmatically (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from .. import telemetry
+from ..reliability.breaker import breaker_for
+from ..reliability.faults import fault_injection
+from .engine import ServeConfig, ServeEngine
+from .loadgen import closed_loop, engine_infer_fn, make_request_pool
+
+
+def _default_model():
+    """A small deterministic CMVM model (host solve — fast, no device)."""
+    from ..cmvm import solve
+
+    rng = np.random.default_rng(7)
+    kernel = rng.integers(-8, 8, (8, 6)).astype(np.float64)
+    return solve(kernel, backend='cpu')
+
+
+def _numpy_oracle(binaries):
+    from ..runtime.numpy_backend import run_binary
+
+    def oracle(x):
+        out = np.asarray(x, dtype=np.float64)
+        for b in binaries:
+            out = run_binary(b, out)
+        return out
+
+    return oracle
+
+
+def _healthz_status(url: str) -> str:
+    try:
+        with urllib.request.urlopen(f'{url}/healthz', timeout=5) as resp:
+            return json.load(resp).get('status', '?')
+    except urllib.error.HTTPError as e:  # 503 = degraded, still a valid doc
+        try:
+            return json.load(e).get('status', 'degraded')
+        except Exception:
+            return 'degraded'
+    except Exception:
+        return 'unreachable'
+
+
+def chaos_drill(
+    source=None,
+    *,
+    duration_s: float = 6.0,
+    workers: int = 4,
+    deadline_ms: float = 500.0,
+    config: ServeConfig | None = None,
+) -> dict:
+    """Run the breaker-trip + reload drill; returns a gateable report."""
+    from .http import ServeServer
+
+    model = source if source is not None else _default_model()
+    cfg = config or ServeConfig(
+        max_batch_rows=64,
+        max_latency_ms=2.0,
+        queue_cap_rows=512,
+        breaker_threshold=3,
+        breaker_reset_s=1.0,
+        degraded='fallback',
+        default_deadline_ms=deadline_ms,
+    )
+    engine = ServeEngine(cfg)
+    engine.load_model('drill', model)
+    server = ServeServer(engine)
+    oracle = _numpy_oracle(engine._state('drill').binaries)
+    pool = make_request_pool(oracle, engine._state('drill').n_in, rows_choices=(1, 2, 4, 8), pool=24)
+    infer = engine_infer_fn(engine, 'drill')
+
+    phases: dict[str, dict] = {}
+    report_box: dict = {}
+    events: list[str] = []
+
+    def load_thread():
+        report_box['load'] = closed_loop(
+            infer, pool, workers=workers, duration_s=duration_s, deadline_ms=deadline_ms
+        )
+
+    with telemetry.span('serve.chaos_drill'):
+        lt = threading.Thread(target=load_thread, daemon=True)
+        lt.start()
+        t_phase = max(duration_s / 4.0, 0.5)
+        time.sleep(t_phase)  # phase 1: steady state
+        phases['steady_healthz'] = {'status': _healthz_status(server.url)}
+
+        # phase 2: trip the breaker with injected dispatch failures
+        br = breaker_for('serve.drill')
+        with fault_injection(f'serve.dispatch=error:{cfg.breaker_threshold + 1}'):
+            t_trip = time.monotonic()
+            while br.state != 'open' and time.monotonic() - t_trip < t_phase * 2:
+                time.sleep(0.02)
+        tripped = br.state != 'closed'
+        degraded_seen = _healthz_status(server.url)
+        events.append(f'breaker tripped={tripped} healthz={degraded_seen}')
+        # recovery: cooldown elapses, a half-open probe closes the breaker
+        t_rec = time.monotonic()
+        while br.state != 'closed' and time.monotonic() - t_rec < cfg.breaker_reset_s + t_phase * 4:
+            time.sleep(0.05)
+        recovered = br.state == 'closed'
+        phases['breaker'] = {
+            'tripped': tripped,
+            'healthz_while_open': degraded_seen,
+            'recovered_without_restart': recovered,
+            'healthz_after': _healthz_status(server.url),
+        }
+
+        # phase 3: hot reload mid-load
+        version = engine.reload('drill')
+        phases['reload'] = {'new_version': version}
+
+        lt.join(duration_s + 120.0)
+
+    load = report_box.get('load', {})
+    final_health = _healthz_status(server.url)
+    server.close()
+    drained = engine.close(timeout=30.0)
+
+    ok = bool(
+        load
+        and load.get('mismatches', 1) == 0
+        and load.get('errors', 1) == 0
+        and (load.get('availability') or 0.0) >= 0.99
+        and phases['breaker']['tripped']
+        and phases['breaker']['recovered_without_restart']
+        and phases['reload']['new_version'] >= 2
+        and final_health == 'ok'
+        and drained
+    )
+    return {
+        'ok': ok,
+        'load': load,
+        'phases': phases,
+        'events': events,
+        'final_healthz': final_health,
+        'drained': drained,
+        'checks': {
+            'bit_exact': load.get('mismatches', 1) == 0,
+            'availability_ge_99': (load.get('availability') or 0.0) >= 0.99,
+            'no_unstructured_errors': load.get('errors', 1) == 0,
+            'breaker_tripped': phases['breaker']['tripped'],
+            'recovered_without_restart': phases['breaker']['recovered_without_restart'],
+            'reloaded_under_load': phases['reload']['new_version'] >= 2,
+            'healthz_ok_at_end': final_health == 'ok',
+            'drained_clean': drained,
+        },
+    }

@@ -1,0 +1,653 @@
+"""The serve engine: model registry, batcher threads, and the robustness
+envelope around ``runtime.jax_backend`` executors.
+
+One :class:`ServeEngine` owns a multi-model registry. Per model it runs a
+bounded :class:`~.batching.AdmissionQueue` and one batcher thread that
+coalesces requests into canonical-grid batches (docs/serving.md). The
+envelope, built from the ``reliability`` primitives:
+
+- **deadlines** — expired requests are rejected *before* dispatch;
+- **circuit breaker** per model (``serve.<model>`` in the shared breaker
+  registry, so ``/healthz`` and the OpenMetrics ``breaker.state`` family
+  see it like any backend breaker);
+- **degradation ladder** — a dispatch failure falls back to the bit-exact
+  ``reliability.run_program`` chain for *that batch*; an OPEN breaker
+  drops the serve path to degraded mode: smaller max batch on the
+  fallback chain (``degraded='fallback'``) or structured 503s with
+  Retry-After (``degraded='shed'``). Answers are never wrong — all chain
+  runtimes are bit-exact — only slower or shed;
+- **hedged dispatch** — an optional straggler hedge races the fallback
+  chain against a slow device batch and takes the first finisher;
+- **graceful drain / hot reload** — drain serves every accepted request
+  then stops; reload builds + warms the new executor off to the side and
+  swaps it atomically between batches, dropping nothing.
+
+The compiled-executor cache is LRU-bounded across models; ``warmup``
+pre-dispatches every canonical batch rung so a warm server never meets a
+new XLA shape (the ``serve.shape_miss`` counter stays 0).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+from numpy.typing import NDArray
+
+from .. import telemetry
+from ..ir.dais_binary import decode
+from ..parallel.shapes import canon_dim, grid_rungs
+from ..reliability.breaker import breaker_for
+from ..reliability.errors import InvalidInputError
+from ..reliability.faults import fault_check
+from .batching import (
+    AdmissionQueue,
+    DeadlineExpired,
+    Draining,
+    InferRequest,
+    ModelNotFound,
+    ModelUnavailable,
+    ServeRejected,
+)
+
+#: batch fill-ratio histogram ladder (rows dispatched / row budget)
+FILL_BUCKETS: tuple[float, ...] = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+#: queue age beyond which /healthz reports the serve plane degraded
+DEFAULT_QUEUE_STALL_S = 10.0
+
+
+def _queue_stall_s() -> float:
+    try:
+        return float(os.environ.get('DA4ML_SERVE_STALL_S', '') or DEFAULT_QUEUE_STALL_S)
+    except ValueError:
+        return DEFAULT_QUEUE_STALL_S
+
+
+@dataclass
+class ServeConfig:
+    """Tuning knobs of the serve plane (docs/serving.md#tuning)."""
+
+    max_batch_rows: int = 256  #: row budget per coalesced device batch
+    max_latency_ms: float = 5.0  #: coalescing window from the first queued request
+    queue_cap_rows: int = 1024  #: hard admission ceiling (rows) per model
+    shed_policy: str = 'reject-newest'  #: or 'deadline-edf'
+    default_deadline_ms: float | None = 1000.0  #: applied when a request carries none (None = unbounded)
+    hedge_ms: float = 0.0  #: straggler hedge: race the fallback chain after this long (0 = off)
+    degraded: str = 'fallback'  #: OPEN-breaker mode: 'fallback' (small batches, bit-exact chain) or 'shed' (503)
+    degraded_max_rows: int = 32  #: row budget while degraded
+    breaker_threshold: int = 3  #: consecutive dispatch failures that open the model's breaker
+    breaker_reset_s: float = 5.0  #: OPEN cooldown before a half-open probe
+    executor_cache_cap: int = 8  #: compiled executors kept across models (LRU)
+    prewarm: bool = True  #: warm the canonical batch grid on load
+    fallback_chain: tuple[str, ...] = ('cpp', 'numpy')  #: bit-exact chain for degraded/hedged batches
+
+    def __post_init__(self):
+        if self.shed_policy not in ('reject-newest', 'deadline-edf'):
+            raise ValueError(f'bad shed_policy {self.shed_policy!r}')
+        if self.degraded not in ('fallback', 'shed'):
+            raise ValueError(f"degraded must be 'fallback' or 'shed', got {self.degraded!r}")
+
+
+@dataclass
+class _ModelState:
+    name: str
+    binaries: list[NDArray[np.int32]]
+    source: str | None
+    version: int = 1
+    queue: AdmissionQueue = field(default=None)  # type: ignore[assignment]
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    stop: threading.Event = field(default_factory=threading.Event)
+    warm_rows: set[int] = field(default_factory=set)
+    n_in: int = 0
+    n_out: int = 0
+    requests_total: int = 0
+    deadline_miss_total: int = 0
+    degraded_total: int = 0
+    served_rows_total: int = 0
+    served_s_total: float = 0.0
+
+
+def _as_binaries(source) -> tuple[list[NDArray[np.int32]], str | None]:
+    """Normalize a model source into its per-stage DAIS binaries.
+
+    Accepts a saved CombLogic/Pipeline ``.json`` path, a live
+    ``CombLogic``/``Pipeline``, or raw binaries (one int32 array or a
+    list of them).
+    """
+    from ..ir.comb import CombLogic, Pipeline
+
+    if isinstance(source, (str, Path)):
+        path = Path(source)
+        import json
+
+        data = json.loads(path.read_text())
+        obj = Pipeline.from_dict(data) if 'stages' in data else CombLogic.from_dict(data)
+        bins, _ = _as_binaries(obj)
+        return bins, str(path)
+    if isinstance(source, Pipeline):
+        return [s.to_binary() for s in source.stages], None
+    if isinstance(source, CombLogic):
+        return [source.to_binary()], None
+    if isinstance(source, np.ndarray):
+        return [np.asarray(source, dtype=np.int32)], None
+    if isinstance(source, (list, tuple)):
+        return [np.asarray(b, dtype=np.int32) for b in source], None
+    raise TypeError(f'cannot load a serve model from {type(source).__name__}')
+
+
+#: live engines, for the /healthz–/statusz serve-plane checks
+#: (telemetry.obs.health resolves this module via sys.modules — a scrape
+#: never imports the serve stack)
+_ENGINES: 'weakref.WeakSet[ServeEngine]' = weakref.WeakSet()
+
+
+class ServeEngine:
+    def __init__(self, config: ServeConfig | None = None):
+        self.config = config or ServeConfig()
+        self._models: dict[str, _ModelState] = {}
+        self._workers: dict[str, threading.Thread] = {}
+        self._executors: 'dict[str, tuple[int, object]]' = {}  # name -> (version, executor), LRU
+        self._exec_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._draining = False
+        self._shed_times: list[float] = []  # recent shed timestamps (rate window)
+        self.started_at = time.time()
+        _ENGINES.add(self)
+
+    # -- registry ------------------------------------------------------------
+
+    def load_model(self, name: str, source, prewarm: bool | None = None) -> None:
+        """Load (or replace) a model and start its batcher thread."""
+        binaries, src = _as_binaries(source)
+        prog0, progL = decode(binaries[0]), decode(binaries[-1])
+        with self._lock:
+            existing = self._models.get(name)
+            if existing is not None:
+                raise ValueError(f'model {name!r} already loaded (use reload())')
+            state = _ModelState(name=name, binaries=binaries, source=src)
+            state.n_in, state.n_out = prog0.n_in, progL.n_out
+            state.queue = AdmissionQueue(self.config.queue_cap_rows, self.config.shed_policy)
+            self._models[name] = state
+            worker = threading.Thread(target=self._batcher_loop, args=(state,), name=f'da4ml-serve-{name}', daemon=True)
+            self._workers[name] = worker
+        breaker_for(f'serve.{name}', self.config.breaker_threshold, self.config.breaker_reset_s)
+        worker.start()
+        if self.config.prewarm if prewarm is None else prewarm:
+            self.warmup(name)
+
+    def reload(self, name: str, source=None) -> int:
+        """Hot-swap a model's executor without dropping queued work.
+
+        Builds (and warms) the replacement off to the side, then swaps the
+        binaries + executor atomically between batches; in-flight batches
+        finish on the old executor. ``source=None`` re-reads the original
+        path. Returns the new version number.
+        """
+        state = self._state(name)
+        if source is None:
+            if state.source is None:
+                source = state.binaries  # rebuild in place (executor refresh)
+            else:
+                source = state.source
+        binaries, src = _as_binaries(source)
+        prog0, progL = decode(binaries[0]), decode(binaries[-1])
+        if (prog0.n_in, progL.n_out) != (state.n_in, state.n_out):
+            raise ValueError(
+                f'reload of {name!r} changes the interface '
+                f'({state.n_in}->{prog0.n_in} in, {state.n_out}->{progL.n_out} out); load a new model name instead'
+            )
+        new_version = state.version + 1
+        executor = self._build_executor(binaries)
+        warm = set()
+        if self.config.prewarm:
+            warm = self._warm_executor(executor, state.n_in)
+        with state.lock:
+            state.binaries = binaries
+            state.version = new_version
+            state.warm_rows = warm
+            if src is not None:
+                state.source = src
+        with self._exec_lock:
+            self._executors.pop(name, None)
+            self._executors[name] = (new_version, executor)  # re-insert = LRU touch
+        telemetry.counter('serve.reloads').inc()
+        telemetry.instant('serve.reload', model=name, version=new_version)
+        return new_version
+
+    def unload(self, name: str) -> None:
+        """Drain one model's queue (serving what was accepted) and drop it."""
+        state = self._state(name)
+        deadline = time.monotonic() + 30.0
+        while state.queue.depth_requests() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        state.stop.set()
+        with self._lock:
+            self._models.pop(name, None)
+            worker = self._workers.pop(name, None)
+        with self._exec_lock:
+            self._executors.pop(name, None)
+        if worker is not None:
+            worker.join(max(deadline - time.monotonic(), 0.05))
+
+    def models(self) -> dict:
+        """The ``/v1/models`` document."""
+        with self._lock:
+            states = list(self._models.values())
+        with self._exec_lock:
+            cached = {n: v for n, (v, _) in self._executors.items()}
+        return {
+            'models': [
+                {
+                    'name': s.name,
+                    'version': s.version,
+                    'source': s.source,
+                    'n_in': s.n_in,
+                    'n_out': s.n_out,
+                    'stages': len(s.binaries),
+                    'queue_rows': s.queue.depth_rows(),
+                    'queue_requests': s.queue.depth_requests(),
+                    'queue_age_s': round(s.queue.oldest_age_s(), 4),
+                    'breaker': breaker_for(f'serve.{s.name}').state,
+                    'executor_cached': s.name in cached,
+                    'warm_rungs': sorted(s.warm_rows),
+                    'requests_total': s.requests_total,
+                    'shed_total': s.queue.shed_total,
+                    'deadline_miss_total': s.deadline_miss_total,
+                    'degraded_total': s.degraded_total,
+                }
+                for s in states
+            ],
+            'executor_cache': {'occupancy': len(cached), 'cap': self.config.executor_cache_cap, 'entries': cached},
+            'draining': self._draining,
+        }
+
+    def _state(self, name: str) -> _ModelState:
+        with self._lock:
+            state = self._models.get(name)
+        if state is None:
+            raise ModelNotFound(name, list(self._models))
+        return state
+
+    # -- executors ------------------------------------------------------------
+
+    def _build_executor(self, binaries: list[NDArray[np.int32]]):
+        from ..runtime.jax_backend import DaisExecutor, PipelineExecutor
+
+        if len(binaries) == 1:
+            return DaisExecutor(decode(binaries[0]))
+        return PipelineExecutor([decode(b) for b in binaries])
+
+    def _executor_for(self, state: _ModelState):
+        """The model's compiled executor, built on demand into the
+        LRU-bounded cross-model cache."""
+        with self._exec_lock:
+            entry = self._executors.get(state.name)
+            if entry is not None and entry[0] == state.version:
+                self._executors[state.name] = self._executors.pop(state.name)  # LRU touch (dict keeps insertion order)
+                return entry[1]
+        executor = self._build_executor(state.binaries)
+        with self._exec_lock:
+            while len(self._executors) >= self.config.executor_cache_cap:
+                oldest = next(iter(self._executors))
+                if oldest == state.name:
+                    self._executors.pop(oldest)
+                    continue
+                self._executors.pop(oldest)
+                telemetry.counter('serve.executor_evictions').inc()
+            self._executors[state.name] = (state.version, executor)
+        return executor
+
+    def _warm_executor(self, executor, n_in: int) -> set[int]:
+        """Dispatch one zero batch per canonical grid rung so every batch
+        shape a warm server can produce is already compiled."""
+        warm: set[int] = set()
+        with telemetry.span('serve.warmup', rungs=0) as sp:
+            for r in grid_rungs(self.config.max_batch_rows):
+                executor(np.zeros((r, max(n_in, 1)), dtype=np.float64))
+                warm.add(r)
+            sp.set(rungs=len(warm))
+        return warm
+
+    def warmup(self, name: str | None = None) -> int:
+        """Synchronously prewarm one model (or all). Returns rung count."""
+        names = [name] if name is not None else list(self._models)
+        total = 0
+        for n in names:
+            state = self._state(n)
+            executor = self._executor_for(state)
+            warm = self._warm_executor(executor, state.n_in)
+            with state.lock:
+                state.warm_rows = warm
+            total += len(warm)
+        return total
+
+    # -- request path ---------------------------------------------------------
+
+    def submit(self, name: str, data, deadline_s: float | None = None) -> InferRequest:
+        """Validate + admit one request; returns its future-like handle.
+
+        Raises the structured taxonomy on rejection: ModelNotFound,
+        InvalidInputError (client bug), QueueFull (shed, with Retry-After),
+        Draining.
+        """
+        from ..runtime.jax_backend import validate_batch
+
+        state = self._state(name)
+        if self._draining or self._stop.is_set():
+            raise Draining('server is draining; retry against another replica', retry_after_s=1.0)
+        x = validate_batch(data, state.n_in, what=f'serve.{name}')
+        if x.shape[0] > self.config.max_batch_rows:
+            raise InvalidInputError(
+                f'serve.{name}: request of {x.shape[0]} rows exceeds the {self.config.max_batch_rows}-row '
+                f'batch budget; split the batch client-side'
+            )
+        if deadline_s is None and self.config.default_deadline_ms is not None:
+            deadline_s = self.config.default_deadline_ms / 1e3
+        req = InferRequest(x, deadline_s)
+        try:
+            state.queue.push(req, rate_rows_s=self._service_rate(state))
+        except ServeRejected:
+            self._note_shed()
+            raise
+        state.requests_total += 1
+        telemetry.counter('serve.requests').inc()
+        telemetry.gauge('serve.queue_depth').set(state.queue.depth_rows())
+        return req
+
+    def infer(self, name: str, data, deadline_s: float | None = None) -> NDArray[np.float64]:
+        """Blocking submit + wait (the in-process client used by bench and
+        the load generator; HTTP handlers do the same)."""
+        req = self.submit(name, data, deadline_s)
+        timeout = None
+        if req.deadline is not None:
+            # the batch holding this request may already be mid-dispatch
+            # when the deadline fires: give resolution a generous margin
+            # (expired-in-queue requests get DeadlineExpired either way)
+            timeout = max(req.deadline - time.monotonic(), 0.0) + 30.0
+        return req.result(timeout)
+
+    def _service_rate(self, state: _ModelState) -> float | None:
+        if state.served_s_total <= 0:
+            return None
+        return state.served_rows_total / state.served_s_total
+
+    def _note_shed(self) -> None:
+        telemetry.counter('serve.shed').inc()
+        now = time.monotonic()
+        self._shed_times.append(now)
+        if len(self._shed_times) > 4096:
+            del self._shed_times[:2048]
+
+    def shed_rate_1m(self) -> float:
+        now = time.monotonic()
+        return sum(1 for t in self._shed_times if now - t < 60.0) / 60.0
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _run_fallback_chain(self, state: _ModelState, x: NDArray[np.float64]) -> NDArray[np.float64]:
+        """Bit-exact answer off the device path: the existing
+        ``reliability.run_program`` chain, stage by stage, in
+        degraded-sized chunks."""
+        from ..reliability.orchestrator import run_program
+
+        chunk = max(int(self.config.degraded_max_rows), 1)
+        outs = []
+        for i in range(0, len(x), chunk):
+            part = x[i : i + chunk]
+            for b in state.binaries:
+                part = run_program(b, part, chain=self.config.fallback_chain)
+            outs.append(part)
+        return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    def _device_call(self, state: _ModelState, x: NDArray[np.float64]) -> NDArray[np.float64]:
+        """One padded, canonical-shape executor call (the breaker-guarded
+        primary path); ``serve.dispatch`` is a fault-injection site for the
+        chaos drill."""
+        fault_check('serve.dispatch')
+        executor = self._executor_for(state)
+        n = len(x)
+        target = canon_dim(n, lo=1, even=False)
+        if target not in state.warm_rows:
+            telemetry.counter('serve.shape_miss').inc()
+            state.warm_rows.add(target)
+        else:
+            telemetry.counter('serve.shape_hit').inc()
+        if target != n:
+            x = np.pad(x, ((0, target - n), (0, 0)))
+        y = executor(x)
+        return y[:n]
+
+    def _dispatch(self, state: _ModelState, x: NDArray[np.float64]) -> tuple[NDArray[np.float64], str]:
+        """The degradation ladder for one coalesced batch. Returns
+        ``(outputs, served_by)``; raises :class:`ModelUnavailable` only
+        when configured to shed while the breaker is open."""
+        br = breaker_for(f'serve.{state.name}', self.config.breaker_threshold, self.config.breaker_reset_s)
+        if br.allow():
+            try:
+                y = self._hedged_device_call(state, x) if self.config.hedge_ms > 0 else self._device_call(state, x)
+            except InvalidInputError:
+                br.record_success()  # the request is wrong, not the backend
+                raise
+            except Exception as e:
+                br.record_failure()
+                telemetry.counter('serve.dispatch_failures').inc()
+                telemetry.instant('serve.dispatch_failure', model=state.name, error=type(e).__name__)
+                # this batch is already accepted: answer it bit-exactly off
+                # the fallback chain rather than shedding accepted work
+                state.degraded_total += 1
+                telemetry.counter('serve.degraded').inc()
+                return self._run_fallback_chain(state, x), 'fallback'
+            else:
+                if isinstance(y, tuple):  # hedge returns (result, served_by)
+                    if y[1] == 'jax':
+                        br.record_success()
+                    return y
+                br.record_success()
+                return y, 'jax'
+        # breaker OPEN: degraded mode
+        if self.config.degraded == 'shed':
+            remaining = max(self.config.breaker_reset_s, 0.1)
+            raise ModelUnavailable(
+                f'model {state.name!r}: serve breaker open; shedding while degraded', retry_after_s=remaining
+            )
+        state.degraded_total += 1
+        telemetry.counter('serve.degraded').inc()
+        return self._run_fallback_chain(state, x), 'fallback'
+
+    def _hedged_device_call(self, state: _ModelState, x: NDArray[np.float64]):
+        """Race the device batch against the fallback chain after
+        ``hedge_ms`` of silence; first bit-exact answer wins."""
+        box: dict = {}
+        done = threading.Event()
+
+        def primary():
+            try:
+                box['y'] = self._device_call(state, x)
+            except BaseException as e:  # noqa: BLE001 - relayed below
+                box['e'] = e
+            done.set()
+
+        t = threading.Thread(target=primary, name=f'da4ml-serve-hedge-{state.name}', daemon=True)
+        t.start()
+        if done.wait(self.config.hedge_ms / 1e3):
+            if 'e' in box:
+                raise box['e']
+            return box['y'], 'jax'
+        telemetry.counter('serve.hedge_fired').inc()
+        y2 = self._run_fallback_chain(state, x)
+        if done.is_set() and 'y' in box:
+            return box['y'], 'jax'
+        telemetry.counter('serve.hedge_won').inc()
+        return y2, 'hedge-fallback'
+
+    # -- batcher loop ---------------------------------------------------------
+
+    def _effective_max_rows(self, state: _ModelState) -> int:
+        br = breaker_for(f'serve.{state.name}', self.config.breaker_threshold, self.config.breaker_reset_s)
+        if br.state != 'closed':
+            return min(self.config.max_batch_rows, self.config.degraded_max_rows)
+        return self.config.max_batch_rows
+
+    def _batcher_loop(self, state: _ModelState) -> None:
+        window_s = self.config.max_latency_ms / 1e3
+        while True:
+            batch = state.queue.take_batch(self._effective_max_rows(state), window_s, state.stop)
+            if not batch:
+                if state.stop.is_set():
+                    return
+                continue
+            self._serve_batch(state, batch)
+
+    def _serve_batch(self, state: _ModelState, batch: list[InferRequest]) -> None:
+        now = time.monotonic()
+        live: list[InferRequest] = []
+        for r in batch:
+            if r.expired(now):
+                state.deadline_miss_total += 1
+                telemetry.counter('serve.deadline_miss').inc()
+                r.set_error(
+                    DeadlineExpired(f'request {r.id}: deadline passed while queued ({r.wait_s() * 1e3:.1f} ms)')
+                )
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.n_rows for r in live)
+        x = np.concatenate([r.x for r in live], axis=0) if len(live) > 1 else live[0].x
+        t0 = time.perf_counter()
+        with telemetry.span('serve.batch', model=state.name, rows=rows, requests=len(live)) as sp:
+            try:
+                y, served_by = self._dispatch(state, x)
+            except ServeRejected as e:
+                for r in live:
+                    r.set_error(e)
+                sp.set(outcome=type(e).__name__)
+                return
+            except Exception as e:  # the fallback chain itself failed
+                err = ModelUnavailable(f'model {state.name!r}: all serve paths failed: {e}', retry_after_s=1.0)
+                for r in live:
+                    r.set_error(err)
+                sp.set(outcome='error')
+                return
+            sp.set(outcome=served_by)
+        dt = time.perf_counter() - t0
+        off = 0
+        for r in live:
+            r.set_result(y[off : off + r.n_rows], served_by)
+            off += r.n_rows
+            telemetry.histogram('serve.latency_s').observe(r.wait_s())
+            telemetry.histogram('serve.queue_wait_s').observe(max(r.wait_s() - dt, 0.0))
+        state.served_rows_total += rows
+        state.served_s_total += dt
+        telemetry.counter('serve.batches').inc()
+        telemetry.counter('serve.samples').inc(rows)
+        telemetry.histogram('serve.batch_rows', telemetry.COUNT_BUCKETS).observe(rows)
+        telemetry.histogram('serve.batch_fill', FILL_BUCKETS).observe(rows / max(self.config.max_batch_rows, 1))
+        telemetry.gauge('serve.queue_depth').set(state.queue.depth_rows())
+        telemetry.gauge('serve.queue_age_s').set(state.queue.oldest_age_s())
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, serve everything already accepted, stop batchers.
+
+        Returns True when every queue drained and every batcher exited
+        within ``timeout`` — the zero-lost-accepted-requests guarantee of
+        SIGTERM shutdown (tests/test_serve.py).
+        """
+        self._draining = True
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            states = list(self._models.values())
+        for s in states:
+            while s.queue.depth_requests() and time.monotonic() < deadline:
+                time.sleep(0.005)
+        self._stop.set()
+        for s in states:
+            s.stop.set()
+        ok = all(s.queue.depth_requests() == 0 for s in states)
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.join(max(deadline - time.monotonic(), 0.05))
+            ok = ok and not w.is_alive()
+        return ok
+
+    def close(self, timeout: float = 30.0) -> bool:
+        ok = self.drain(timeout)
+        _ENGINES.discard(self)
+        return ok
+
+    # -- health ---------------------------------------------------------------
+
+    def health_doc(self) -> dict:
+        """Serve-plane health: queue stall, shed rate, per-model breakers
+        (feeds the process /healthz — telemetry.obs.health)."""
+        stall_s = _queue_stall_s()
+        with self._lock:
+            states = list(self._models.values())
+        models = {}
+        degraded = False
+        for s in states:
+            br_state = breaker_for(f'serve.{s.name}').state
+            age = s.queue.oldest_age_s()
+            stalled = age > stall_s
+            degraded = degraded or stalled or br_state == 'open'
+            models[s.name] = {
+                'queue_rows': s.queue.depth_rows(),
+                'queue_age_s': round(age, 4),
+                'stalled': stalled,
+                'breaker': br_state,
+                'shed_total': s.queue.shed_total,
+                'deadline_miss_total': s.deadline_miss_total,
+                'degraded_total': s.degraded_total,
+            }
+        return {
+            'status': 'degraded' if degraded else 'ok',
+            'draining': self._draining,
+            'shed_rate_1m': round(self.shed_rate_1m(), 4),
+            'queue_stall_threshold_s': stall_s,
+            'models': models,
+        }
+
+
+def serve_health() -> dict | None:
+    """Aggregate health over live engines (None when none exist) — resolved
+    by ``telemetry.obs.health`` via ``sys.modules``, never by import."""
+    engines = list(_ENGINES)
+    if not engines:
+        return None
+    docs = [e.health_doc() for e in engines]
+    status = 'degraded' if any(d['status'] == 'degraded' for d in docs) else 'ok'
+    merged_models: dict = {}
+    for d in docs:
+        merged_models.update(d['models'])
+    return {
+        'status': status,
+        'engines': len(docs),
+        'draining': any(d['draining'] for d in docs),
+        'shed_rate_1m': round(sum(d['shed_rate_1m'] for d in docs), 4),
+        'models': merged_models,
+    }
+
+
+def serve_status() -> dict | None:
+    """Loaded models + executor-cache occupancy for ``/statusz``."""
+    engines = list(_ENGINES)
+    if not engines:
+        return None
+    out = {'engines': []}
+    for e in engines:
+        out['engines'].append(e.models())
+    return out

@@ -1,0 +1,200 @@
+"""Stdlib HTTP front-end for the serve engine (``da4ml-tpu serve``).
+
+Same fabric as the observability endpoint (``telemetry/obs/server.py``:
+``ThreadingHTTPServer``, daemon threads, quiet request logging) with the
+inference API mounted next to the monitoring routes — one port serves
+both planes (docs/serving.md#endpoints):
+
+- ``POST /v1/infer``                — ``{"model", "inputs", "deadline_ms"?}``
+  → ``{"outputs", "served_by", "latency_ms"}``; errors are structured
+  JSON with the taxonomy's HTTP status (400 invalid input, 404 unknown
+  model, 429 shed + ``Retry-After``, 503 degraded/draining +
+  ``Retry-After``, 504 deadline expired);
+- ``POST /v1/models/<name>/reload`` — hot-swap the model's executor;
+- ``GET  /v1/models``               — registry + executor-cache document;
+- ``GET  /metrics`` / ``/healthz`` / ``/statusz`` — the process
+  observability plane, mounted in-process (serve-plane checks included
+  via ``telemetry.obs.health``).
+
+Request handler threads block on the request's outcome — closed-loop
+clients see natural backpressure through connection concurrency, and the
+admission queue sheds anything beyond its hard ceiling.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..reliability.errors import InvalidInputError
+from .batching import ServeRejected
+from .engine import ServeEngine
+
+#: request body ceiling (bytes): a hard parse-side bound so a single fat
+#: POST cannot balloon memory before admission control even sees it
+MAX_BODY_BYTES = 64 << 20
+
+
+class ServeServer:
+    """HTTP wrapper around one :class:`ServeEngine`."""
+
+    def __init__(self, engine: ServeEngine, port: int = 0, host: str = '127.0.0.1'):
+        from ..telemetry.metrics import enable_metrics
+
+        enable_metrics()  # a serve endpoint without metrics is flying blind
+        self.engine = engine
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        srv = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            server_version = 'da4ml-serve'
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):  # quiet: per-request logs would swamp stderr
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str = 'application/json', headers: dict | None = None):
+                self.send_response(code)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, doc: dict, headers: dict | None = None):
+                self._send(code, json.dumps(doc, default=str).encode(), headers=headers)
+
+            def _send_error_doc(self, exc: BaseException):
+                if isinstance(exc, ServeRejected):
+                    doc = exc.to_doc()
+                    headers = {}
+                    if exc.retry_after_s is not None:
+                        headers['Retry-After'] = f'{max(exc.retry_after_s, 0.0):.3f}'
+                    self._send_json(exc.http_status, {'error': doc}, headers=headers)
+                elif isinstance(exc, InvalidInputError):
+                    self._send_json(400, {'error': {'type': 'InvalidInputError', 'message': str(exc), 'http_status': 400}})
+                else:
+                    self._send_json(
+                        500, {'error': {'type': type(exc).__name__, 'message': str(exc), 'http_status': 500}}
+                    )
+
+            # -- routes -----------------------------------------------------
+
+            def do_GET(self):
+                try:
+                    path = self.path.split('?', 1)[0]
+                    if path == '/v1/models':
+                        self._send_json(200, srv.engine.models())
+                    elif path == '/metrics':
+                        from ..telemetry.obs.health import refresh_computed_gauges
+                        from ..telemetry.obs.openmetrics import CONTENT_TYPE, render_openmetrics
+
+                        refresh_computed_gauges()
+                        self._send(200, render_openmetrics().encode(), CONTENT_TYPE)
+                    elif path == '/healthz':
+                        from ..telemetry.obs.health import health_snapshot
+
+                        doc = health_snapshot()
+                        self._send_json(200 if doc.get('status') == 'ok' else 503, doc)
+                    elif path == '/statusz':
+                        from ..telemetry.obs.health import status_snapshot
+
+                        self._send_json(200, status_snapshot())
+                    elif path in ('/', ''):
+                        body = b'da4ml_tpu serve: POST /v1/infer, GET /v1/models, /metrics /healthz /statusz\n'
+                        self._send(200, body, 'text/plain; charset=utf-8')
+                    else:
+                        self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
+                except Exception as e:  # a broken provider must not kill the thread
+                    try:
+                        self._send_error_doc(e)
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                try:
+                    path = self.path.split('?', 1)[0]
+                    if path == '/v1/infer':
+                        with srv._inflight_lock:
+                            srv._inflight += 1
+                        try:
+                            self._infer()
+                        finally:
+                            with srv._inflight_lock:
+                                srv._inflight -= 1
+                    elif path.startswith('/v1/models/') and path.endswith('/reload'):
+                        name = path[len('/v1/models/') : -len('/reload')]
+                        version = srv.engine.reload(name)
+                        self._send_json(200, {'model': name, 'version': version})
+                    elif path == '/v1/drain':
+                        ok = srv.engine.drain(timeout=30.0)
+                        self._send_json(200, {'drained': ok})
+                    else:
+                        self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
+                except Exception as e:
+                    try:
+                        self._send_error_doc(e)
+                    except Exception:
+                        pass
+
+            def _infer(self):
+                try:
+                    length = int(self.headers.get('Content-Length', '0') or 0)
+                except ValueError:
+                    length = 0
+                if length <= 0 or length > MAX_BODY_BYTES:
+                    raise InvalidInputError(f'request body must be 1..{MAX_BODY_BYTES} bytes, got {length}')
+                try:
+                    body = json.loads(self.rfile.read(length))
+                except ValueError as e:
+                    raise InvalidInputError(f'request body is not valid JSON: {e}') from e
+                if not isinstance(body, dict) or 'inputs' not in body:
+                    raise InvalidInputError("request body must be a JSON object with an 'inputs' field")
+                name = body.get('model', 'default')
+                deadline_ms = body.get('deadline_ms')
+                deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
+                req = srv.engine.submit(name, body['inputs'], deadline_s)
+                y = req.result(None if req.deadline is None else max(req.deadline - req.t_enq, 0.0) + 30.0)
+                self._send_json(
+                    200,
+                    {
+                        'model': name,
+                        'n': int(len(y)),
+                        'outputs': np.asarray(y).tolist(),
+                        'served_by': req.served_by,
+                        'latency_ms': round(req.wait_s() * 1e3, 3),
+                    },
+                )
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, name='da4ml-serve-http', daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f'http://{self.host}:{self.port}'
+
+    def close(self, grace_s: float = 10.0) -> None:
+        """Stop accepting and wait (up to ``grace_s``) for in-flight
+        handlers to finish writing their responses — a SIGTERM'd process
+        must not drop an accepted request's bytes on the floor."""
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass

@@ -1,0 +1,247 @@
+"""Closed-loop load generator + overload burst probe for the serve plane.
+
+``closed_loop`` runs N worker threads, each issuing back-to-back requests
+(classic closed-loop load: concurrency is the control variable, arrival
+rate follows service rate) against either an in-process engine or an HTTP
+endpoint, verifying every response bit-exactly against the numpy oracle.
+``burst`` is the overload probe: fire far more work than the queue ceiling
+admits at once and prove the ceiling holds — bounded shedding with
+structured rejections, zero deadlocks, zero wrong answers.
+
+Used by the ``bench.py`` ``serve`` section, the chaos drill
+(``serve.chaos``), and the CI ``serve-chaos`` job (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from ..reliability.errors import InvalidInputError
+from .batching import DeadlineExpired, QueueFull, ServeRejected
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class _Tally:
+    """Thread-safe outcome accumulator."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lat_ms: list[float] = []
+        self.ok = 0
+        self.shed = 0
+        self.deadline_miss = 0
+        self.unavailable = 0
+        self.invalid = 0
+        self.errors = 0
+        self.mismatches = 0
+        self.rows_ok = 0
+        self.served_by: dict[str, int] = {}
+
+    def record(self, outcome: str, lat_ms: float | None = None, rows: int = 0, served_by: str | None = None):
+        with self.lock:
+            setattr(self, outcome, getattr(self, outcome) + 1)
+            if lat_ms is not None:
+                self.lat_ms.append(lat_ms)
+            self.rows_ok += rows
+            if served_by:
+                self.served_by[served_by] = self.served_by.get(served_by, 0) + 1
+
+    def report(self, wall_s: float) -> dict:
+        with self.lock:
+            lat = sorted(self.lat_ms)
+            total = self.ok + self.shed + self.deadline_miss + self.unavailable + self.invalid + self.errors
+            rejected = self.shed + self.deadline_miss + self.unavailable
+            return {
+                'requests': total,
+                'ok': self.ok,
+                'shed': self.shed,
+                'deadline_miss': self.deadline_miss,
+                'unavailable': self.unavailable,
+                'invalid': self.invalid,
+                'errors': self.errors,
+                'mismatches': self.mismatches,
+                'availability': round(self.ok / total, 6) if total else None,
+                'bounded_rejections': rejected,
+                'samples_ok': self.rows_ok,
+                'samples_per_s': round(self.rows_ok / wall_s, 1) if wall_s > 0 else None,
+                'p50_ms': round(percentile(lat, 50), 3),
+                'p99_ms': round(percentile(lat, 99), 3),
+                'served_by': dict(self.served_by),
+                'wall_s': round(wall_s, 3),
+            }
+
+
+def make_request_pool(oracle, n_in: int, rows_choices=(1, 2, 4, 8), pool: int = 32, seed: int = 0):
+    """Deterministic request pool with precomputed oracle outputs.
+
+    ``oracle`` maps a float64 batch to the golden outputs (numpy chain);
+    returns a list of ``(x, y_expected)`` pairs the load workers cycle
+    through.
+    """
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(pool):
+        rows = int(rows_choices[i % len(rows_choices)])
+        x = np.round(rng.uniform(-4, 4, (rows, n_in)) * 16) / 16
+        out.append((x, oracle(x)))
+    return out
+
+
+def engine_infer_fn(engine, model: str):
+    """An ``infer(x, deadline_s) -> (y, served_by)`` callable over an
+    in-process engine."""
+
+    def call(x, deadline_s):
+        req = engine.submit(model, x, deadline_s)
+        y = req.result((deadline_s or 30.0) + 30.0)
+        return y, req.served_by or '?'
+
+    return call
+
+
+def http_infer_fn(url: str, model: str):
+    """Same contract over a running HTTP endpoint; raises the client-side
+    taxonomy mapped back from the structured error codes."""
+
+    def call(x, deadline_s):
+        body = json.dumps(
+            {
+                'model': model,
+                'inputs': np.asarray(x).tolist(),
+                **({'deadline_ms': deadline_s * 1e3} if deadline_s is not None else {}),
+            }
+        ).encode()
+        req = urllib.request.Request(f'{url}/v1/infer', data=body, headers={'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req, timeout=(deadline_s or 30.0) + 30.0) as resp:
+                doc = json.load(resp)
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.load(e).get('error', {})
+            except Exception:
+                pass
+            msg = payload.get('message', str(e))
+            if e.code == 429:
+                raise QueueFull(msg) from None
+            if e.code == 504:
+                raise DeadlineExpired(msg) from None
+            if e.code == 400:
+                raise InvalidInputError(msg) from None
+            raise ServeRejected(msg) from None
+        return np.asarray(doc['outputs'], dtype=np.float64), doc.get('served_by', '?')
+
+    return call
+
+
+def closed_loop(
+    infer_fn,
+    pool,
+    *,
+    workers: int = 4,
+    duration_s: float = 2.0,
+    deadline_ms: float | None = 200.0,
+    check_exact: bool = True,
+) -> dict:
+    """Closed-loop load: each worker issues sequential requests from the
+    pool for ``duration_s``, verifying bit-exactness. Returns the tally
+    report (p50/p99 latency, samples/s, availability, shed counts)."""
+    tally = _Tally()
+    stop = time.monotonic() + duration_s
+    deadline_s = deadline_ms / 1e3 if deadline_ms is not None else None
+
+    def worker(wid: int):
+        i = wid
+        while time.monotonic() < stop:
+            x, y_exp = pool[i % len(pool)]
+            i += workers
+            t0 = time.perf_counter()
+            try:
+                y, served_by = infer_fn(x, deadline_s)
+            except QueueFull:
+                tally.record('shed')
+            except DeadlineExpired:
+                tally.record('deadline_miss')
+            except InvalidInputError:
+                tally.record('invalid')
+            except ServeRejected:
+                tally.record('unavailable')
+            except Exception:
+                tally.record('errors')
+            else:
+                lat_ms = (time.perf_counter() - t0) * 1e3
+                if check_exact and not np.array_equal(np.asarray(y), y_exp):
+                    with tally.lock:
+                        tally.mismatches += 1
+                tally.record('ok', lat_ms=lat_ms, rows=len(x), served_by=served_by)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True) for w in range(workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 120.0)
+    return tally.report(time.perf_counter() - t0)
+
+
+def burst(
+    infer_fn,
+    pool,
+    *,
+    n_requests: int,
+    deadline_ms: float = 500.0,
+    timeout_s: float = 60.0,
+) -> dict:
+    """Overload probe: fire ``n_requests`` concurrently (typically 10× the
+    sustainable rate) and require every one to resolve quickly into either
+    a bit-exact answer or a structured rejection — the bounded-queue /
+    no-deadlock / no-OOM guarantee."""
+    tally = _Tally()
+    start = threading.Barrier(n_requests + 1)
+
+    def one(i: int):
+        x, y_exp = pool[i % len(pool)]
+        start.wait(timeout=timeout_s)
+        t0 = time.perf_counter()
+        try:
+            y, served_by = infer_fn(x, deadline_ms / 1e3)
+        except QueueFull:
+            tally.record('shed')
+        except DeadlineExpired:
+            tally.record('deadline_miss')
+        except ServeRejected:
+            tally.record('unavailable')
+        except Exception:
+            tally.record('errors')
+        else:
+            if not np.array_equal(np.asarray(y), y_exp):
+                with tally.lock:
+                    tally.mismatches += 1
+            tally.record('ok', lat_ms=(time.perf_counter() - t0) * 1e3, rows=len(x), served_by=served_by)
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True) for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start.wait(timeout=timeout_s)
+    deadline = time.monotonic() + timeout_s
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.1))
+    hung = sum(1 for t in threads if t.is_alive())
+    rep = tally.report(time.perf_counter() - t0)
+    rep['hung_requests'] = hung
+    rep['resolved_all'] = hung == 0
+    return rep

@@ -52,6 +52,20 @@ def _metric_value(snap: dict, name: str) -> float | None:
     return None if m is None else m.get('value')
 
 
+def _serve_check() -> dict | None:
+    """Serve-plane health of any live :class:`~da4ml_tpu.serve.ServeEngine`
+    (queue stall, shed rate, per-model breaker states). Resolved via
+    ``sys.modules`` — a scrape never imports the serve stack; None when no
+    engine exists in this process."""
+    mod = sys.modules.get('da4ml_tpu.serve.engine')
+    if mod is None:
+        return None
+    try:
+        return mod.serve_health()
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
 def _campaign_workers() -> dict | None:
     """Cross-process worker liveness of an active multi-worker campaign
     (``parallel.campaign.worker_health``: heartbeat files in the shared
@@ -140,6 +154,9 @@ def health_snapshot(snap: dict | None = None) -> dict:
         'campaign': campaign,
         'compile_cache': _cache_check(snap),
     }
+    serve = _serve_check()
+    if serve is not None:
+        checks['serve'] = serve
     degraded = any(c['status'] == 'degraded' for c in checks.values())
     return {
         'status': 'degraded' if degraded else 'ok',
@@ -161,6 +178,18 @@ def _run_mode_decisions() -> dict:
         return {}
 
 
+def _serve_status() -> dict | None:
+    """Loaded models + executor-cache occupancy (``/statusz``), when a
+    serve engine is live in this process."""
+    mod = sys.modules.get('da4ml_tpu.serve.engine')
+    if mod is None:
+        return None
+    try:
+        return mod.serve_status()
+    except Exception:
+        return None
+
+
 def _device_inventory() -> dict | None:
     """Local device info — only when jax is already initialized (a scrape
     must never pay, or trigger, backend startup)."""
@@ -180,7 +209,9 @@ def status_snapshot() -> dict:
     snap = metrics_snapshot()
     sched = {k: v.get('value', v.get('count')) for k, v in snap.items() if k.startswith(('sched.', 'emit.'))}
     run = {k: v.get('value', v.get('count')) for k, v in snap.items() if k.startswith('run.')}
+    serve_metrics = {k: v.get('value', v.get('count')) for k, v in snap.items() if k.startswith('serve.')}
     deadline_workers = [t.name for t in threading.enumerate() if t.name.startswith('da4ml-deadline-')]
+    serve = _serve_status()
     return {
         'pid': os.getpid(),
         'uptime_s': round(time.monotonic() - _T0, 3),
@@ -194,6 +225,8 @@ def status_snapshot() -> dict:
         'run_modes': _run_mode_decisions(),
         'scheduler': sched,
         'runtime': run,
+        'serve': serve,
+        'serve_metrics': serve_metrics,
         'deadline_workers': deadline_workers,
         'devices': _device_inventory(),
     }

@@ -50,6 +50,25 @@ _HELP = {
     'health.status': 'aggregate health: 0 ok, 1 degraded',
     'fallback.events': 'reliability chain degradations (solve + runtime)',
     'checkpoint.hits': 'campaign kernels restored from a checkpoint instead of re-solved',
+    'serve.requests': 'inference requests admitted to a serve queue',
+    'serve.samples': 'inference sample rows served',
+    'serve.shed': 'requests shed by admission control (HTTP 429)',
+    'serve.deadline_miss': 'requests whose deadline expired while queued (rejected before dispatch)',
+    'serve.batches': 'coalesced device batches dispatched by the serve plane',
+    'serve.batch_rows': 'rows per coalesced serve batch',
+    'serve.batch_fill': 'serve batch fill ratio (rows dispatched / row budget)',
+    'serve.latency_s': 'request latency: admission to resolution',
+    'serve.queue_wait_s': 'request queue wait before its batch dispatched',
+    'serve.queue_depth': 'admission queue depth in rows (last served model)',
+    'serve.queue_age_s': 'age of the oldest queued serve request',
+    'serve.degraded': 'serve batches answered by the bit-exact fallback chain',
+    'serve.dispatch_failures': 'device dispatch failures absorbed by the serve envelope',
+    'serve.shape_miss': 'serve batches whose padded shape was not prewarmed (new XLA compile)',
+    'serve.shape_hit': 'serve batches landing on a prewarmed canonical shape',
+    'serve.hedge_fired': 'straggler hedges launched against slow device batches',
+    'serve.hedge_won': 'hedged batches answered by the fallback chain first',
+    'serve.reloads': 'hot executor reloads',
+    'serve.executor_evictions': 'compiled executors evicted from the LRU serve cache',
 }
 
 

@@ -1,0 +1,657 @@
+"""Resilient serving layer (docs/serving.md): batching, admission control,
+deadlines, degradation, drain/reload races, and graceful shutdown.
+
+Covers the ISSUE-8 acceptance surface:
+
+- canonical-grid padding is bit-identical through ``DaisExecutor.__call__``
+  (the ``parallel.shapes`` satellite);
+- executor input validation raises the typed reliability taxonomy
+  (``InvalidInputError``) — the serve plane maps it to HTTP 400;
+- bounded admission with both shed policies, Retry-After backpressure,
+  and the 10× overload burst (hard ceiling, no deadlock, no lost work);
+- per-request deadlines rejected *before* dispatch;
+- breaker trip → bit-exact fallback serving → recovery without restart;
+- drain/reload races: in-flight work completes during drain, hot reload
+  drops nothing, and a SIGTERM'd serve process exits 0 with zero lost
+  accepted requests;
+- /healthz + /statusz + OpenMetrics serve-plane integration.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from da4ml_tpu import telemetry
+from da4ml_tpu.parallel.shapes import canon_dim, grid_rungs, next_pow2, pad_rows
+from da4ml_tpu.reliability.breaker import breaker_for, reset_all_breakers
+from da4ml_tpu.reliability.errors import InvalidInputError, classify
+from da4ml_tpu.reliability.faults import fault_injection
+from da4ml_tpu.runtime.numpy_backend import run_binary as np_run_binary
+from da4ml_tpu.serve import (
+    DeadlineExpired,
+    Draining,
+    ModelNotFound,
+    ModelUnavailable,
+    QueueFull,
+    ServeConfig,
+    ServeEngine,
+)
+from da4ml_tpu.serve.batching import AdmissionQueue, InferRequest
+from da4ml_tpu.serve.loadgen import burst, closed_loop, engine_infer_fn, http_infer_fn, make_request_pool
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE = REPO / 'examples' / 'kernels' / 'cmvm_pipeline.json'
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    monkeypatch.delenv('DA4ML_FAULT_INJECT', raising=False)
+    reset_all_breakers()
+    telemetry.reset()
+    yield
+    reset_all_breakers()
+    telemetry.reset()
+
+
+@pytest.fixture(scope='module')
+def model():
+    """One deterministic solved model shared by the module (host solve)."""
+    from da4ml_tpu.cmvm import solve
+
+    rng = np.random.default_rng(7)
+    pipe = solve(rng.integers(-8, 8, (8, 6)).astype(np.float64), backend='cpu')
+    return pipe
+
+
+@pytest.fixture(scope='module')
+def binaries(model):
+    return [s.to_binary() for s in model.stages]
+
+
+def oracle_fn(binaries):
+    def oracle(x):
+        out = np.asarray(x, dtype=np.float64)
+        for b in binaries:
+            out = np_run_binary(b, out)
+        return out
+
+    return oracle
+
+
+def make_engine(model, **cfg):
+    defaults = dict(
+        max_batch_rows=16,
+        max_latency_ms=1.0,
+        queue_cap_rows=64,
+        breaker_threshold=3,
+        breaker_reset_s=0.4,
+        prewarm=False,
+        default_deadline_ms=5000.0,
+    )
+    defaults.update(cfg)
+    engine = ServeEngine(ServeConfig(**defaults))
+    engine.load_model('m', model)
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# satellite: canonical grid shared helper + padded bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_canon_dim_matches_cmvm_scheduler():
+    from da4ml_tpu.cmvm.jax_search import _canon_dim
+
+    for x in range(1, 600):
+        assert _canon_dim(x) == canon_dim(x, lo=2, even=True)
+        assert _canon_dim(x, lo=8) == canon_dim(x, lo=8, even=True)
+    # even grid: odd 3*2^0 / 5*2^0 rungs excluded
+    assert canon_dim(3, even=True) == 4 and canon_dim(3, lo=1, even=False) == 3
+    assert canon_dim(5, even=True) == 6 and canon_dim(5, lo=1, even=False) == 5
+    assert next_pow2(7) == 8 and next_pow2(1) == 1
+
+
+def test_grid_rungs_cover_every_batch_size():
+    rungs = grid_rungs(64)
+    assert rungs[0] == 1 and rungs[-1] == 64
+    for n in range(1, 65):
+        assert canon_dim(n, lo=1, even=False) in rungs
+    # the ladder stays logarithmic, not linear
+    assert len(rungs) < 20
+
+
+def test_padded_batch_bit_identical_through_executor(binaries):
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+    from da4ml_tpu.ir.dais_binary import decode
+
+    ex = DaisExecutor(decode(binaries[0]))
+    rng = np.random.default_rng(3)
+    for n in (1, 3, 5, 7, 11, 13):
+        x = np.round(rng.uniform(-4, 4, (n, ex.prog.n_in)) * 16) / 16
+        xp, kept = pad_rows(x)
+        assert kept == n and xp.shape[0] == canon_dim(n, lo=1, even=False)
+        exact = ex(x)
+        padded = ex(xp)[:n]
+        np.testing.assert_array_equal(padded, exact)
+        np.testing.assert_array_equal(exact, np_run_binary(binaries[0], x))
+
+
+# ---------------------------------------------------------------------------
+# satellite: typed input validation (400s, not 500s)
+# ---------------------------------------------------------------------------
+
+
+def test_executor_input_validation_taxonomy(binaries):
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+
+    ex = DaisExecutor(decode(binaries[0]))
+    n_in = ex.prog.n_in
+    with pytest.raises(InvalidInputError, match='feature width'):
+        ex(np.zeros((4, n_in + 2)))
+    with pytest.raises(InvalidInputError, match='2-D'):
+        ex(np.zeros(n_in))
+    with pytest.raises(InvalidInputError, match='2-D'):
+        ex(np.zeros((2, 2, n_in)))
+    bad = np.zeros((3, n_in))
+    bad[1, 0] = np.nan
+    with pytest.raises(InvalidInputError, match='non-finite'):
+        ex(bad)
+    bad[1, 0] = np.inf
+    with pytest.raises(InvalidInputError, match='non-finite'):
+        ex(bad)
+    with pytest.raises(InvalidInputError, match='not a numeric array'):
+        ex([[1, 'x']])
+    # classified fatal: a malformed request must not trigger backend fallback
+    assert classify(InvalidInputError('x')) == 'fatal'
+    assert isinstance(InvalidInputError('x'), ValueError)
+
+
+# ---------------------------------------------------------------------------
+# admission queue + shed policies
+# ---------------------------------------------------------------------------
+
+
+def _req(rows=1, deadline_s=None, n_in=4):
+    return InferRequest(np.zeros((rows, n_in)), deadline_s)
+
+
+def test_admission_queue_reject_newest():
+    q = AdmissionQueue(cap_rows=4, policy='reject-newest')
+    q.push(_req(2))
+    q.push(_req(2))
+    with pytest.raises(QueueFull) as ei:
+        q.push(_req(1))
+    assert ei.value.retry_after_s is not None and ei.value.http_status == 429
+    assert q.depth_rows() == 4 and q.shed_total == 1
+
+
+def test_admission_queue_deadline_edf_evicts_slack():
+    q = AdmissionQueue(cap_rows=2, policy='deadline-edf')
+    lazy = _req(1, deadline_s=60.0)
+    lazier = _req(1, deadline_s=120.0)
+    q.push(lazy)
+    q.push(lazier)
+    urgent = _req(1, deadline_s=0.5)
+    q.push(urgent)  # evicts the laziest queued request
+    assert lazier.finished
+    with pytest.raises(QueueFull):
+        lazier.result(0)
+    # service order is earliest-deadline-first
+    batch = q.take_batch(max_rows=8, window_s=0.0, stop=threading.Event())
+    assert [r.id for r in batch] == [urgent.id, lazy.id]
+    # an arrival no more urgent than every queued request is itself shed
+    q2 = AdmissionQueue(cap_rows=1, policy='deadline-edf')
+    q2.push(_req(1, deadline_s=0.2))
+    with pytest.raises(QueueFull):
+        q2.push(_req(1, deadline_s=10.0))
+
+
+def test_take_batch_respects_row_budget():
+    q = AdmissionQueue(cap_rows=64, policy='reject-newest')
+    for _ in range(5):
+        q.push(_req(3))
+    batch = q.take_batch(max_rows=8, window_s=0.0, stop=threading.Event())
+    assert sum(r.n_rows for r in batch) == 6  # 3+3 fits, a third would overshoot
+    batch2 = q.take_batch(max_rows=8, window_s=0.0, stop=threading.Event())
+    assert sum(r.n_rows for r in batch2) == 6
+    assert q.depth_requests() == 1
+
+
+def test_oversized_request_rejected(model):
+    engine = make_engine(model, max_batch_rows=8)
+    try:
+        with pytest.raises(InvalidInputError, match='split the batch'):
+            engine.submit('m', np.zeros((9, 8)))
+        with pytest.raises(ModelNotFound):
+            engine.submit('nope', np.zeros((1, 8)))
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# request path: bit-exactness, deadlines, degradation
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_batches_bit_exact(model, binaries):
+    engine = make_engine(model, max_latency_ms=5.0)
+    oracle = oracle_fn(binaries)
+    try:
+        pool = make_request_pool(oracle, 8, rows_choices=(1, 2, 3), pool=12)
+        reqs = [engine.submit('m', x) for x, _ in pool]
+        for (x, y_exp), r in zip(pool, reqs):
+            np.testing.assert_array_equal(r.result(30.0), y_exp)
+            assert r.served_by == 'jax'
+        snap = telemetry.metrics_snapshot()
+        # coalescing happened: fewer batches than requests
+        if snap:
+            assert snap.get('serve.batches', {}).get('value', 0) <= len(reqs)
+    finally:
+        engine.close()
+
+
+def test_warm_engine_has_no_shape_miss(model, binaries):
+    telemetry.enable(metrics=True)
+    engine = make_engine(model, prewarm=True, max_batch_rows=8)
+    oracle = oracle_fn(binaries)
+    try:
+        pool = make_request_pool(oracle, 8, rows_choices=(1, 2, 3, 4), pool=16)
+        for x, y_exp in pool:
+            np.testing.assert_array_equal(engine.infer('m', x, deadline_s=30.0), y_exp)
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('serve.shape_miss', {}).get('value', 0) == 0
+        assert snap.get('serve.shape_hit', {}).get('value', 0) >= 1
+        assert engine._state('m').warm_rows == set(grid_rungs(8))
+    finally:
+        engine.close()
+
+
+def test_deadline_expired_rejected_before_dispatch(model):
+    # a long coalescing window guarantees the deadline fires while queued
+    engine = make_engine(model, max_latency_ms=300.0)
+    try:
+        req = engine.submit('m', np.zeros((1, 8)), deadline_s=0.05)
+        with pytest.raises(DeadlineExpired) as ei:
+            req.result(5.0)
+        assert ei.value.http_status == 504
+        snap = telemetry.metrics_snapshot()
+        if snap:
+            assert snap.get('serve.deadline_miss', {}).get('value', 0) >= 1
+    finally:
+        engine.close()
+
+
+def test_breaker_trip_falls_back_bit_exact_then_recovers(model, binaries):
+    engine = make_engine(model, max_latency_ms=0.5)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, pool=8)
+    try:
+        with fault_injection('serve.dispatch=error:4'):
+            for i in range(5):
+                x, y_exp = pool[i % len(pool)]
+                np.testing.assert_array_equal(engine.infer('m', x, deadline_s=30.0), y_exp)
+        br = breaker_for('serve.m')
+        assert br.state in ('open', 'half-open')
+        assert engine.health_doc()['status'] == 'degraded'
+        # cooldown elapses; the half-open probe closes the breaker in place
+        time.sleep(0.45)
+        x, y_exp = pool[0]
+        np.testing.assert_array_equal(engine.infer('m', x, deadline_s=30.0), y_exp)
+        assert br.state == 'closed'
+        assert engine.health_doc()['status'] == 'ok'
+        snap = telemetry.metrics_snapshot()
+        if snap:
+            assert snap.get('serve.degraded', {}).get('value', 0) >= 1
+    finally:
+        engine.close()
+
+
+def test_degraded_shed_mode_returns_structured_503(model):
+    engine = make_engine(model, degraded='shed', breaker_reset_s=30.0)
+    try:
+        with fault_injection('serve.dispatch=error:3'):
+            for _ in range(3):
+                engine.infer('m', np.zeros((1, 8)), deadline_s=10.0)  # served via per-batch fallback
+        assert breaker_for('serve.m').state == 'open'
+        with pytest.raises(ModelUnavailable) as ei:
+            engine.infer('m', np.zeros((1, 8)), deadline_s=10.0)
+        assert ei.value.http_status == 503 and ei.value.retry_after_s is not None
+    finally:
+        engine.close()
+
+
+def test_hedged_dispatch_bit_exact(model, binaries):
+    engine = make_engine(model, hedge_ms=5.0, max_latency_ms=0.5)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, pool=4)
+    try:
+        # a slow device batch: the hedge races the fallback chain and wins
+        with fault_injection('serve.dispatch=sleep:1:0.5'):
+            x, y_exp = pool[0]
+            np.testing.assert_array_equal(engine.infer('m', x, deadline_s=30.0), y_exp)
+        snap = telemetry.metrics_snapshot()
+        if snap:
+            assert snap.get('serve.hedge_fired', {}).get('value', 0) >= 1
+        # healthy path unaffected
+        x, y_exp = pool[1]
+        np.testing.assert_array_equal(engine.infer('m', x, deadline_s=30.0), y_exp)
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# overload: the 10x burst holds the ceiling
+# ---------------------------------------------------------------------------
+
+
+def test_burst_10x_bounded_no_deadlock(model, binaries):
+    engine = make_engine(model, queue_cap_rows=16, max_batch_rows=8, max_latency_ms=0.5)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, rows_choices=(1, 2), pool=16)
+    try:
+        rep = burst(engine_infer_fn(engine, 'm'), pool, n_requests=160, deadline_ms=5000.0, timeout_s=60.0)
+        assert rep['resolved_all'] and rep['hung_requests'] == 0
+        assert rep['mismatches'] == 0 and rep['errors'] == 0
+        assert rep['shed'] > 0  # the ceiling actually engaged
+        assert rep['ok'] + rep['bounded_rejections'] == rep['requests']
+        # the queue never exceeded its bound
+        assert engine._state('m').queue.depth_rows() <= 16
+    finally:
+        engine.close()
+
+
+def test_closed_loop_availability(model, binaries):
+    engine = make_engine(model, prewarm=True, max_batch_rows=8, max_latency_ms=1.0)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, rows_choices=(1, 2, 4), pool=16)
+    try:
+        rep = closed_loop(engine_infer_fn(engine, 'm'), pool, workers=4, duration_s=1.0, deadline_ms=2000.0)
+        assert rep['mismatches'] == 0 and rep['errors'] == 0
+        assert rep['ok'] > 0 and (rep['availability'] or 0) >= 0.99
+        assert rep['p99_ms'] > 0 and rep['samples_per_s'] > 0
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# drain / reload races
+# ---------------------------------------------------------------------------
+
+
+def test_drain_completes_in_flight_then_rejects(model, binaries):
+    engine = make_engine(model, max_latency_ms=50.0)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, pool=6)
+    try:
+        reqs = [engine.submit('m', x) for x, _ in pool]
+        assert engine.drain(timeout=30.0)
+        for (x, y_exp), r in zip(pool, reqs):
+            np.testing.assert_array_equal(r.result(1.0), y_exp)  # already resolved
+        with pytest.raises(Draining):
+            engine.submit('m', pool[0][0])
+    finally:
+        engine.close()
+
+
+def test_reload_swaps_executor_without_dropping_queued_work(model, binaries):
+    engine = make_engine(model, max_latency_ms=1.0)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, pool=8)
+    try:
+        # hold the batcher busy so work queues up behind the reload
+        with fault_injection('serve.dispatch=sleep:1:0.3'):
+            first = engine.submit('m', pool[0][0])
+            time.sleep(0.05)  # batcher is now sleeping inside dispatch
+            queued = [engine.submit('m', x) for x, _ in pool[1:]]
+            version = engine.reload('m')
+        assert version == 2
+        np.testing.assert_array_equal(first.result(30.0), pool[0][1])
+        for (x, y_exp), r in zip(pool[1:], queued):
+            np.testing.assert_array_equal(r.result(30.0), y_exp)
+        assert engine.models()['models'][0]['version'] == 2
+        snap = telemetry.metrics_snapshot()
+        if snap:
+            assert snap.get('serve.reloads', {}).get('value', 0) >= 1
+    finally:
+        engine.close()
+
+
+def test_reload_rejects_interface_change(model):
+    engine = make_engine(model)
+    try:
+        from da4ml_tpu.cmvm import solve
+
+        other = solve(np.ones((4, 3)), backend='cpu')
+        with pytest.raises(ValueError, match='interface'):
+            engine.reload('m', other)
+    finally:
+        engine.close()
+
+
+def test_executor_cache_lru_bound(model):
+    telemetry.enable(metrics=True)
+    engine = ServeEngine(ServeConfig(executor_cache_cap=2, prewarm=False, max_latency_ms=0.5))
+    try:
+        for name in ('a', 'b', 'c'):
+            engine.load_model(name, model)
+            engine.infer(name, np.zeros((1, 8)), deadline_s=30.0)
+        doc = engine.models()
+        assert doc['executor_cache']['occupancy'] <= 2
+        assert doc['executor_cache']['cap'] == 2
+        snap = telemetry.metrics_snapshot()
+        assert snap.get('serve.executor_evictions', {}).get('value', 0) >= 1
+        # evicted model still serves (executor rebuilt on demand)
+        engine.infer('a', np.zeros((1, 8)), deadline_s=30.0)
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# observability integration
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_http_endpoints_and_serve_plane_health(model, binaries):
+    from da4ml_tpu.serve.http import ServeServer
+    from da4ml_tpu.telemetry.obs.openmetrics import validate_openmetrics
+
+    engine = make_engine(model, prewarm=True, max_batch_rows=8)
+    server = ServeServer(engine)
+    oracle = oracle_fn(binaries)
+    pool = make_request_pool(oracle, 8, pool=4)
+    try:
+        fn = http_infer_fn(server.url, 'm')
+        y, served_by = fn(pool[0][0], 5.0)
+        np.testing.assert_array_equal(y, pool[0][1])
+        assert served_by == 'jax'
+        # client errors map to 400/404, not 500
+        with pytest.raises(InvalidInputError):
+            fn(np.zeros((1, 3)), 5.0)
+        code, body = _get(f'{server.url}/v1/models')
+        doc = json.loads(body)
+        assert code == 200 and doc['models'][0]['name'] == 'm'
+        assert doc['models'][0]['executor_cached'] and doc['executor_cache']['occupancy'] == 1
+        # /healthz carries the serve-plane check
+        code, body = _get(f'{server.url}/healthz')
+        health = json.loads(body)
+        assert code == 200 and health['checks']['serve']['models']['m']['breaker'] == 'closed'
+        # /statusz lists loaded models + executor-cache occupancy
+        code, body = _get(f'{server.url}/statusz')
+        status = json.loads(body)
+        names = [m['name'] for e in status['serve']['engines'] for m in e['models']]
+        assert 'm' in names
+        # /metrics: serve families + per-model serve breaker label folding
+        code, text = _get(f'{server.url}/metrics')
+        fams = validate_openmetrics(text)
+        assert any(f.startswith('da4ml_serve_') for f in fams)
+        br = fams['da4ml_breaker_state']
+        assert br['samples'].get('da4ml_breaker_state{breaker="serve.m"}') == 0.0
+    finally:
+        server.close()
+        engine.close()
+
+
+def test_healthz_degrades_on_open_serve_breaker_over_http(model):
+    from da4ml_tpu.serve.http import ServeServer
+
+    engine = make_engine(model, degraded='shed', breaker_reset_s=30.0)
+    server = ServeServer(engine)
+    try:
+        with fault_injection('serve.dispatch=error:3'):
+            for _ in range(3):
+                engine.infer('m', np.zeros((1, 8)), deadline_s=10.0)
+        code, body = _get(f'{server.url}/healthz')
+        assert code == 503
+        doc = json.loads(body)
+        assert doc['status'] == 'degraded'
+        assert doc['checks']['serve']['models']['m']['breaker'] == 'open'
+    finally:
+        server.close()
+        engine.close()
+
+
+def test_http_429_with_retry_after_under_burst(model):
+    from da4ml_tpu.serve.http import ServeServer
+
+    engine = make_engine(model, queue_cap_rows=2, max_batch_rows=2, max_latency_ms=20.0)
+    server = ServeServer(engine)
+    try:
+        codes = []
+
+        def post():
+            body = json.dumps({'model': 'm', 'inputs': [[0.0] * 8], 'deadline_ms': 5000}).encode()
+            req = urllib.request.Request(f'{server.url}/v1/infer', data=body)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    codes.append((resp.status, resp.headers.get('Retry-After')))
+            except urllib.error.HTTPError as e:
+                codes.append((e.code, e.headers.get('Retry-After')))
+
+        threads = [threading.Thread(target=post) for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert all(c in (200, 429) for c, _ in codes)
+        rejected = [ra for c, ra in codes if c == 429]
+        assert rejected and all(ra is not None for ra in rejected)
+    finally:
+        server.close()
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# process-level: SIGTERM exits 0 with zero lost accepted requests
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(sys.platform == 'win32', reason='POSIX signals')
+def test_sigterm_graceful_exit_zero_lost_requests(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONUNBUFFERED='1')
+    env.pop('DA4ML_TRACE', None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, '-m', 'da4ml_tpu', 'serve', f'm={FIXTURE}',
+            '--port', '0', '--max-batch-rows', '8', '--max-latency-ms', '20',
+            '--deadline-ms', '30000', '--no-prewarm',
+        ],  # fmt: skip
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+        cwd=str(REPO),
+    )
+    try:
+        ready = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            try:
+                ready = json.loads(line)
+                break
+            except ValueError:
+                continue
+        assert ready and 'serving' in ready, f'no ready line (rc={proc.poll()}): {proc.stderr.read()[:2000]}'
+        url = ready['serving']
+
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            body = json.dumps({'model': 'm', 'inputs': [[0.25 * i] * 8], 'deadline_ms': 30000}).encode()
+            req = urllib.request.Request(f'{url}/v1/infer', data=body)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    doc = json.load(resp)
+                    with lock:
+                        outcomes.append(('ok', doc['outputs']))
+            except urllib.error.HTTPError as e:
+                with lock:
+                    outcomes.append(('rejected', e.code))  # structured rejection, not lost
+            except urllib.error.URLError as e:
+                # connection refused = the listener was already closed, the
+                # request was never accepted; reset mid-stream would be loss
+                kind = 'refused' if isinstance(e.reason, ConnectionRefusedError) else 'lost'
+                with lock:
+                    outcomes.append((kind, repr(e)))
+            except Exception as e:
+                with lock:
+                    outcomes.append(('lost', repr(e)))
+
+        # a first request proves the path, then SIGTERM lands while a wave
+        # of accepted requests is still in flight (20 ms coalesce window)
+        client(0)
+        assert outcomes and outcomes[0][0] == 'ok', outcomes
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(1, 9)]
+        for t in threads:
+            t.start()
+        time.sleep(0.02)
+        proc.send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(90)
+        rc = proc.wait(timeout=90)
+        assert rc == 0, (rc, proc.stderr.read()[:2000])
+        lost = [o for o in outcomes if o[0] == 'lost']
+        assert not lost, f'accepted requests lost on SIGTERM: {lost}'
+        assert sum(1 for o in outcomes if o[0] == 'ok') >= 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(30)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill (the CI serve-chaos gate, in miniature)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_drill_end_to_end():
+    from da4ml_tpu.serve.chaos import chaos_drill
+
+    report = chaos_drill(duration_s=4.0, workers=3)
+    assert report['ok'], report['checks']
+    assert report['load']['mismatches'] == 0
+    assert report['phases']['breaker']['tripped']
+    assert report['final_healthz'] == 'ok'
