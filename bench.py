@@ -484,6 +484,39 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
             'win_or_tie_portfolio': f'{int((portfolio_costs <= host_costs).sum())}/{len(k1)}',
             'wall_s': round(wall, 2),
         }
+    if name == 'quality_beam':
+        # the quality= knob's headline numbers (docs/cmvm.md#search-strategies):
+        # strict-win rate of the beam-4 portfolio vs the host oracle on the
+        # quality-sweep corpus, never-worse accounting, and the wall-clock
+        # multiplier vs the greedy device solve — the CI quality-gate's
+        # committed-corpus twin (ci/quality_gate.py gates the same invariants)
+        from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+        k1 = _section_kernels('1_16x16_int4', n1, limited)
+        host_sols, _ = _host_solve(k1, host_backend)
+        host_costs = np.asarray([s.cost for s in host_sols])
+        solve_jax_many(k1[:2])  # warm the dominant shape classes
+        t0 = time.perf_counter()
+        greedy = solve_jax_many(k1)
+        greedy_wall = time.perf_counter() - t0
+        greedy_costs = np.asarray([s.cost for s in greedy])
+        t0 = time.perf_counter()
+        beam = solve_jax_many(k1, quality='search')
+        beam_wall = time.perf_counter() - t0
+        beam_costs = np.asarray([s.cost for s in beam])
+        return {
+            'quality': 'search',
+            'n_kernels': len(k1),
+            'strict_wins': f'{int((beam_costs < host_costs).sum())}/{len(k1)}',
+            'win_or_tie': f'{int((beam_costs <= host_costs).sum())}/{len(k1)}',
+            'never_worse_than_greedy': f'{int((beam_costs <= greedy_costs).sum())}/{len(k1)}',
+            'mean_cost_host': round(float(host_costs.mean()), 3),
+            'mean_cost_greedy': round(float(greedy_costs.mean()), 3),
+            'mean_cost_beam': round(float(beam_costs.mean()), 3),
+            'greedy_wall_s': round(greedy_wall, 2),
+            'beam_wall_s': round(beam_wall, 2),
+            'wall_multiplier': round(beam_wall / greedy_wall, 2) if greedy_wall > 0 else None,
+        }
     if name == 'quality_1000':
         # on-demand (not in the default budget): the reference-scale quality
         # sweep — 1000 random kernels, dims 2-32, 1-8 bit, device vs host
@@ -639,7 +672,7 @@ _CONFIG_SECTIONS = (
     '4_qconv3x3_im2col',
     '5_full_model_trace',
 )
-_MICRO_SECTIONS = ('quality_sweep', 'select_modes', 'dais_inference', 'campaign', 'serve')
+_MICRO_SECTIONS = ('quality_sweep', 'quality_beam', 'select_modes', 'dais_inference', 'campaign', 'serve')
 
 
 def _run_section_child(name: str, n1: int, timeout: float, env: dict | None = None) -> dict:

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""CI quality gate for the beam search (docs/cmvm.md#search-strategies).
+
+Runs ``quality='search'`` on the committed corpus (ci/quality_corpus.npz)
+against the host oracle and gates on the PR's acceptance invariants:
+
+- zero cost regressions (beam <= oracle on EVERY kernel);
+- at least ``--min-strict-wins`` strict wins (beam < oracle);
+- never worse than the greedy device solve on any kernel;
+- wall-clock <= ``--max-wall-multiplier`` x the greedy device solve.
+
+Writes a JSON report (uploaded as a CI artifact) whose ``quality_beam.*``
+metrics ride the ci/budgets.toml rules through ``da4ml-tpu bench-diff``.
+
+Regenerate the corpus (deterministic) with ``--regen``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+CORPUS_SEED = 20260804
+
+
+def regen_corpus(path: str) -> None:
+    rng = np.random.default_rng(CORPUS_SEED)
+    kernels = {}
+    # mixed sizes around the quality-sweep shape; small enough that the gate
+    # runs in CI minutes, large enough that the beam has room to win
+    for i, (dim, bits) in enumerate([(10, 4), (12, 4), (12, 3), (14, 4), (16, 4), (16, 3), (16, 4), (14, 3)]):
+        mag = rng.integers(0, 2**bits, (dim, dim)).astype(np.float64)
+        sign = rng.choice([-1.0, 1.0], (dim, dim))
+        kernels[f'k{i:02d}'] = mag * sign
+    np.savez(path, **kernels)
+    print(f'wrote {len(kernels)} kernels -> {path}')
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--corpus', default='ci/quality_corpus.npz')
+    ap.add_argument('--out', default=None, help='JSON report path')
+    ap.add_argument('--min-strict-wins', type=int, default=1)
+    ap.add_argument('--max-wall-multiplier', type=float, default=4.0)
+    ap.add_argument('--regen', action='store_true', help='regenerate the committed corpus and exit')
+    args = ap.parse_args()
+
+    if args.regen:
+        regen_corpus(args.corpus)
+        return 0
+
+    from da4ml_tpu.cmvm import api as host_api
+    from da4ml_tpu.cmvm.jax_search import solve_jax_many
+
+    with np.load(args.corpus) as blob:
+        kernels = [np.asarray(blob[k], np.float64) for k in sorted(blob.files)]
+
+    host_costs = np.asarray([float(host_api.solve(k, backend='auto').cost) for k in kernels])
+
+    solve_jax_many(kernels[:2])  # warm the dominant shape classes off the clock
+    t0 = time.perf_counter()
+    greedy_costs = np.asarray([float(s.cost) for s in solve_jax_many(kernels)])
+    greedy_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    beam_sols = solve_jax_many(kernels, quality='search')
+    beam_wall = time.perf_counter() - t0
+    beam_costs = np.asarray([float(s.cost) for s in beam_sols])
+
+    # exactness first: a cheap wrong answer must fail loudly
+    for k, s in zip(kernels, beam_sols):
+        np.testing.assert_array_equal(np.asarray(s.kernel, np.float64), k)
+
+    strict_wins = int((beam_costs < host_costs).sum())
+    regressions = int((beam_costs > host_costs).sum())
+    worse_than_greedy = int((beam_costs > greedy_costs).sum())
+    mult = beam_wall / greedy_wall if greedy_wall > 0 else float('inf')
+    report = {
+        'quality_beam': {
+            'n_kernels': len(kernels),
+            'strict_wins': f'{strict_wins}/{len(kernels)}',
+            'win_or_tie': f'{len(kernels) - regressions}/{len(kernels)}',
+            'regressions': regressions,
+            'worse_than_greedy': worse_than_greedy,
+            'mean_cost_host': round(float(host_costs.mean()), 3),
+            'mean_cost_greedy': round(float(greedy_costs.mean()), 3),
+            'mean_cost_beam': round(float(beam_costs.mean()), 3),
+            'cost_delta_vs_host': round(float((beam_costs - host_costs).mean()), 3),
+            'greedy_wall_s': round(greedy_wall, 2),
+            'beam_wall_s': round(beam_wall, 2),
+            'wall_multiplier': round(mult, 2),
+        }
+    }
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, 'w') as fh:
+            json.dump(report, fh, indent=1)
+
+    failures = []
+    if regressions:
+        failures.append(f'{regressions} kernels cost MORE than the host oracle (must be 0)')
+    if worse_than_greedy:
+        failures.append(f'{worse_than_greedy} kernels cost more than the greedy solve (must be 0)')
+    if strict_wins < args.min_strict_wins:
+        failures.append(f'only {strict_wins} strict wins (< {args.min_strict_wins})')
+    if mult > args.max_wall_multiplier:
+        failures.append(f'wall multiplier {mult:.2f}x exceeds {args.max_wall_multiplier}x')
+    if failures:
+        print('QUALITY GATE FAILED:\n  - ' + '\n  - '.join(failures), file=sys.stderr)
+        return 1
+    print(f'quality gate OK: {strict_wins}/{len(kernels)} strict wins, 0 regressions, {mult:.2f}x wall')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

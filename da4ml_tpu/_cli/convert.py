@@ -65,6 +65,7 @@ def convert(
     solver_backend: str = 'auto',
     n_restarts: int = 1,
     method0_candidates: list[str] | None = None,
+    quality: str = 'fast',
     deadline: float | None = None,
     fallback: str | bool | None = None,
     resume: Path | None = None,
@@ -125,6 +126,7 @@ def convert(
                 'backend': solver_backend,
                 'n_restarts': n_restarts,
                 **({'method0_candidates': method0_candidates} if method0_candidates else {}),
+                **({'quality': quality} if quality and quality != 'fast' else {}),
                 **reliability_opts,
             },
             verbose > 1,
@@ -309,6 +311,7 @@ def _convert_main(args: argparse.Namespace) -> int:
             solver_backend=args.solver_backend,
             n_restarts=args.n_restarts,
             method0_candidates=args.methods,
+            quality=args.quality,
             deadline=args.deadline,
             fallback=args.fallback,
             resume=args.resume,
@@ -357,6 +360,16 @@ def add_convert_args(parser: argparse.ArgumentParser):
         default=None,
         choices=['mc', 'wmc', 'mc-dc', 'mc-pdc', 'wmc-dc', 'wmc-pdc'],
         help='Selection heuristics to sweep (replaces the default wmc; the argmin keeps the cheapest)',
+    )
+    parser.add_argument(
+        '--quality',
+        type=str,
+        default='fast',
+        choices=['fast', 'search', 'max'],
+        help="CMVM search strategy (docs/cmvm.md#search-strategies): 'fast' = greedy (default, "
+        "byte-identical to previous releases), 'search' = focused beam-5 with the host oracle "
+        "folded in, 'max' = beam-8 + all heuristics + restarts. Beam lanes need the jax solver "
+        'backend; host backends keep the portfolio sweep and warn once',
     )
     parser.add_argument(
         '--deadline',

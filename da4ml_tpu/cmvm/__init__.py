@@ -16,6 +16,7 @@ from .api import _solve, minimal_latency, solve
 from .core import cmvm, solve_single, to_solution
 from .csd import csd_decompose, int_arr_to_csd
 from .decompose import kernel_decompose, prim_mst_dc
+from .search import QUALITY_PRESETS, SearchSpec, resolve_quality
 
 
 class solver_options_t(TypedDict):
@@ -32,6 +33,9 @@ class solver_options_t(TypedDict):
     backend: NotRequired[str]
     method0_candidates: NotRequired[list[str] | None]
     n_restarts: NotRequired[int]
+    # search strategy (docs/cmvm.md#search-strategies): 'fast' | 'search' |
+    # 'max' | a SearchSpec | its to_dict form
+    quality: NotRequired[str | dict | SearchSpec | None]
     # reliability layer (docs/reliability.md): per-solve wall-clock budget,
     # backend fallback chain override, and campaign checkpoint path/store
     deadline: NotRequired[float | None]
@@ -54,6 +58,9 @@ __all__ = [
     'solve_jax',
     'solve_jax_many',
     'prewarm_for_kernels',
+    'SearchSpec',
+    'QUALITY_PRESETS',
+    'resolve_quality',
 ]
 
 _LAZY_JAX = ('solve_jax', 'solve_jax_many', 'prewarm_for_kernels')

@@ -153,6 +153,7 @@ def _solve_dispatch(
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
     mesh=None,
+    quality=None,
 ) -> Pipeline:
     """Direct (un-orchestrated) backend dispatch — the body of :func:`solve`.
 
@@ -177,6 +178,7 @@ def _solve_dispatch(
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
             mesh=mesh,
+            quality=quality,
         )
 
 
@@ -196,6 +198,7 @@ def _solve_dispatch_impl(
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
     mesh=None,
+    quality=None,
 ) -> Pipeline:
     if kernel.ndim != 2 or kernel.shape[0] == 0 or kernel.shape[1] == 0:
         raise ValueError(f'kernel must be a non-empty 2D matrix, got shape {kernel.shape}')
@@ -226,6 +229,32 @@ def _solve_dispatch_impl(
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
             mesh=mesh,
+            quality=quality,
+        )
+
+    # host backends: the beam/restart axes are device-lane features. A
+    # degraded chain walk (or an explicit cpu/cpp request) keeps the spec's
+    # heuristic portfolio — still a quality win — and surfaces what was
+    # dropped instead of ignoring it on the floor.
+    if quality not in (None, 'fast'):
+        from .search.spec import resolve_quality
+
+        _spec = resolve_quality(quality)
+        if not _spec.is_fast:
+            telemetry.warn_once(
+                f'cmvm.quality.{backend}',
+                f'quality beam search runs on the jax backend only; degrading to a '
+                f'portfolio sweep on backend {backend!r} (beam/restart lanes dropped)',
+                logger='cmvm',
+            )
+            method0_candidates = list(dict.fromkeys([*(method0_candidates or [method0]), *_spec.portfolio]))
+        quality = None
+    if n_restarts and int(n_restarts) > 1:
+        telemetry.warn_once(
+            f'cmvm.n_restarts.{backend}',
+            f'n_restarts={n_restarts} requires the jax backend; restart lanes are '
+            f'not run on backend {backend!r}',
+            logger='cmvm',
         )
 
     if method0_candidates:
@@ -309,6 +338,7 @@ def solve(
     n_restarts: int = 1,
     mesh=None,
     *,
+    quality='fast',
     deadline: float | None = None,
     fallback=None,
     report=None,
@@ -323,10 +353,22 @@ def solve(
     (argmin keeps the cheapest solution); on the jax backend the extra
     candidates batch into the same device call, on cpu/cpp they solve
     sequentially. ``n_restarts`` adds random tie-break restarts as extra
-    device lanes (jax backend only; ignored on cpu/cpp). ``mesh`` (jax
-    backend) shards the lane batch over a device mesh; None auto-shards
-    over all local devices on multi-device TPU backends (``DA4ML_JAX_MESH``
-    overrides — docs/api.md#scheduler-knobs).
+    device lanes (jax backend only; a one-time warning is emitted — and
+    recorded in the ``report`` — when a host backend drops them). ``mesh``
+    (jax backend) shards the lane batch over a device mesh; None
+    auto-shards over all local devices on multi-device TPU backends
+    (``DA4ML_JAX_MESH`` overrides — docs/api.md#scheduler-knobs).
+
+    ``quality`` selects the search strategy (docs/cmvm.md#search-strategies):
+    ``'fast'`` (default) is the single greedy trajectory, byte-identical to
+    the pre-beam solver; ``'search'`` runs a focused beam-5 with the host
+    oracle folded in (never worse, usually strictly better, bounded extra
+    wall clock); ``'max'`` forks everything: beam 8, every heuristic, and
+    4 restarts. An explicit
+    :class:`~da4ml_tpu.cmvm.search.SearchSpec` (or its ``to_dict`` form)
+    pins the strategy exactly. Beam lanes run on the jax backend; host
+    backends (including reliability-chain degradation) keep the portfolio
+    sweep and warn once about the dropped beam.
 
     Reliability (docs/reliability.md): by default a failed backend degrades
     along the bit-exact chain ``jax → native-threads → pure-python``
@@ -360,7 +402,7 @@ def solve(
         result = _solve_entry(
             kernel, method0, method1, hard_dc, decompose_dc, qintervals, latencies, adder_size,
             carry_size, search_all_decompose_dc, backend, n_workers, method0_candidates, n_restarts,
-            mesh, deadline=deadline, fallback=fallback, report=report, checkpoint=checkpoint,
+            mesh, quality=quality, deadline=deadline, fallback=fallback, report=report, checkpoint=checkpoint,
         )  # fmt: skip
         if _metrics:
             telemetry.counter('solve.calls').inc()
@@ -389,6 +431,7 @@ def _solve_entry(
     n_restarts: int,
     mesh=None,
     *,
+    quality='fast',
     deadline: float | None,
     fallback,
     report,
@@ -423,6 +466,7 @@ def _solve_entry(
             method0_candidates=method0_candidates,
             n_restarts=n_restarts,
             mesh=mesh,
+            quality=quality,
         )
         return _post_solve_verify(result)
 
@@ -448,6 +492,7 @@ def _solve_entry(
         method0_candidates=method0_candidates,
         n_restarts=n_restarts,
         n_workers=n_workers,
+        quality=quality,
     )
     result = solve_orchestrated(
         kernel,

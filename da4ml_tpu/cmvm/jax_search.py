@@ -502,6 +502,12 @@ class _KernelSpec:
     select: str = 'top4'  # 'top4' | 'xla' | 'fused' (DA4ML_JAX_SELECT)
     R_in: int = 0  # provided input rows (0 = full P); the rest are device-padded
     topk: int = 8  # top4 score-cache depth (deeper at large P, see _select)
+    #: full-capacity op records [P, 4] instead of [P - R_in, 4]: beam-fork
+    #: lanes enter a rung with heterogeneous cur0 (each prefix has its own
+    #: depth), so the trimmed capacity's cur0 >= R_in invariant does not
+    #: hold and a record write past P - R_in would be silently dropped.
+    #: False (the default, all non-beam classes) keeps programs byte-stable.
+    full_rec: bool = False
 
 
 @lru_cache(maxsize=64)
@@ -524,8 +530,9 @@ def _build_cse_fn(spec: _KernelSpec):
     K_CACHE = spec.topk
     _ED = _einsum_dtype()  # baked into the program (bf16 on TPU, f32 on CPU)
     # op-record capacity: a call adds at most P - cur0 ops, and cur0 >= R_in
-    # when rows are trimmed (st_cur == R_in for every live lane)
-    n_iters = P - spec.R_in if spec.R_in else P
+    # when rows are trimmed (st_cur == R_in for every live lane); beam-fork
+    # rungs (heterogeneous cur0) carry the full capacity instead (full_rec)
+    n_iters = P if spec.full_rec else (P - spec.R_in if spec.R_in else P)
     adder_size, carry_size = spec.adder_size, spec.carry_size
 
     def _pack_digits(E):
@@ -1014,6 +1021,24 @@ def _build_cse_fn(spec: _KernelSpec):
 
 
 @dataclass
+class LanePrefix:
+    """Host-committed decision prefix of a beam-fork lane (search/beam.py).
+
+    Everything is in *lane slot space*: inputs 0..ni-1, prefix ops
+    ni..ni+d-1 (the scheduler remaps op ids to its padded device slots).
+    ``E`` is the post-prefix digit tensor [ni+d, O, B]; ``rec`` the
+    committed (id0, id1, sub, shift) records [d, 4]; ``qmeta``/``lat`` the
+    f32 scoring metadata of the op rows (emission re-derives exact f64
+    metadata from the records, like any device decision).
+    """
+
+    rec: NDArray
+    E: NDArray
+    qmeta: NDArray
+    lat: NDArray
+
+
+@dataclass
 class _Lane:
     kernel: NDArray
     qintervals: list[QInterval]
@@ -1025,6 +1050,9 @@ class _Lane:
     #: solution is mapped back to the original input order, so every restart
     #: is exact and only cost/latency differ
     perm: NDArray | None = None
+    #: optional beam decision prefix: the lane resumes the greedy search
+    #: from this state instead of the raw CSD (quality='search'/'max')
+    prefix: LanePrefix | None = None
     # filled by preparation
     csd: NDArray | None = None
     shift0: NDArray | None = None
@@ -1057,7 +1085,20 @@ def _prepare_lane(lane: _Lane) -> None:
 
 
 def _lane_initial_digits(lane: _Lane) -> int:
+    if lane.prefix is not None:
+        return int((lane.prefix.E != 0).sum())
     return int((lane.csd != 0).sum())
+
+
+def _lane_rows(lane: _Lane) -> int:
+    """Rows carrying state at search entry: inputs plus any prefix ops."""
+    return lane.csd.shape[0] + (len(lane.prefix.rec) if lane.prefix is not None else 0)
+
+
+def _lane_demand(lane: _Lane) -> int:
+    """Slot-demand upper bound: each CSE merge eliminates >= 2 digit pairs,
+    so a lane needs at most rows + digits/2 slots."""
+    return _lane_rows(lane) + _lane_initial_digits(lane) // 2
 
 
 def _ladder_P(cur_max: int, step: int | None) -> int:
@@ -1166,6 +1207,8 @@ def solve_single_lanes(
             tuple(ln.qintervals),
             tuple(ln.latencies),
             None if ln.perm is None else ln.perm.tobytes(),
+            # beam forks of one lane differ only in their decision prefix
+            None if ln.prefix is None else (ln.prefix.rec.tobytes(), ln.prefix.E.tobytes()),
         )
         if key in _uniq:
             dup_of[k] = _uniq[key]
@@ -1182,11 +1225,7 @@ def solve_single_lanes(
     # matrix keeps its decomposed (dc >= 0) candidates on device and only
     # the undecomposed monster goes host-side.
     pmax_route = _pmax()
-    over = [
-        k
-        for k, ln in enumerate(lanes)
-        if k not in dup_of and ln.method != 'dummy' and ln.csd.shape[0] + _lane_initial_digits(ln) // 2 > pmax_route
-    ]
+    over = [k for k, ln in enumerate(lanes) if k not in dup_of and ln.method != 'dummy' and _lane_demand(ln) > pmax_route]
     if over:
         from .core import solve_single as _host_solve_single
 
@@ -1271,8 +1310,11 @@ def solve_single_lanes(
             active = g_active
             net: dict[int, CombLogic] = {}
             # pow2 so the first rung's cur0 equals the trimmed-row class
-            # R_in exactly (op-record capacity P - R_in relies on cur0 >= R_in)
+            # R_in exactly (op-record capacity P - R_in relies on cur0 >= R_in);
+            # beam-fork prefixes start above n_in_max and switch the group's
+            # rung classes to full-capacity records (spec.full_rec)
             n_in_max = _next_pow2(max(lanes[k].csd.shape[0] for k in active))
+            has_prefix = any(lanes[k].prefix is not None for k in active)
 
             n_act = len(active)
             st_E: dict[int, NDArray] = {}  # final digit tensors, filled as lanes finish
@@ -1288,11 +1330,18 @@ def solve_single_lanes(
             for a, k in enumerate(active):
                 ln = lanes[k]
                 ni, no, nb = ln.csd.shape
-                E = np.zeros((n_in_max, O, B), dtype=np.int8)
-                E[:ni, :no, :nb] = ln.csd
-                q = np.zeros((n_in_max, 3), dtype=np.float32)
+                d = len(ln.prefix.rec) if ln.prefix is not None else 0
+                E = np.zeros((n_in_max + d, O, B), dtype=np.int8)
+                if d:
+                    # post-prefix digit tensor: inputs keep their lane slots,
+                    # prefix ops occupy the first d device op slots
+                    E[:ni, :no, :nb] = ln.prefix.E[:ni]
+                    E[n_in_max : n_in_max + d, :no, :nb] = ln.prefix.E[ni:]
+                else:
+                    E[:ni, :no, :nb] = ln.csd
+                q = np.zeros((n_in_max + d, 3), dtype=np.float32)
                 q[:, 2] = 1.0  # benign step for unused slots
-                lb = np.zeros((n_in_max,), dtype=np.float32)
+                lb = np.zeros((n_in_max + d,), dtype=np.float32)
                 for i in range(ni):
                     sf = 2.0 ** float(ln.shift0[i])
                     qi = ln.qintervals[ln.slot(i)]
@@ -1303,6 +1352,18 @@ def solve_single_lanes(
                         lo, hi, stp = 0.0, 0.0, 1.0
                     q[i] = (lo, hi, stp)
                     lb[i] = ln.latencies[ln.slot(i)]
+                if d:
+                    q[n_in_max : n_in_max + d] = ln.prefix.qmeta
+                    lb[n_in_max : n_in_max + d] = ln.prefix.lat
+                    # seed the op records in device slot space (prefix op ids
+                    # shift up with the input padding; emission shifts back)
+                    rec = ln.prefix.rec.astype(np.int32).copy()
+                    shift_up = n_in_max - ni
+                    if shift_up:
+                        for c in (0, 1):
+                            rec[:, c] = np.where(rec[:, c] >= ni, rec[:, c] + shift_up, rec[:, c])
+                    recs[a].append(rec)
+                    st_cur[a] = n_in_max + d
                 hE.append(E)
                 hq.append(q)
                 hl.append(lb)
@@ -1362,7 +1423,7 @@ def solve_single_lanes(
                 # and the fused pad-up / VMEM-fallback policy live in
                 # _resolve_rung_class, shared with the prewarm estimators.
                 spec = _resolve_rung_class(
-                    P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(cur_max)
+                    P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(cur_max), full_rec=has_prefix
                 )
                 P, select, topk = spec.P, spec.select, spec.topk
                 rows_in = spec.R_in or P
@@ -1373,14 +1434,10 @@ def solve_single_lanes(
                 if _prewarm_enabled() and P < pmax:
                     # lanes whose slot demand outgrows this rung will resume at
                     # the next one; AOT-compile that class while this rung runs
-                    resume_est = [
-                        a
-                        for a in pend
-                        if lanes[active[a]].csd.shape[0] + _lane_initial_digits(lanes[active[a]]) // 2 > P
-                    ]
+                    resume_est = [a for a in pend if _lane_demand(lanes[active[a]]) > P]
                     P2 = min(_ladder_P(P, step), pmax)
                     if resume_est and P2 > P:
-                        spec2 = _resolve_rung_class(P2, O, B, adder_size, carry_size, _select(), pmax, P)
+                        spec2 = _resolve_rung_class(P2, O, B, adder_size, carry_size, _select(), pmax, P, full_rec=has_prefix)
                         bucket2 = _bucket_lanes(len(resume_est), mesh)
                         _prewarm_submit(lambda s=spec2, b=bucket2: _prewarm_class(s, b))
 
@@ -1428,10 +1485,7 @@ def solve_single_lanes(
                     # vmapped loop runs to the slowest lane of its chunk)
                     while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget // 2:
                         max_lanes //= 2
-                    pend = sorted(
-                        pend,
-                        key=lambda a: -(lanes[active[a]].csd.shape[0] + _lane_initial_digits(lanes[active[a]]) // 2),
-                    )
+                    pend = sorted(pend, key=lambda a: -_lane_demand(lanes[active[a]]))
 
                 next_pend: list[int] = []
                 _timed = debug or telemetry.metrics_on()
@@ -1773,13 +1827,17 @@ def _mark_fused_broken(err: Exception) -> None:
 
 
 def _resolve_rung_class(
-    P: int, O: int, B: int, adder_size: int, carry_size: int, select: str, pmax: int, rows_cap: int
+    P: int, O: int, B: int, adder_size: int, carry_size: int, select: str, pmax: int, rows_cap: int, full_rec: bool = False
 ) -> _KernelSpec:
     """Final (P, select, topk, R_in) policy for a device rung — the single
     source of truth shared by the live rung loop and both prewarm
     estimators, so the speculative compile always targets the class the
-    real rung will use."""
-    if select == 'fused' and _FUSED_BROKEN:
+    real rung will use. ``full_rec`` marks beam-fork rungs (heterogeneous
+    per-lane cur0 -> full-capacity op records)."""
+    if select == 'fused' and (_FUSED_BROKEN or full_rec):
+        # the fused kernel derives its record capacity from P - R_in and
+        # cannot host heterogeneous-cur0 beam rungs; the XLA top4 loop is
+        # decision-identical for the same class
         select = 'top4'
     topk = _TOPK if 'DA4ML_JAX_TOPK' in os.environ else (8 if P <= 256 else 16)
     if select == 'fused':
@@ -1795,7 +1853,7 @@ def _resolve_rung_class(
         else:
             select = 'top4'
     rows_in = min(rows_cap, P)
-    return _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk)
+    return _KernelSpec(P, O, B, adder_size, carry_size, select, R_in=rows_in if rows_in < P else 0, topk=topk, full_rec=full_rec)
 
 
 def _first_rung_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None) -> list[tuple]:
@@ -1811,7 +1869,7 @@ def _first_rung_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh
         if ln.csd is None:
             _prepare_lane(ln)
     pmax = _pmax()
-    active = [ln for ln in active if ln.csd.shape[0] + _lane_initial_digits(ln) // 2 <= pmax]
+    active = [ln for ln in active if _lane_demand(ln) <= pmax]
     if not active:
         return []
     if mesh is None:
@@ -1844,7 +1902,7 @@ def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=Non
         if ln.csd is None:
             _prepare_lane(ln)
     pmax = _pmax()
-    active = [ln for ln in active if ln.csd.shape[0] + _lane_initial_digits(ln) // 2 <= pmax]
+    active = [ln for ln in active if _lane_demand(ln) <= pmax]
     if not active:
         return []
     if mesh is None:
@@ -1856,7 +1914,7 @@ def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=Non
     out: list[tuple] = []
     for (O, B), grp in sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True):
         n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in grp))
-        demands = [ln.csd.shape[0] + _lane_initial_digits(ln) // 2 for ln in grp]
+        demands = [_lane_demand(ln) for ln in grp]
         cur = n_in_max
         while True:
             P = _ladder_P(cur, None)
@@ -2102,12 +2160,15 @@ def solve_jax(
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
     mesh=None,
+    quality=None,
 ) -> Pipeline:
     """Drop-in `solve` with the candidate search running on TPU.
 
     ``mesh=None`` auto-shards the lane batch over all local devices on a
     multi-device TPU backend (``_auto_mesh``); pass an explicit mesh to
-    pin, or set ``DA4ML_JAX_MESH=0`` to keep a single device."""
+    pin, or set ``DA4ML_JAX_MESH=0`` to keep a single device. ``quality``
+    (preset name / SearchSpec / dict) widens the sweep with the beam search
+    — docs/cmvm.md#search-strategies."""
     return solve_jax_many(
         [kernel],
         method0=method0,
@@ -2122,6 +2183,7 @@ def solve_jax(
         method0_candidates=method0_candidates,
         n_restarts=n_restarts,
         mesh=mesh,
+        quality=quality,
     )[0]
 
 
@@ -2151,10 +2213,21 @@ def _solve_jax_many_impl(
     method0_candidates: list[str] | None = None,
     n_restarts: int = 1,
     include_host: bool = False,
+    quality=None,
 ) -> list[Pipeline]:
     """Batched CMVM solve: all (matrix × dc candidate) stage-0 searches run as
     one device batch, then all stage-1 searches. The argmin over dc candidates
     per matrix happens on host. ``mesh`` shards the lane axis over devices.
+
+    ``quality`` (a preset name, :class:`~.search.SearchSpec`, or its dict
+    form) resolves to a search strategy: the spec's heuristic portfolio and
+    restart count widen the axes below, ``include_host`` folds the oracle
+    in, and — the beam proper — each eligible stage-0 lane forks its
+    top-``beam`` first substitutions for ``depth`` greedy rungs on the host
+    (``search/beam.py``) and the surviving decision prefixes ride the
+    bucketed scheduler as extra lanes. The unforked greedy lane always
+    stays in the batch, so the per-matrix argmin is never worse than the
+    ``quality='fast'`` result.
 
     Two quality axes widen the sweep with extra device lanes — something the
     serial reference sweep cannot afford:
@@ -2179,6 +2252,21 @@ def _solve_jax_many_impl(
     # orchestration drill point: lets tests/chaos runs fail the whole device
     # search deterministically (DA4ML_FAULT_INJECT=cmvm.jax=...)
     fault_check('cmvm.jax')
+
+    spec = None
+    if quality is not None:
+        from .search.spec import resolve_quality
+
+        spec = resolve_quality(quality)
+        if spec.is_fast:
+            spec = None  # byte-identical default path
+    if spec is not None:
+        # the spec's portfolio/restart axes merge into (never replace) the
+        # caller's; the beam forks ride along after lane construction
+        method0_candidates = list(dict.fromkeys([*(method0_candidates or [method0]), *spec.portfolio]))
+        n_restarts = max(int(n_restarts or 1), spec.n_restarts)
+        include_host = include_host or spec.include_host
+        telemetry.gauge('search.beam_width').set(spec.beam)
 
     if mesh is None:
         # resolve the default mesh once here so the background prewarm
@@ -2281,6 +2369,30 @@ def _solve_jax_many_impl(
         lanes0.append(_Lane(mat0, list(qints), list(lats), method_0, perm=perm))
         mats1.append(mat1)
 
+    # --- beam forks: decision prefixes as extra lanes of the batch ---
+    # expanded-lane bookkeeping: exp_refs maps every stage-0 lane back to its
+    # (matrix, dc, method-pair, restart) job; slot 0 is the unforked greedy
+    # lane, slots > 0 the beam forks (search/beam.py). focus == 0 forks every
+    # eligible lane into THIS batch; focus > 0 defers forking until the base
+    # batch has solved (two-phase, below) so only each matrix's best base
+    # trajectories pay for beam slots.
+    exp_refs = list(range(len(jobs)))
+    slot_ids = [0] * len(jobs)
+    fork_meta: list = [None] * len(jobs)
+    two_phase = spec is not None and spec.forks and spec.focus > 0
+    if spec is not None and spec.forks and not two_phase:
+        from .search.beam import expand_beam_lanes
+
+        with telemetry.span('cmvm.search.expand', n_lanes=len(lanes0), beam=spec.beam, depth=spec.depth):
+            forks = expand_beam_lanes(lanes0, spec, adder_size, carry_size)
+        for slot, (ji, fln, meta) in enumerate(forks, start=1):
+            lanes0.append(fln)
+            exp_refs.append(ji)
+            slot_ids.append(slot)
+            fork_meta.append(meta)
+    exp_jobs = [jobs[ji] for ji in exp_refs]
+    mats1_exp = [mats1[ji] for ji in exp_refs]
+
     if _prewarm_enabled() and mats1:
         # stage-1's first shape class compiles in the background while the
         # stage-0 searches occupy the device — serial per-class compiles are
@@ -2300,13 +2412,57 @@ def _solve_jax_many_impl(
     with telemetry.span('cmvm.jax.stage0', n_lanes=len(lanes0)):
         sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
 
-    # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed)
+    # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed);
+    # every beam fork carries its own stage-1 solve, since its stage-0
+    # intervals/latencies differ from the base trajectory's
     lanes1: list[_Lane] = []
-    for (mi, dc, mp, r), sol0, mat1 in zip(jobs, sols0, mats1):
+    for (mi, dc, mp, r), sol0, mat1 in zip(exp_jobs, sols0, mats1_exp):
         qints1, lats1 = sol0.out_qint, sol0.out_latency
         lanes1.append(_Lane(mat1, list(qints1), list(lats1), _lane_method(mpairs[mp][1], dc, _hard_eff)))
     with telemetry.span('cmvm.jax.stage1', n_lanes=len(lanes1)):
         sols1 = solve_single_lanes(lanes1, adder_size, carry_size, mesh=mesh, raw=True)
+
+    if two_phase:
+        # focused forking: the base batch just solved end-to-end, so each
+        # matrix's spec.focus cheapest base trajectories are known — fork
+        # only those (beam slots where the base sweep says they matter) and
+        # run the forks as one second pair of device batches
+        from .search.beam import expand_beam_lanes
+
+        base_totals_x = [float(s0.cost) + float(s1.cost) for s0, s1 in zip(sols0, sols1)]
+        per_m: dict[int, list[tuple[float, int]]] = {}
+        for x, (mi, _dc, _mp, _r) in enumerate(jobs):
+            if lanes0[x].method != 'dummy':
+                per_m.setdefault(mi, []).append((base_totals_x[x], x))
+        focus_idx: list[int] = []
+        for mi in sorted(per_m):
+            ranked = sorted(per_m[mi])  # cost asc, job order as tie-break
+            focus_idx.extend(x for _, x in ranked[: spec.focus])
+        focus_idx.sort()
+        sub = [lanes0[x] for x in focus_idx]
+        with telemetry.span('cmvm.search.expand', n_lanes=len(sub), beam=spec.beam, depth=spec.depth):
+            forks = expand_beam_lanes(sub, spec, adder_size, carry_size)
+        if forks:
+            fork_lanes: list[_Lane] = []
+            for slot, (si, fln, meta) in enumerate(forks, start=1):
+                ji = focus_idx[si]
+                fork_lanes.append(fln)
+                exp_refs.append(ji)
+                slot_ids.append(slot)
+                fork_meta.append(meta)
+            with telemetry.span('cmvm.jax.stage0', n_lanes=len(fork_lanes)):
+                sols0_f = solve_single_lanes(fork_lanes, adder_size, carry_size, mesh=mesh, raw=True)
+            lanes1_f: list[_Lane] = []
+            for ji, s0f in zip(exp_refs[len(jobs) :], sols0_f):
+                _mi, dcf, mpf, _rf = jobs[ji]
+                lanes1_f.append(
+                    _Lane(mats1[ji], list(s0f.out_qint), list(s0f.out_latency), _lane_method(mpairs[mpf][1], dcf, _hard_eff))
+                )
+            with telemetry.span('cmvm.jax.stage1', n_lanes=len(lanes1_f)):
+                sols1_f = solve_single_lanes(lanes1_f, adder_size, carry_size, mesh=mesh, raw=True)
+            sols0 = list(sols0) + list(sols0_f)
+            sols1 = list(sols1) + list(sols1_f)
+        exp_jobs = [jobs[ji] for ji in exp_refs]
 
     # per-matrix latency budget, computed once
     allowed = [inf] * n_mat
@@ -2324,11 +2480,11 @@ def _solve_jax_many_impl(
     # hard_dc >= 0 solve never leaves the device path.
     best_cost = [inf] * n_mat
     best_sols: list[tuple | None] = [None] * n_mat
-    first_fit: dict[tuple[int, int, int], tuple] = {}  # (matrix, method pair, restart) -> pair
+    first_fit: dict[tuple[int, int, int, int], tuple] = {}  # (matrix, method pair, restart, beam slot) -> pair
     terminal: list[tuple | None] = [None] * n_mat
-    for (mi, dc, mp, r), sol0, sol1 in zip(jobs, sols0, sols1):
+    for (mi, dc, mp, r), slot, sol0, sol1 in zip(exp_jobs, slot_ids, sols0, sols1):
         pair = (sol0, sol1)
-        if dc == -1 and r == 0 and terminal[mi] is None:
+        if dc == -1 and r == 0 and slot == 0 and terminal[mi] is None:
             terminal[mi] = pair
         max_lat = max((lt for s in pair for lt in s.out_latency), default=0.0)
         if max_lat > allowed[mi]:
@@ -2338,10 +2494,10 @@ def _solve_jax_many_impl(
             if c < best_cost[mi]:
                 best_cost[mi] = c
                 best_sols[mi] = pair
-        elif (mi, mp, r) not in first_fit:
-            first_fit[(mi, mp, r)] = pair
+        elif (mi, mp, r, slot) not in first_fit:
+            first_fit[(mi, mp, r, slot)] = pair
     if not search_all_decompose_dc:
-        for (mi, _, _), pair in first_fit.items():
+        for (mi, _, _, _), pair in first_fit.items():
             c = float(pair[0].cost) + float(pair[1].cost)
             if c < best_cost[mi]:
                 best_cost[mi] = c
@@ -2359,11 +2515,35 @@ def _solve_jax_many_impl(
             search_stats['over_budget_accepts'] += 1
         results.append(Pipeline(stages=(_as_comb(pair[0]), _as_comb(pair[1]))))
 
+    if spec is not None and spec.forks:
+        # training-data export (docs/cmvm.md#training-the-learned-ranker):
+        # every completed fork trajectory becomes (features, chosen,
+        # final-cost-delta) records when DA4ML_SEARCH_TRACE_DIR is set
+        from .search import trace as _strace
+
+        tdir = _strace.trace_dir()
+        if tdir:
+            totals = [float(s0.cost) + float(s1.cost) for s0, s1 in zip(sols0, sols1)]
+            base_totals = {jt: totals[x] for x, (jt, slot) in enumerate(zip(exp_jobs, slot_ids)) if slot == 0}
+            _strace.export_records(tdir, _strace.solve_records(kernels, exp_jobs, slot_ids, fork_meta, totals, base_totals))
+
     if include_host:
+        n_win = n_tie = n_rescue = 0
         for mi in range(n_mat):
             if mi in routed:  # already a host solution
                 continue
             host_sol = _solve_on_host(mi)
-            if float(host_sol.cost) < float(results[mi].cost):
+            dcost, hcost = float(results[mi].cost), float(host_sol.cost)
+            if dcost < hcost:
+                n_win += 1
+            elif dcost == hcost:
+                n_tie += 1
+            else:
+                n_rescue += 1
                 results[mi] = host_sol
+        # the quality gate's live signal: device lanes strictly beating the
+        # oracle vs rescued by it (docs/telemetry.md#search)
+        telemetry.counter('search.strict_wins').inc(n_win)
+        telemetry.counter('search.ties').inc(n_tie)
+        telemetry.counter('search.host_rescues').inc(n_rescue)
     return results

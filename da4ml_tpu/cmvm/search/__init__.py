@@ -1,0 +1,21 @@
+"""Search strategies for the CMVM solver (docs/cmvm.md#search-strategies).
+
+Light imports only: ``spec``/``ranker``/``train``/``trace`` are numpy-level
+and safe everywhere (checkpoint keys, CLI, host backends); ``beam`` pulls in
+the jax device stack and is imported lazily by its only consumer,
+``cmvm.jax_search``.
+"""
+
+from .ranker import FEATURE_NAMES, CostRanker, LearnedRanker, get_ranker
+from .spec import QUALITY_PRESETS, SearchSpec, quality_key, resolve_quality
+
+__all__ = [
+    'SearchSpec',
+    'QUALITY_PRESETS',
+    'resolve_quality',
+    'quality_key',
+    'CostRanker',
+    'LearnedRanker',
+    'get_ranker',
+    'FEATURE_NAMES',
+]

@@ -65,6 +65,11 @@ class DAState:
     freq_stat: dict[Pair, int]
     kernel: NDArray[np.float64]
     n_out: int = field(default=0)
+    #: ``freq_stat.items()`` in the reference scan order (Pair.sort_key asc),
+    #: maintained incrementally by :func:`update_stats` — heuristics consult
+    #: this instead of re-sorting the whole map on every selection call.
+    #: ``None`` means stale/unbuilt (the next selection sorts and caches).
+    sorted_stat: list[tuple[Pair, int]] | None = field(default=None, repr=False, compare=False)
 
 
 def _count_pairs_into(stat: dict[Pair, int], raw: list[Pair]) -> None:
@@ -197,9 +202,19 @@ def update_expr(state: DAState, pair: Pair, adder_size: int, carry_size: int) ->
 
 def update_stats(state: DAState, pair: Pair) -> None:
     """Purge freq entries touching the modified rows, regenerate, batch-merge
-    (state_opr.cc:285-345)."""
+    (state_opr.cc:285-345).
+
+    The sorted scan-order view (``state.sorted_stat``) is maintained
+    incrementally alongside: survivors of the purge keep their relative
+    order, regenerated pairs all touch a modified row (so they can never
+    collide with a survivor), and one ``heapq.merge`` of the two sorted runs
+    replaces the full re-sort the selection heuristics used to pay per call.
+    """
     id0, id1 = pair.id0, pair.id1
     dirty = {id0, id1}
+    survivors: list[tuple[Pair, int]] | None = None
+    if state.sorted_stat is not None and len(state.sorted_stat) == len(state.freq_stat):
+        survivors = [kv for kv in state.sorted_stat if kv[0].id0 not in dirty and kv[0].id1 not in dirty]
     state.freq_stat = {p: c for p, c in state.freq_stat.items() if not (p.id0 in dirty or p.id1 in dirty)}
 
     n_constructed = len(state.expr)
@@ -213,7 +228,16 @@ def update_stats(state: DAState, pair: Pair) -> None:
                     continue
                 lo, hi = min(_in0, _in1), max(_in0, _in1)
                 _row_pairs(raw, lo, hi, state.expr[lo][i_out], state.expr[hi][i_out])
-    _count_pairs_into(state.freq_stat, raw)
+    fresh: dict[Pair, int] = {}
+    _count_pairs_into(fresh, raw)
+    state.freq_stat.update(fresh)
+    if survivors is not None:
+        from heapq import merge
+
+        fresh_sorted = sorted(fresh.items(), key=lambda kv: kv[0].sort_key)
+        state.sorted_stat = list(merge(survivors, fresh_sorted, key=lambda kv: kv[0].sort_key))
+    else:
+        state.sorted_stat = None
 
 
 def update_state(state: DAState, pair: Pair, adder_size: int, carry_size: int) -> None:

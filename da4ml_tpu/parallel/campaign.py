@@ -97,6 +97,16 @@ def _jsonable_options(solver_options: dict | None) -> dict:
     opts = dict(solver_options or {})
     if opts.get('qintervals'):
         opts['qintervals'] = [list(t) for t in opts['qintervals']]
+    if 'quality' in opts:
+        # canonical dict form (a SearchSpec is not JSON-serializable; the
+        # fast default drops out so pre-existing manifests keep their keys)
+        from ..cmvm.search.spec import quality_key
+
+        qk = quality_key(opts['quality'])
+        if qk is None:
+            opts.pop('quality')
+        else:
+            opts['quality'] = qk
     return opts
 
 

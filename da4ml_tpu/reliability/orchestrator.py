@@ -112,11 +112,17 @@ _SOLVE_KW = (
     'search_all_decompose_dc',
     'method0_candidates',
     'n_restarts',
+    'quality',
 )
 
 
 def _call_backend(backend: str, kernel, kw: dict):
-    """Dispatch one backend attempt (fault-injection sites per backend)."""
+    """Dispatch one backend attempt (fault-injection sites per backend).
+
+    ``quality`` rides _SOLVE_KW into every backend: the jax search runs the
+    beam, host backends degrade it to a portfolio sweep (cmvm.api warns
+    once; the orchestrator records the degradation in the SolveReport).
+    """
     args = {k: kw[k] for k in _SOLVE_KW if k in kw}
     if backend == 'jax':
         from ..cmvm.jax_search import solve_jax
@@ -146,6 +152,16 @@ def _checkpoint_opts(kw: dict) -> dict:
     q = opts.get('qintervals')
     if q:
         opts['qintervals'] = [list(t) for t in q]
+    # canonicalize the quality knob: the fast default is dropped entirely so
+    # pre-existing checkpoint keys stay valid; active specs key on their
+    # to_dict form, so 'search', a SearchSpec, and its dict all agree
+    from ..cmvm.search.spec import quality_key
+
+    qk = quality_key(opts.get('quality'))
+    if qk is None:
+        opts.pop('quality', None)
+    else:
+        opts['quality'] = qk
     return opts
 
 
@@ -285,6 +301,15 @@ def _solve_orchestrated_impl(
         br.record_success()
         report.backend_used = bk
         report.total_duration_s = time.monotonic() - t_start
+        if bk != 'jax':
+            # device-only quality options silently narrow on host backends;
+            # the report records exactly what the answering backend dropped
+            # (cmvm.api emits the matching one-time warning)
+            nr = int(solve_kwargs.get('n_restarts') or 1)
+            if nr > 1:
+                report.warn(f'n_restarts={nr} dropped: backend {bk!r} runs no restart lanes (jax-only)')
+            if solve_kwargs.get('quality') not in (None, 'fast'):
+                report.warn(f'quality beam search degraded to a portfolio sweep on backend {bk!r}')
         if store is not None and key is not None:
             store.put(key, {'pipeline': result.to_dict(), 'cost': float(result.cost), 'backend': bk})
         return result

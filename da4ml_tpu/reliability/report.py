@@ -56,6 +56,14 @@ class SolveReport:
     phases: dict[str, float] = field(default_factory=dict)
     #: telemetry span id of the orchestrated solve (None when telemetry is off)
     trace_span_id: int | None = None
+    #: non-fatal degradations worth surfacing (e.g. device-only options —
+    #: n_restarts, the quality beam — dropped because a host backend
+    #: answered); deduplicated, in occurrence order
+    warnings: list[str] = field(default_factory=list)
+
+    def warn(self, message: str) -> None:
+        if message not in self.warnings:
+            self.warnings.append(message)
 
     @property
     def degraded(self) -> bool:
@@ -83,6 +91,7 @@ class SolveReport:
             'total_duration_s': round(self.total_duration_s, 4),
             'phases': {k: round(v, 6) for k, v in sorted(self.phases.items())},
             'trace_span_id': self.trace_span_id,
+            'warnings': list(self.warnings),
         }
 
     def summary(self) -> str:

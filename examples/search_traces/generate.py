@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Regenerate the committed search-trace corpus + trained ranker.
+
+Runs a deterministic beam solve campaign with ``DA4ML_SEARCH_TRACE_DIR``
+armed, consolidates the per-process trace files into one canonical
+``trace_corpus.jsonl`` (records sorted, so the file is byte-stable), and
+fits the committed ``ranker.json`` from it (search/train.py — closed-form,
+no RNG). Run from the repo root::
+
+    JAX_PLATFORMS=cpu python examples/search_traces/generate.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SEED = 20260804
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as td:
+        os.environ['DA4ML_SEARCH_TRACE_DIR'] = td
+        from da4ml_tpu.cmvm import SearchSpec
+        from da4ml_tpu.cmvm.jax_search import solve_jax_many
+        from da4ml_tpu.cmvm.search.trace import load_trace_dir
+        from da4ml_tpu.cmvm.search.train import train_from_dir
+
+        rng = np.random.default_rng(SEED)
+        kernels, lats = [], []
+        for dim, bits in [(8, 4), (10, 4), (12, 4), (12, 3), (14, 4), (16, 4), (16, 3)]:
+            mag = rng.integers(0, 2**bits, (dim, dim)).astype(np.float64)
+            kernels.append(mag * rng.choice([-1.0, 1.0], (dim, dim)))
+            # staggered input latencies so the latency_skew feature is live
+            lats.append([float(v) for v in rng.integers(0, 3, dim)])
+        # deep fork-everything spec: the training corpus wants feature
+        # variance (depth_remaining, novelty, skew), not the bounded-wall
+        # preset the ranker will later steer
+        spec = SearchSpec(beam=4, depth=3, focus=0, include_host=False)
+        solve_jax_many(kernels, latencies_list=lats, quality=spec)
+        del os.environ['DA4ML_SEARCH_TRACE_DIR']
+
+        records = load_trace_dir(td)
+        records.sort(key=lambda r: json.dumps(r, sort_keys=True))
+        out = os.path.join(HERE, 'trace_corpus.jsonl')
+        with open(out, 'w') as fh:
+            for r in records:
+                fh.write(json.dumps(r, sort_keys=True) + '\n')
+        print(f'{len(records)} records -> {out}')
+
+    ranker = train_from_dir(HERE)
+    ranker.save(os.path.join(HERE, 'ranker.json'))
+    print(f'trained ranker -> {os.path.join(HERE, "ranker.json")}')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

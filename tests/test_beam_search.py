@@ -1,0 +1,374 @@
+"""Beam/portfolio search invariants (docs/cmvm.md#search-strategies).
+
+The contracts under test:
+
+- never-worse: a beam solve's cost is <= the greedy solve's on every kernel
+  (the unforked greedy lane always rides in the batch);
+- ``quality='fast'`` (the default) is byte-identical to the pre-beam solver;
+- beam solves are deterministic across runs and across mesh shardings on
+  the 8-device CPU mesh;
+- ``SearchSpec`` round-trips through checkpoint keys;
+- the learned ranker reproduces train -> save -> load -> rank bit-exactly.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.cmvm import QUALITY_PRESETS, SearchSpec, resolve_quality, solve
+from da4ml_tpu.cmvm.jax_search import solve_jax_many
+from da4ml_tpu.ir import QInterval
+
+
+def random_kernel(rng, n_dim, bits, n_out=None):
+    n_out = n_dim if n_out is None else n_out
+    mag = rng.integers(0, 2**bits, (n_dim, n_out)).astype(np.float64)
+    sign = rng.choice([-1.0, 1.0], (n_dim, n_out))
+    return mag * sign
+
+
+def assert_pipelines_identical(a, b):
+    """Op-for-op byte identity of two solved pipelines."""
+    assert a.cost == b.cost and a.latency == b.latency
+    for sa, sb in zip(a.stages, b.stages):
+        assert len(sa.ops) == len(sb.ops)
+        for oa, ob in zip(sa.ops, sb.ops):
+            assert (oa.id0, oa.id1, oa.opcode, oa.data) == (ob.id0, ob.id1, ob.opcode, ob.data)
+
+
+# ---------------------------------------------------------------------------
+# spec / presets
+# ---------------------------------------------------------------------------
+
+
+def test_spec_presets_and_resolution():
+    assert resolve_quality(None).is_fast and resolve_quality('fast').is_fast
+    s = resolve_quality('search')
+    assert s.forks and s.beam == 5 and s.focus == 3 and s.include_host
+    m = resolve_quality('max')
+    assert m.beam == 8 and len(m.portfolio) == 6 and m.n_restarts == 4 and m.focus == 0
+    assert resolve_quality(s) is s
+    assert resolve_quality(s.to_dict()) == s
+    with pytest.raises(ValueError):
+        resolve_quality('bogus')
+    with pytest.raises(TypeError):
+        resolve_quality(3)
+    with pytest.raises(ValueError):
+        SearchSpec(beam=0)
+    with pytest.raises(ValueError):
+        SearchSpec(portfolio=('nope',))
+    with pytest.raises(ValueError):
+        SearchSpec.from_dict({'beam': 2, 'bogus_key': 1})
+
+
+def test_spec_roundtrip_through_checkpoint_keys(tmp_path):
+    from da4ml_tpu.reliability.checkpoint import kernel_key
+    from da4ml_tpu.reliability.orchestrator import _checkpoint_opts
+
+    k = np.eye(4)
+    spec = QUALITY_PRESETS['search']
+    key_name = kernel_key(k, _checkpoint_opts({'method0': 'wmc', 'quality': 'search'}))
+    key_spec = kernel_key(k, _checkpoint_opts({'method0': 'wmc', 'quality': spec}))
+    key_dict = kernel_key(k, _checkpoint_opts({'method0': 'wmc', 'quality': spec.to_dict()}))
+    key_fast = kernel_key(k, _checkpoint_opts({'method0': 'wmc', 'quality': 'fast'}))
+    key_none = kernel_key(k, _checkpoint_opts({'method0': 'wmc'}))
+    assert key_name == key_spec == key_dict
+    assert key_fast == key_none != key_name
+
+
+def test_spec_checkpoint_hit_across_spellings(rng, tmp_path):
+    """A beam solve checkpointed under the preset name is restored by the
+    equivalent SearchSpec — and never by a fast solve."""
+    from da4ml_tpu.reliability import SolveReport
+
+    kernel = random_kernel(rng, 5, 3)
+    ckpt = tmp_path / 'ck.json'
+    r1 = SolveReport()
+    s1 = solve(kernel, backend='jax', quality='search', checkpoint=ckpt, report=r1)
+    assert r1.checkpoint_misses == 1
+    r2 = SolveReport()
+    s2 = solve(kernel, backend='jax', quality=QUALITY_PRESETS['search'], checkpoint=ckpt, report=r2)
+    assert r2.checkpoint_hits == 1
+    assert_pipelines_identical(s1, s2)
+    r3 = SolveReport()
+    solve(kernel, backend='jax', checkpoint=ckpt, report=r3)
+    assert r3.checkpoint_hits == 0 and r3.checkpoint_misses == 1
+
+
+# ---------------------------------------------------------------------------
+# beam invariants
+# ---------------------------------------------------------------------------
+
+
+def test_beam_never_worse_randomized_corpus(rng):
+    """Beam result cost <= greedy cost on every kernel of a randomized
+    corpus, with exactness (the acceptance invariant)."""
+    kernels = [
+        random_kernel(rng, int(rng.integers(4, 11)), int(rng.integers(2, 5)), int(rng.integers(4, 11)))
+        for _ in range(8)
+    ]
+    greedy = solve_jax_many(kernels)
+    beam = solve_jax_many(kernels, quality='search')
+    for k, g, b in zip(kernels, greedy, beam):
+        np.testing.assert_array_equal(np.asarray(b.kernel, np.float64), k)
+        assert b.cost <= g.cost, (b.cost, g.cost)
+        x = rng.integers(-8, 8, (32, k.shape[0])).astype(np.float64)
+        np.testing.assert_array_equal(b.predict(x, backend='numpy'), x @ k)
+
+
+def test_quality_fast_byte_identical(rng):
+    """The default path must not change at all under the beam integration."""
+    kernels = [random_kernel(rng, n, 4) for n in (4, 6, 8)]
+    base = solve_jax_many(kernels)
+    fast = solve_jax_many(kernels, quality='fast')
+    none = solve_jax_many(kernels, quality=None)
+    for b, f, n in zip(base, fast, none):
+        assert_pipelines_identical(b, f)
+        assert_pipelines_identical(b, n)
+
+
+def test_beam_deterministic_across_runs(rng):
+    kernels = [random_kernel(rng, 7, 4) for _ in range(3)]
+    a = solve_jax_many(kernels, quality='search')
+    b = solve_jax_many(kernels, quality='search')
+    for x, y in zip(a, b):
+        assert_pipelines_identical(x, y)
+
+
+def test_beam_deterministic_across_mesh_shardings(rng):
+    """Same decisions whether the lane batch runs on 1, 4, or 8 of the CPU
+    mesh devices (beam slots shard like any other lane)."""
+    import jax
+    from jax.sharding import Mesh
+
+    kernels = [random_kernel(rng, 6, 4) for _ in range(3)]
+    devs = jax.devices()
+    assert len(devs) >= 8, 'conftest must provide the virtual 8-device mesh'
+    ref = solve_jax_many(kernels, quality='search', mesh=None)
+    for nd in (4, 8):
+        mesh = Mesh(np.asarray(devs[:nd]), ('batch',))
+        got = solve_jax_many(kernels, quality='search', mesh=mesh)
+        for x, y in zip(ref, got):
+            assert_pipelines_identical(x, y)
+
+
+def test_beam_under_hard_dc_budget(rng):
+    from math import inf
+
+    from da4ml_tpu.cmvm.api import minimal_latency
+
+    kernel = random_kernel(rng, 6, 4)
+    for hard_dc in (0, 2):
+        sol = solve_jax_many([kernel], hard_dc=hard_dc, quality='search')[0]
+        np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+        qints = [QInterval(-128.0, 127.0, 1.0)] * 6
+        allowed = hard_dc + minimal_latency(kernel, qints, [0.0] * 6, -1, -1)
+        max_lat = max((lt for st in sol.stages for lt in st.out_latency), default=0.0)
+        assert max_lat <= allowed < inf
+
+
+def test_beam_heterogeneous_qintervals(rng):
+    """Fork prefixes respect per-input metadata (restart perms included)."""
+    kernel = random_kernel(rng, 6, 4)
+    qints = [QInterval(-(2.0**e), 2.0**e - 2.0**-2, 2.0**-2) for e in range(2, 8)]
+    lats = [float(i % 3) for i in range(6)]
+    sol = solve_jax_many([kernel], qintervals_list=[qints], latencies_list=[lats], quality='search')[0]
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+    x = np.stack([rng.integers(-(2**e), 2**e, 64) for e in range(2, 8)], axis=1).astype(np.float64)
+    np.testing.assert_array_equal(sol.predict(x, backend='numpy'), x @ kernel)
+
+
+def test_beam_never_worse_than_host_oracle(rng):
+    """quality='search' folds the oracle in: never a cost regression."""
+    from da4ml_tpu.cmvm import api as host_api
+
+    kernels = [random_kernel(rng, 8, 4) for _ in range(4)]
+    host = [host_api.solve(k, backend='auto') for k in kernels]
+    beam = solve_jax_many(kernels, quality='search')
+    for k, h, b in zip(kernels, host, beam):
+        assert b.cost <= h.cost, (b.cost, h.cost)
+
+
+# ---------------------------------------------------------------------------
+# heuristics / expansion primitives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('method', ['mc', 'wmc', 'mc-dc', 'wmc-dc', 'mc-pdc', 'wmc-pdc'])
+def test_top_candidates_head_matches_select_pair(rng, method):
+    from da4ml_tpu.cmvm.heuristics import select_pair, top_candidates
+    from da4ml_tpu.cmvm.state import create_state, update_state
+
+    kernel = random_kernel(rng, 7, 4)
+    st = create_state(kernel, [QInterval(-128.0, 127.0, 1.0)] * 7, [0.0] * 7)
+    steps = 0
+    while st.freq_stat and steps < 64:
+        cands = top_candidates(st, method, 4)
+        pair = select_pair(st, method)
+        if pair.id0 == -1:
+            break
+        assert cands and cands[0][0] == pair
+        assert len({c[0] for c in cands}) == len(cands)  # distinct pairs
+        update_state(st, pair, -1, -1)
+        steps += 1
+    assert steps > 0
+
+
+def test_expand_beam_lanes_prefix_contract(rng):
+    """Fork prefixes are valid CSE states: records reference earlier slots,
+    digit tensors stay trits, and forks of byte-identical lanes are shared."""
+    from da4ml_tpu.cmvm.jax_search import _Lane
+    from da4ml_tpu.cmvm.search.beam import expand_beam_lanes
+
+    kernel = random_kernel(rng, 6, 4)
+    qints = [QInterval(-128.0, 127.0, 1.0)] * 6
+    lanes = [
+        _Lane(kernel, qints, [0.0] * 6, 'wmc'),
+        _Lane(kernel, qints, [0.0] * 6, 'wmc'),  # duplicate: shares expansion
+        _Lane(kernel, qints, [0.0] * 6, 'dummy'),  # never forked
+    ]
+    spec = SearchSpec(beam=3, depth=2)
+    forks = expand_beam_lanes(lanes, spec, -1, -1)
+    assert forks, 'beam must fork at least one trajectory'
+    assert all(ji in (0, 1) for ji, _, _ in forks)
+    shared = {}
+    for ji, fln, meta in forks:
+        pfx = fln.prefix
+        d = len(pfx.rec)
+        assert 1 <= d <= spec.depth
+        assert pfx.E.shape == (6 + d, 6, pfx.E.shape[2])
+        assert set(np.unique(pfx.E)) <= {-1, 0, 1}
+        for t, (id0, id1, sub, shift) in enumerate(pfx.rec):
+            assert 0 <= id0 <= id1 < 6 + t and sub in (0, 1)
+        assert len(meta) == d and all('features' in s for s in meta)
+        shared.setdefault(ji, []).append(pfx.rec.tobytes())
+    # the duplicate lane reuses the memoized expansion byte-for-byte
+    assert shared.get(0) == shared.get(1)
+
+
+# ---------------------------------------------------------------------------
+# ranker / training
+# ---------------------------------------------------------------------------
+
+
+def test_ranker_train_save_load_rank_reproducible(tmp_path):
+    from da4ml_tpu.cmvm.search.ranker import FEATURE_NAMES, LearnedRanker
+    from da4ml_tpu.cmvm.search.train import train_ranker
+
+    prng = np.random.default_rng(11)
+    X = prng.normal(size=(64, len(FEATURE_NAMES)))
+    y = X @ np.asarray([1.0, -0.5, 0.2, 0.0, 0.3]) + 0.1 * prng.normal(size=64)
+    r1 = train_ranker(X, y)
+    p = tmp_path / 'ranker.json'
+    r1.save(p)
+    r2 = LearnedRanker.load(p)
+    np.testing.assert_array_equal(r1.predict(X), r2.predict(X))
+    # training is deterministic: same data -> identical weights
+    r3 = train_ranker(X, y)
+    np.testing.assert_array_equal(r1.weights, r3.weights)
+    blob1 = json.loads(p.read_text())
+    r2.save(p)
+    assert json.loads(p.read_text()) == blob1
+
+
+def test_trace_export_and_training_workflow(rng, tmp_path, monkeypatch):
+    """DA4ML_SEARCH_TRACE_DIR -> (features, chosen, final-cost-delta) JSONL
+    -> trained ranker -> steers a solve (the full satellite workflow)."""
+    from da4ml_tpu.cmvm.search.trace import load_trace_dir
+    from da4ml_tpu.cmvm.search.train import main as train_main
+    from da4ml_tpu.cmvm.search.train import records_to_xy
+
+    tdir = tmp_path / 'traces'
+    monkeypatch.setenv('DA4ML_SEARCH_TRACE_DIR', str(tdir))
+    kernels = [random_kernel(rng, 6, 4) for _ in range(2)]
+    solve_jax_many(kernels, quality='search')
+    monkeypatch.delenv('DA4ML_SEARCH_TRACE_DIR')
+    records = load_trace_dir(tdir)
+    assert records
+    for r in records:
+        assert len(r['features']) == 5 and isinstance(r['chosen'], bool)
+        assert isinstance(r['final_cost_delta'], float)
+    X, y = records_to_xy(records)
+    assert X.shape[0] == len(records)
+    out = tmp_path / 'ranker.json'
+    assert train_main([str(tdir), str(out)]) == 0
+    spec = SearchSpec(beam=3, depth=2, ranker=str(out))
+    greedy = solve_jax_many(kernels)
+    learned = solve_jax_many(kernels, quality=spec)
+    for k, g, b in zip(kernels, greedy, learned):
+        np.testing.assert_array_equal(np.asarray(b.kernel, np.float64), k)
+        assert b.cost <= g.cost
+
+
+# ---------------------------------------------------------------------------
+# degradation satellites
+# ---------------------------------------------------------------------------
+
+
+def test_host_backend_degrades_with_report_warnings(rng):
+    """n_restarts / beam quality on a host backend: recorded in the
+    SolveReport instead of dropped on the floor (warn_once fires too)."""
+    from da4ml_tpu.reliability import SolveReport
+
+    kernel = random_kernel(rng, 5, 3)
+    rep = SolveReport()
+    sol = solve(kernel, backend='cpu', quality='search', n_restarts=4, report=rep)
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernel)
+    assert any('n_restarts' in w for w in rep.warnings), rep.warnings
+    assert any('quality' in w for w in rep.warnings), rep.warnings
+    assert rep.to_dict()['warnings'] == rep.warnings
+    # a jax-backend beam solve records no degradation
+    rep2 = SolveReport()
+    solve(kernel, backend='jax', quality='search', n_restarts=2, report=rep2)
+    assert rep2.backend_used == 'jax' and not rep2.warnings
+
+
+def test_quality_through_solver_options(rng):
+    """quality= routes through solver_options on the tracer path and keeps
+    bit-exactness on both backends."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    w = random_kernel(rng, 6, 3)
+    for backend in ('jax', 'cpu'):
+        opts = {'backend': backend, 'quality': 'search'}
+        inp = FixedVariableArrayInput((3, 6), hwconf=HWConfig(1, -1, -1), solver_options=opts)
+        x = inp.quantize(np.ones((3, 6)), np.full((3, 6), 3), np.zeros((3, 6), np.int64))
+        comb = comb_trace(inp, x @ w)
+        data = rng.integers(-8, 8, (16, 18)).astype(np.float64)
+        out = comb.predict(data, backend='numpy')
+        np.testing.assert_array_equal(out.reshape(16, 3, -1), data.reshape(16, 3, 6) @ w)
+
+
+def test_search_telemetry_counters(rng):
+    """A beam solve emits the search.* metric family (docs/telemetry.md)."""
+    from da4ml_tpu import telemetry
+    from da4ml_tpu.telemetry.metrics import metrics_snapshot
+
+    telemetry.enable()
+    try:
+        solve_jax_many([random_kernel(rng, 6, 4)], quality='search')
+        snap = metrics_snapshot()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert snap.get('search.beam_width', {}).get('value') == 5
+    assert 'search.fork_lanes' in snap and snap['search.fork_lanes']['value'] > 0
+    assert 'search.frontier_culled' in snap
+    # include_host ran: win/tie/rescue counters sum to the matrix count
+    total = sum(int(snap.get(k, {}).get('value', 0)) for k in ('search.strict_wins', 'search.ties', 'search.host_rescues'))
+    assert total == 1
+
+
+def test_cli_quality_flag_wiring():
+    """convert --quality is exposed and defaults to the byte-identical path."""
+    import argparse
+
+    from da4ml_tpu._cli.convert import add_convert_args
+
+    parser = argparse.ArgumentParser()
+    add_convert_args(parser)
+    args = parser.parse_args(['model.json', 'out'])
+    assert args.quality == 'fast'
+    args = parser.parse_args(['model.json', 'out', '--quality', 'search'])
+    assert args.quality == 'search'

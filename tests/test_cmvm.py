@@ -35,6 +35,29 @@ def test_kernel_decompose(rng, dc):
     np.testing.assert_allclose(m0 @ m1, kernel, rtol=0, atol=0)
 
 
+@pytest.mark.parametrize('method', ['mc', 'wmc', 'mc-dc', 'wmc-dc', 'mc-pdc', 'wmc-pdc'])
+def test_heuristic_selection_order_incremental(rng, method, monkeypatch):
+    """Micro-assert: the incrementally maintained sorted freq view
+    (DAState.sorted_stat) reproduces the full re-sort exactly at every
+    greedy step, so heuristic selection order is unchanged."""
+    from da4ml_tpu.cmvm import heuristics as H
+    from da4ml_tpu.cmvm.core import cmvm as run_cmvm
+
+    orig = H._sorted_items
+    calls = []
+
+    def checked(state):
+        items = orig(state)
+        assert items == sorted(state.freq_stat.items(), key=lambda kv: kv[0].sort_key)
+        calls.append(len(items))
+        return items
+
+    monkeypatch.setattr(H, '_sorted_items', checked)
+    kernel = random_kernel(rng, 6, 4)
+    state = run_cmvm(kernel, method)
+    assert calls and len(state.ops) > 6  # the greedy loop ran through the instrumented scan
+
+
 @pytest.mark.parametrize('method0', ['mc', 'wmc'])
 @pytest.mark.parametrize('method1', ['mc', 'wmc', 'auto'])
 @pytest.mark.parametrize('hard_dc', [0, 2, -1])
