@@ -8,7 +8,10 @@ BASELINE.json baseline). detail[] adds config 2 (JEDI-linear MLP layer
 kernels), config 3 (dim x bits random sweep), config 4 (QConv2D 3x3 kernels
 as im2col constant blocks [kh*kw*Cin, Cout]), and config 5 (a full MLP+Conv
 model traced end to end, jax vs cpp solver backend), plus the
-compile-vs-search time split of the JAX path.
+compile-vs-search time split of the JAX path. Config entries also record
+the device-resident ladder evidence (``fetch_bytes`` / ``upload_bytes`` /
+``resident_rungs``); ``--no-device-resident`` runs the legacy host-state
+rung loop for A/B captures (docs/benchmarks.md#device-resident-ladder-protocol).
 
 Robustness: the axon TPU plugin can *hang* (not just fail) at backend init,
 so the TPU is probed in a bounded subprocess with retries; on failure the
@@ -128,8 +131,16 @@ def _parity(kernels, jax_sols, host_sols):
 
 
 def _run_config(name, kernels, host_backend):
+    from da4ml_tpu.telemetry.metrics import metrics_snapshot
+
     host_sols, host_t = _host_solve(kernels, host_backend)
+    pre = metrics_snapshot()
     jax_sols, jax_t, compile_t = _jax_solve(kernels)
+    post = metrics_snapshot()
+
+    def _delta(metric: str) -> int:
+        return int(post.get(metric, {}).get('value', 0) - pre.get(metric, {}).get('value', 0))
+
     n = len(kernels)
     entry = {
         'config': name,
@@ -142,6 +153,12 @@ def _run_config(name, kernels, host_backend):
         'speedup': round(host_t / jax_t, 3),
         'speedup_vs_16thread': round((n / jax_t) / _host_16t_rate(n, host_t), 3),
         'jax_compile_s': round(compile_t, 2),
+        # device-resident ladder evidence (docs/benchmarks.md#device-resident):
+        # host<->device traffic and on-device rung transitions across both
+        # jax solves; A/B against `--no-device-resident` to see the drop
+        'fetch_bytes': _delta('sched.fetch_bytes'),
+        'upload_bytes': _delta('sched.upload_bytes'),
+        'resident_rungs': _delta('sched.device_resident_rungs'),
         **_parity(kernels, jax_sols, host_sols),
     }
     return entry
@@ -881,6 +898,11 @@ def _parse_cache_flags(argv: list[str]) -> list[str]:
         a = argv[i]
         if a == '--no-persistent-cache':
             os.environ['DA4ML_XLA_CACHE'] = '0'
+        elif a == '--no-device-resident':
+            # A/B flag: legacy host-state rung loop (per-rung fetch/re-upload)
+            # so a capture pair shows the device-resident ladder's delta on
+            # identical hardware (docs/benchmarks.md#device-resident)
+            os.environ['DA4ML_JAX_DEVICE_RESIDENT'] = '0'
         elif a == '--cache-dir' and i + 1 < len(argv):
             os.environ['DA4ML_XLA_CACHE'] = argv[i + 1]
             i += 1

@@ -35,9 +35,12 @@ def _child() -> None:
     cache_dir = ensure_compile_cache()
 
     rng = np.random.default_rng(20260804)
+    # the (12, 5) kernel resumes across ladder rungs, so the drill also
+    # exercises the device-resident rung-transition kernels — the warm
+    # process must deserialize THOSE compile classes too
     kernels = [
         (rng.integers(0, 2**b, (d, d)) * rng.choice([-1.0, 1.0], (d, d))).astype(np.float64)
-        for d, b in ((6, 3), (8, 4))
+        for d, b in ((6, 3), (8, 4), (12, 5))
     ]
     t0 = time.perf_counter()
     sols = solve_jax_many(kernels)
@@ -59,6 +62,11 @@ def _child() -> None:
                 'buckets': executable_classes(),
                 'jit_compile': int(snap.get('jit.compile', {}).get('value', 0)),
                 'jit_cache_load': int(snap.get('jit.cache_load', {}).get('value', 0)),
+                # device-resident ladder evidence: transitions executed and
+                # the host<->device traffic they saved (docs/cmvm.md#scheduler)
+                'resident_rungs': int(snap.get('sched.device_resident_rungs', {}).get('value', 0)),
+                'fetch_bytes': int(snap.get('sched.fetch_bytes', {}).get('value', 0)),
+                'upload_bytes': int(snap.get('sched.upload_bytes', {}).get('value', 0)),
                 'metrics': snap,
             }
         )
@@ -119,6 +127,10 @@ def main() -> int:
                 and runs[0]['jit_compile'] > 0
                 and runs[1]['jit_compile'] == 0
                 and runs[1]['jit_cache_load'] > 0
+                # the warm process must stay compile-free WITH the
+                # device-resident transition kernels in play (they are
+                # compile classes too, markered + persisted like the rungs)
+                and runs[1].get('resident_rungs', 0) > 0
             ),
         }
         print(json.dumps({k: v for k, v in result.items() if k != 'runs'} | {'runs': [

@@ -315,6 +315,31 @@ def _select() -> str:
     return sel
 
 
+def _device_resident_enabled() -> bool:
+    """Device-resident rung ladders (env ``DA4ML_JAX_DEVICE_RESIDENT``,
+    default on): between rungs the search carry stays on device and the host
+    fetches only the op records + cursors; ``0`` restores the legacy
+    host-state rung loop (fetch/unpack/pad/re-upload per rung)."""
+    return os.environ.get('DA4ML_JAX_DEVICE_RESIDENT', '1') not in ('0', 'false', 'off')
+
+
+def _donate_ok() -> bool:
+    """Whether buffer donation is honored on this backend. CPU XLA ignores
+    donation and warns per call; requesting it there would spam stderr, so
+    the carry runs undonated (degrade silently-correctly — the resident
+    driver notes it once via ``telemetry.warn_once``)."""
+    return jax.default_backend() in ('tpu', 'gpu')
+
+
+def _rung_donate(spec) -> tuple:
+    """``donate_argnums`` for a rung program: the lane carry (digits, qmeta,
+    lat) is dead after dispatch in every driver mode, so donating lets XLA
+    alias it into the loop state and HBM holds one live copy per chain. The
+    fused path keeps its args alive for the top4 retry-on-Mosaic-failure
+    path, so it never donates."""
+    return (0, 1, 2) if _donate_ok() and spec.select != 'fused' else ()
+
+
 def _pmax() -> int:
     """Slot-count ceiling for the device search (env DA4ML_JAX_PMAX).
 
@@ -992,7 +1017,10 @@ def _build_cse_fn(spec: _KernelSpec):
         # Trimmed upload: the host ships only the R_in rows that carry state
         # (int32-packed when possible — int8 H2D through the remote tunnel is
         # ~5x slower per byte) and the device pads to the full P slots. Pad
-        # rows keep the benign-metadata invariant (step 1.0).
+        # rows keep the benign-metadata invariant (step 1.0). The packed
+        # layout is byte-identical to ``_pack_digits``'s output at P = R_in,
+        # so a previous rung's still-on-device output feeds this unpack
+        # directly (the device-resident rung chain, ``_transition_jit``).
         R_in = spec.R_in
         in_mode = 'trit' if (O * B) % 16 == 0 else ('byte' if (O * B) % 4 == 0 else 'raw')
 
@@ -1011,8 +1039,8 @@ def _build_cse_fn(spec: _KernelSpec):
             lat = jnp.pad(lat0, (0, P - R_in))
             return inner(E0, qmeta, lat, cur0, method)
 
-        return jax.jit(jax.vmap(lane_trimmed))
-    return jax.jit(jax.vmap(inner))
+        return jax.jit(jax.vmap(lane_trimmed), donate_argnums=_rung_donate(spec))
+    return jax.jit(jax.vmap(inner), donate_argnums=_rung_donate(spec))
 
 
 # --------------------------------------------------------------------------
@@ -1155,6 +1183,125 @@ def _as_comb(sol) -> CombLogic:
     return sol if isinstance(sol, CombLogic) else sol.to_comb()
 
 
+# --------------------------------------------------------------------------
+# device-resident rung chain: transition kernel + host-side decision replay
+# --------------------------------------------------------------------------
+
+
+def _packed_E_struct(bucket: int, P: int, O: int, B: int) -> jax.ShapeDtypeStruct:
+    """Shape/dtype of a rung's packed digit output ``[bucket, P, ...]`` —
+    also the transition kernel's input layout (see ``_pack_digits``)."""
+    if (O * B) % 16 == 0:
+        return jax.ShapeDtypeStruct((bucket, P, (O * B) // 16), jnp.int32)
+    if (O * B) % 4 == 0:
+        return jax.ShapeDtypeStruct((bucket, P, (O * B) // 4), jnp.int32)
+    return jax.ShapeDtypeStruct((bucket, P, O, B), jnp.int8)
+
+
+_TRANS_JITS: dict[tuple, object] = {}
+
+
+def _transition_jit(sh=None):
+    """The jitted rung-transition kernel of the device-resident ladder.
+
+    Gathers the still-on-device carry (packed digits, qmeta, lat) of the
+    lanes resuming at the next rung into the next rung's (usually smaller)
+    lane bucket: ``sel`` is the host-computed source-lane index per
+    destination slot (-1 = padding; padding lanes are made inert by the
+    host-side ``cur0 = P`` sentinel, so the duplicated rows they gather are
+    never read). Carry buffers are donated where the backend honors
+    donation (``_donate_ok``), so HBM holds one live copy per chain. The
+    slot-axis growth P_from -> P_to happens inside the next rung's
+    trimmed-input unpack (R_in == P_from), which keeps rung compile classes
+    byte-identical between the resident and legacy drivers — both share one
+    persistent cache. One jit per (sharding, donation) pair; jax's own
+    call cache keys the per-shape executables.
+    """
+    donate = _donate_ok() and sh is None
+    key = (sh, donate)
+    fn = _TRANS_JITS.get(key)
+    if fn is None:
+
+        def trans(E, q, lat, sel):
+            idx = jnp.maximum(sel, 0)
+            return jnp.take(E, idx, axis=0), jnp.take(q, idx, axis=0), jnp.take(lat, idx, axis=0)
+
+        kw: dict = {}
+        if donate:
+            kw['donate_argnums'] = (0, 1, 2)
+        if sh is not None:
+            kw['out_shardings'] = (sh, sh, sh)
+        fn = jax.jit(trans, **kw)
+        _TRANS_JITS[key] = fn
+    return fn
+
+
+def _trans_cls(E_shape: tuple, E_dtype: str, bucket_to: int, sharded: bool) -> tuple:
+    """Compile-class key of one transition executable — feeds the same
+    first-call compile-vs-cache_load classification as the rung classes
+    (shared with ``_prewarm_transition``, so markers line up)."""
+    return ('transition', tuple(E_shape), str(E_dtype), bucket_to, sharded)
+
+
+def _substitute_np(E: NDArray, sub: int, s: int, i: int, j: int) -> NDArray:
+    """Numpy twin of the device ``substitute`` (one greedy CSE step on the
+    digit tensor, mutating ``E`` in place); returns the new intermediate
+    row. Kept in exact lockstep with the device logic — the resident driver
+    re-derives final digit tensors from the fetched decision records
+    instead of fetching the tensors themselves (``_replay_digits``)."""
+    O, B = E.shape[1], E.shape[2]
+    row_i = E[i].copy()
+    row_j = E[j].copy()
+    shifted_j = np.zeros_like(row_j)
+    if s < B:
+        shifted_j[:, : B - s] = row_j[:, s:]
+    target = -1 if sub == 1 else 1
+    sign_ok = (row_i != 0) & (shifted_j != 0) & (row_i.astype(np.int32) * shifted_j == target)
+    if i == j:
+        # digits can chain (b, b+s, b+2s); greedily match ascending bits —
+        # the host's same-row chain matching (state_opr.cc:249-280)
+        avail = row_i != 0
+        M = np.zeros((O, B), dtype=bool)
+        for b in range(B):
+            if b + s >= B:
+                continue
+            ok = sign_ok[:, b] & avail[:, b] & avail[:, b + s]
+            avail[:, b] &= ~ok
+            avail[:, b + s] &= ~ok
+            M[:, b] = ok
+    else:
+        M = sign_ok
+    M_up = np.zeros((O, B), dtype=bool)
+    if s < B:
+        M_up[:, s:] = M[:, : B - s]
+    E[i] = np.where(M, 0, row_i)
+    E[j] = np.where(M_up, 0, E[j])  # re-read: i == j sees the cleared row
+    new_row = (M * row_i) if i < j else (M_up * row_j)
+    return new_row.astype(np.int8)
+
+
+def _replay_digits(E0: NDArray, rec: NDArray, n_applied: int, n_in_max: int, n_slots: int, O: int, B: int) -> NDArray:
+    """Re-derive a finished lane's final digit tensor from its op records.
+
+    The device-resident driver fetches only decisions, so the host replays
+    the deterministic substitutions (byte-identical to the device tensor —
+    ``tests/test_bucket_parity.py`` pins resident == legacy end to end).
+    ``E0`` holds the lane state as of record ``n_applied`` (its rows are
+    current up to that record; later slots are re-created here); record
+    ``t`` creates slot ``n_in_max + t``."""
+    E = np.zeros((max(n_slots, E0.shape[0]), O, B), dtype=np.int8)
+    E[: E0.shape[0]] = E0
+    for t in range(n_applied, len(rec)):
+        id0, id1, sub, shift = (int(v) for v in rec[t])
+        # invert the record convention: shift = +s when i < j else -s
+        if shift >= 0:
+            i, j, s = id0, id1, shift
+        else:
+            i, j, s = id1, id0, -shift
+        E[n_in_max + t] = _substitute_np(E, sub, s, i, j)
+    return E
+
+
 def solve_single_lanes(
     lanes: list[_Lane],
     adder_size: int,
@@ -1175,7 +1322,13 @@ def solve_single_lanes(
       the pow2 ``_ladder_P`` ladder (P ~doubles per rung; explicit ``step``
       restores the legacy cur+step rungs): per-iteration selection cost is
       O(P^2), so early iterations run on small tensors and only stragglers
-      resume at larger P (state is resumable; finished lanes drop out);
+      resume at larger P (state is resumable; finished lanes drop out).
+      The whole ladder executes **device-resident** by default: rung k's
+      still-on-device carry feeds a donated transition kernel straight into
+      rung k+1, the host fetches only op records + cursors per rung, and
+      finished lanes' digit tensors are replayed from those decisions
+      (``DA4ML_JAX_DEVICE_RESIDENT=0`` restores the per-rung
+      fetch/re-upload host loop);
     - **overlapped dispatch/emit** — chunks of a rung dispatch depth-2
       pipelined (host pack/unpack overlaps device execute), and each
       bucket's host emission runs on a background worker while the next
@@ -1321,9 +1474,16 @@ def solve_single_lanes(
             st_cur = np.full((n_act,), n_in_max, dtype=np.int32)
             mcodes = np.zeros((n_act,), dtype=np.int32)
             recs: list[list[NDArray]] = [[] for _ in range(n_act)]
+            #: per lane: op records already materialized in its host digit
+            #: tensor hE[a] (prefix seeds at entry, everything fetched so far
+            #: after a legacy drain/spill). The resident driver's decision
+            #: replay (_replay_digits) starts from this record.
+            n_applied = np.zeros((n_act,), dtype=np.int32)
 
-            # initial per-lane search state (host numpy; see the rung loop
-            # below for why state never lives on device between rungs)
+            # initial per-lane search state (host numpy): rung 0 uploads it;
+            # from then on the carry normally stays device-resident (see the
+            # rung loop below), with hE/hq/hl refreshed only on legacy
+            # drains/spills
             hE: list[NDArray] = []
             hq: list[NDArray] = []
             hl: list[NDArray] = []
@@ -1364,20 +1524,54 @@ def solve_single_lanes(
                             rec[:, c] = np.where(rec[:, c] >= ni, rec[:, c] + shift_up, rec[:, c])
                     recs[a].append(rec)
                     st_cur[a] = n_in_max + d
+                    n_applied[a] = d  # prefix ops are already in hE[a]
                 hE.append(E)
                 hq.append(q)
                 hl.append(lb)
                 mcodes[a] = _METHOD_CODES[ln.method]
 
             pend = list(range(n_act))
-            # Between rungs the search state lives on the HOST (numpy, one
-            # entry per lane), not device-resident: re-slicing device state
-            # with data-dependent shapes (take of the finished subset, pads,
-            # concats) creates a fresh tiny XLA program per shape, and through
-            # the remote compiler each costs ~1.5s on first call. With
-            # host-side state every device program has a fixed shape per
-            # (P, O, B, bucket) class; the extra cost is one packed
-            # full-batch fetch + re-upload per rung (~0.1s/10MB).
+            # Between rungs the search carry (digit tensor, qmeta, lat) stays
+            # DEVICE-RESIDENT by default: a rung's still-on-device outputs
+            # feed a tiny jitted transition kernel (lane gather over a fixed
+            # [bucket_from] -> [bucket_to] class, donated carry) straight
+            # into the next rung's trimmed-input unpack, and the host fetches
+            # only the per-rung op records + cursors — the decision stream
+            # emission needs. Final digit tensors are re-derived on host by
+            # replaying those decisions (_replay_digits), so per-rung
+            # host<->device traffic is O(decisions), not O(state). Because
+            # the transition gathers into exactly the packed trimmed-upload
+            # layout, rung compile classes are byte-identical to the legacy
+            # host-state driver and both modes share one persistent cache.
+            # DA4ML_JAX_DEVICE_RESIDENT=0 restores the legacy loop
+            # (fetch/unpack/pad/re-upload per rung) — kept for multi-process
+            # meshes and as the parity oracle in tests; a rung that must
+            # split into HBM-guard chunks spills the carry to host for that
+            # rung and re-enters resident mode at the next single-chunk rung.
+            resident_on = _device_resident_enabled() and not multiproc
+            #: still-on-device carry of the previous rung's single chunk:
+            #: {'outs': rung outputs, 'pos': lane idx -> chunk slot, 'P': P}
+            dev_carry: dict | None = None
+
+            def _spill_carry(to_host: bool = True) -> None:
+                """Fetch the device-resident carry back into host lane state
+                (the legacy representation) — the escape hatch for chunked
+                rungs; ``to_host=False`` just drops it (PMAX safety net)."""
+                nonlocal dev_carry
+                if dev_carry is None:
+                    return
+                if to_host:
+                    oE_c, oq_c, ol_c = dev_carry['outs'][0], dev_carry['outs'][1], dev_carry['outs'][2]
+                    hEp_c, hq_c, hl_c = _fetch((oE_c, oq_c, ol_c))
+                    telemetry.counter('sched.fetch_bytes').inc(int(hEp_c.nbytes + hq_c.nbytes + hl_c.nbytes))
+                    E_all_c = _unpack_digits(np.asarray(hEp_c), O, B)
+                    hq_c, hl_c = np.asarray(hq_c), np.asarray(hl_c)
+                    for a, x in dev_carry['pos'].items():
+                        if st_cur[a] >= dev_carry['P']:  # pending lanes only
+                            hE[a], hq[a], hl[a] = E_all_c[x].copy(), hq_c[x].copy(), hl_c[x].copy()
+                            n_applied[a] = sum(len(r) for r in recs[a])
+                dev_carry = None
+
             while pend:
                 # async dispatch must not outlive a reliability deadline: a
                 # budgeted solve aborts between rungs instead of burning a
@@ -1398,6 +1592,7 @@ def solve_single_lanes(
                         # byte-identical.
                         from .core import solve_single as _host_solve_single
 
+                        _spill_carry(to_host=False)  # host re-solves from scratch
                         memo: dict[tuple, CombLogic] = {}
                         for a in pend:
                             k = active[a]
@@ -1440,6 +1635,11 @@ def solve_single_lanes(
                         spec2 = _resolve_rung_class(P2, O, B, adder_size, carry_size, _select(), pmax, P, full_rec=has_prefix)
                         bucket2 = _bucket_lanes(len(resume_est), mesh)
                         _prewarm_submit(lambda s=spec2, b=bucket2: _prewarm_class(s, b))
+                        if resident_on and sh is None:
+                            # the rung-transition hop into that class, too —
+                            # a resident chain must meet zero in-line compiles
+                            b1 = _bucket_lanes(n_pend, mesh)
+                            _prewarm_submit(lambda s=spec, b1=b1, b2=bucket2: _prewarm_transition(s, b1, b2))
 
                 # HBM guard: bound the lanes per device call so a wide batch of
                 # large matrices cannot OOM-crash the worker; excess lanes run
@@ -1477,6 +1677,17 @@ def solve_single_lanes(
                     max_lanes = 1 << (max_lanes.bit_length() - 1)
                     while max_lanes > 1 and _bucket_lanes(max_lanes, mesh) * per_lane > nd * hbm_budget:
                         max_lanes //= 2
+                single_chunk = n_pend <= max_lanes
+                # resident transitions require the previous rung's carry to
+                # cover every pending lane (it does iff that rung ran as one
+                # chunk) and its row count to equal this rung's trimmed-input
+                # class; anything else spills the carry to host state and
+                # this rung runs the legacy pack path
+                use_resident = (
+                    resident_on and single_chunk and dev_carry is not None and dev_carry['P'] == rows_in and rows_in < P
+                )
+                if dev_carry is not None and not use_resident:
+                    _spill_carry()
                 if n_pend > max_lanes:
                     # the rung splits into chunks: halve the budget so the
                     # depth-2 dispatch pipeline below never holds more than
@@ -1491,19 +1702,30 @@ def solve_single_lanes(
                 _timed = debug or telemetry.metrics_on()
 
                 def _drain(ent):
-                    """Fetch + unpack one in-flight chunk (FIFO with dispatch)."""
-                    nonlocal select, fn
-                    lo, n_chunk, chunk, bucket, args, outs, t0, cls = ent
+                    """Fetch one in-flight chunk (FIFO with dispatch).
+
+                    A resident drain (``res``) fetches ONLY the cursors + op
+                    records — O(decisions) bytes — and parks the rung outputs
+                    in ``dev_carry`` for the next rung's transition kernel; a
+                    legacy drain additionally fetches + unpacks the digit
+                    tensors (and qmeta/lat when lanes resume).
+                    """
+                    nonlocal select, fn, dev_carry
+                    lo, n_chunk, chunk, bucket, args, outs, t0, cls, res = ent
                     try:
                         oE, oq, ol, o_rec, ocur = outs
                         # one tree fetch (not one device_get per output): the
                         # remote tunnel charges a round trip per call, so
-                        # cur/records/digits come back together. qmeta/lat are
-                        # only needed for lanes that resume at a larger P
-                        # (finished lanes' metadata is re-derived on host in
-                        # f64 from the records) — a second fetch only then.
+                        # cur/records (and, legacy only, digits) come back
+                        # together. qmeta/lat are only needed for lanes that
+                        # resume at a larger P on the legacy path (finished
+                        # lanes' metadata is re-derived on host in f64 from
+                        # the records) — a second fetch only then.
                         with _prof.annotate('cmvm.rung.fetch'):
-                            h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                            if res:
+                                h_cur, h_rec = _fetch((ocur, o_rec))
+                            else:
+                                h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
                     except Exception as e:
                         if select != 'fused':
                             raise
@@ -1521,8 +1743,12 @@ def solve_single_lanes(
                         warnings.warn(f'fused CSE kernel failed ({type(e).__name__}); using the XLA top4 loop: {e}')
                         select = 'top4'
                         fn = _build_cse_fn(dataclasses.replace(spec, select='top4'))
-                        oE, oq, ol, o_rec, ocur = fn(*args)
-                        h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
+                        outs = fn(*args)
+                        oE, oq, ol, o_rec, ocur = outs
+                        if res:
+                            h_cur, h_rec = _fetch((ocur, o_rec))
+                        else:
+                            h_cur, h_rec, hEp = _fetch((ocur, o_rec, oE))
                     cur_f = np.asarray(h_cur)[:n_chunk]
                     if _timed:
                         _dt = time.perf_counter() - t0
@@ -1549,11 +1775,18 @@ def solve_single_lanes(
                                 f'[jax_search] round P={P} O={O} B={B} bucket={bucket} '
                                 f'chunk={lo}+{n_chunk}/{n_pend} select={select}: {_dt:.2f}s'
                             )
-                    if bool((cur_f >= P).any()):
-                        q_all, l_all = _fetch((oq, ol))
-                        q_all, l_all = np.asarray(q_all)[:n_chunk], np.asarray(l_all)[:n_chunk]
+                    fetched = int(h_cur.nbytes + h_rec.nbytes)
+                    if res:
+                        E_all = None
+                    else:
+                        fetched += int(hEp.nbytes)
+                        if bool((cur_f >= P).any()):
+                            q_all, l_all = _fetch((oq, ol))
+                            fetched += int(q_all.nbytes + l_all.nbytes)
+                            q_all, l_all = np.asarray(q_all)[:n_chunk], np.asarray(l_all)[:n_chunk]
+                        E_all = _unpack_digits(np.asarray(hEp), O, B)[:n_chunk]
+                    telemetry.counter('sched.fetch_bytes').inc(fetched)
                     op_rec = np.asarray(h_rec)[:n_chunk]
-                    E_all = _unpack_digits(np.asarray(hEp), O, B)[:n_chunk]
 
                     _n_subst = 0
                     for x, a in enumerate(chunk):
@@ -1566,9 +1799,22 @@ def solve_single_lanes(
                         # whole bucket-sized fetch buffer until emission
                         if c1 >= P:  # budget exhausted -> resume, larger P
                             next_pend.append(a)
-                            hE[a], hq[a], hl[a] = E_all[x].copy(), q_all[x].copy(), l_all[x].copy()
-                        else:
+                            if not res:
+                                hE[a], hq[a], hl[a] = E_all[x].copy(), q_all[x].copy(), l_all[x].copy()
+                                n_applied[a] = sum(len(r) for r in recs[a])
+                        elif not res:
                             st_E[a] = E_all[x].copy()
+                        # resident drains leave finished lanes' digit tensors
+                        # on device (dropped with the carry): emission replays
+                        # them from the decision records (_replay_digits)
+                    if res:
+                        # park the rung outputs for the next rung's on-device
+                        # transition; dropped when every lane finished
+                        dev_carry = (
+                            {'outs': outs, 'pos': {a: x for x, a in enumerate(chunk)}, 'P': P}
+                            if bool((cur_f >= P).any())
+                            else None
+                        )
                     if _n_subst:
                         # greedy CSE substitutions materialized this round
                         telemetry.counter('cse.substitutions').inc(_n_subst)
@@ -1583,37 +1829,82 @@ def solve_single_lanes(
                     chunk = pend[lo:hi]
                     n_chunk = hi - lo
                     bucket = _bucket_lanes(n_chunk, mesh)
-                    # host arrays trimmed to the rows that carry state (the
-                    # device pads to P); pad rows keep the benign-metadata
-                    # invariant (step 1.0, not 0): zero digit rows are never
-                    # selectable, but scoring reads the step column unguarded.
-                    # Padding lanes start at cur = P so their loop exits
-                    # immediately.
-                    rows_h = rows_in if rows_in < P else P
-                    cE = np.zeros((bucket, rows_h, O, B), np.int8)
-                    cq = np.zeros((bucket, rows_h, 3), np.float32)
-                    cq[:, :, 2] = 1.0
-                    cl = np.zeros((bucket, rows_h), np.float32)
                     cc = np.full((bucket,), P, np.int32)
                     cm = np.zeros((bucket,), np.int32)
                     for x, a in enumerate(chunk):
-                        pa = min(hE[a].shape[0], rows_h)
-                        cE[x, :pa] = hE[a][:pa]
-                        cq[x, :pa] = hq[a][:pa]
-                        cl[x, :pa] = hl[a][:pa]
                         cc[x] = st_cur[a]
                         cm[x] = mcodes[a]
-                    if rows_h < P and (O * B) % 16 == 0:
-                        # trit-packed upload (16 digits per int32 word, offset
-                        # by 1); the device unpacks — see _pack_digits
-                        cE_send = _trit_pack_np(cE.reshape(bucket, rows_h, O * B))
-                    elif rows_h < P and (O * B) % 4 == 0:
-                        # int32-packed upload (same little-endian view the
-                        # fetch side uses); the device bitcasts back to int8
-                        cE_send = np.ascontiguousarray(cE).reshape(bucket, rows_h, O * B).view(np.int32)
+                    if use_resident:
+                        # --- device-resident transition: the previous rung's
+                        # still-on-device carry gathers into this rung's lane
+                        # bucket; only sel/cur/method (O(bucket) ints) upload.
+                        # Padding slots (sel == -1) duplicate lane 0's rows
+                        # but start at cur = P, so they are inert.
+                        src = dev_carry
+                        dev_carry = None  # consumed (donated where honored)
+                        sel = np.full((bucket,), -1, np.int32)
+                        for x, a in enumerate(chunk):
+                            sel[x] = src['pos'][a]
+                        if sh is not None:
+                            sel_d, cc_d, cm_d = (jax.device_put(v, sh) for v in (sel, cc, cm))
+                        else:
+                            sel_d, cc_d, cm_d = jnp.asarray(sel), jnp.asarray(cc), jnp.asarray(cm)
+                        oE_s, oq_s, ol_s = src['outs'][0], src['outs'][1], src['outs'][2]
+                        t_cls = _trans_cls(oE_s.shape, oE_s.dtype, bucket, sh is not None)
+                        t_t0 = time.perf_counter()
+                        with telemetry.span('cmvm.jax.transition', n_lanes=n_chunk, P_from=src['P'], P_to=P):
+                            with _prof.annotate('cmvm.rung.transition'):
+                                tE, tq, tl = _transition_jit(sh)(oE_s, oq_s, ol_s, sel_d)
+                        if t_cls not in _SEEN_CLASSES:
+                            _SEEN_CLASSES.add(t_cls)
+                            try:
+                                jax.block_until_ready(tE)  # make the compile observable
+                            except Exception:
+                                pass
+                            _record_first_call(t_cls, time.perf_counter() - t_t0)
+                        if not _donate_ok():
+                            telemetry.warn_once(
+                                'jax.rung_donation',
+                                f'buffer donation is not honored on the {jax.default_backend()!r} backend; '
+                                'the device-resident rung carry runs undonated '
+                                '(DA4ML_JAX_DEVICE_RESIDENT=0 restores the host-state rung loop)',
+                            )
+                        telemetry.counter('sched.device_resident_rungs').inc()
+                        telemetry.counter('sched.upload_bytes').inc(int(sel.nbytes + cc.nbytes + cm.nbytes))
+                        args = (tE, tq, tl, cc_d, cm_d)
                     else:
-                        cE_send = cE
-                    args = tuple(jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm))
+                        # host arrays trimmed to the rows that carry state
+                        # (the device pads to P); pad rows keep the
+                        # benign-metadata invariant (step 1.0, not 0): zero
+                        # digit rows are never selectable, but scoring reads
+                        # the step column unguarded. Padding lanes start at
+                        # cur = P so their loop exits immediately.
+                        rows_h = rows_in if rows_in < P else P
+                        cE = np.zeros((bucket, rows_h, O, B), np.int8)
+                        cq = np.zeros((bucket, rows_h, 3), np.float32)
+                        cq[:, :, 2] = 1.0
+                        cl = np.zeros((bucket, rows_h), np.float32)
+                        for x, a in enumerate(chunk):
+                            pa = min(hE[a].shape[0], rows_h)
+                            cE[x, :pa] = hE[a][:pa]
+                            cq[x, :pa] = hq[a][:pa]
+                            cl[x, :pa] = hl[a][:pa]
+                        if rows_h < P and (O * B) % 16 == 0:
+                            # trit-packed upload (16 digits per int32 word,
+                            # offset by 1); the device unpacks — _pack_digits
+                            cE_send = _trit_pack_np(cE.reshape(bucket, rows_h, O * B))
+                        elif rows_h < P and (O * B) % 4 == 0:
+                            # int32-packed upload (same little-endian view the
+                            # fetch side uses); the device bitcasts to int8
+                            cE_send = np.ascontiguousarray(cE).reshape(bucket, rows_h, O * B).view(np.int32)
+                        else:
+                            cE_send = cE
+                        telemetry.counter('sched.upload_bytes').inc(
+                            int(cE_send.nbytes + cq.nbytes + cl.nbytes + cc.nbytes + cm.nbytes)
+                        )
+                        args = tuple(
+                            jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm)
+                        )
                     run = fn if sh is not None else _class_runner(spec, bucket, fn, args)
                     t0 = time.perf_counter() if _timed else 0.0
                     try:
@@ -1630,7 +1921,7 @@ def solve_single_lanes(
                         select = 'top4'
                         fn = _build_cse_fn(dataclasses.replace(spec, select='top4'))
                         outs = fn(*args)
-                    inflight.append((lo, n_chunk, chunk, bucket, args, outs, t0, (spec, bucket)))
+                    inflight.append((lo, n_chunk, chunk, bucket, args, outs, t0, (spec, bucket), resident_on and single_chunk))
                     if len(inflight) >= 2:
                         _drain(inflight.pop(0))
                 while inflight:
@@ -1644,12 +1935,17 @@ def solve_single_lanes(
                 ln = lanes[k]
                 ni, no, nb = ln.csd.shape
                 n_add = int(st_cur[a]) - n_in_max
-                E_f = st_E[a]
+                rec = np.concatenate(recs[a], axis=0) if recs[a] else np.zeros((0, 4), np.int32)
+                E_f = st_E.get(a)
+                if E_f is None:
+                    # resident drains never fetched this lane's final digit
+                    # tensor: replay the recorded decisions from its last
+                    # host-known state (byte-identical by construction)
+                    E_f = _replay_digits(hE[a], rec, int(n_applied[a]), n_in_max, int(st_cur[a]), O, B)
                 # slots in the device tensor: [0, n_in_max) inputs,
                 # [n_in_max, ...) new. Remap device slot index -> host op
                 # index (inputs of THIS lane first)
                 E_lane = np.concatenate([E_f[:ni, :no, :nb], E_f[n_in_max : n_in_max + n_add, :no, :nb]], axis=0)
-                rec = np.concatenate(recs[a], axis=0) if recs[a] else np.zeros((0, 4), np.int32)
                 shift_down = n_in_max - ni
                 if shift_down:
                     rec = rec.copy()
@@ -1816,6 +2112,28 @@ def _prewarm_class(spec: _KernelSpec, bucket: int) -> None:
         pass
 
 
+def _prewarm_transition(spec_from: _KernelSpec, bucket_from: int, bucket_to: int) -> None:
+    """AOT-compile the rung-transition executable for one (rung class,
+    bucket_from) -> bucket_to hop (lower + compile, no execution), so a
+    warm device-resident chain meets zero in-line compiles. Idempotent per
+    hop; failures are swallowed like :func:`_prewarm_class`."""
+    key = ('transition', spec_from.P, spec_from.O, spec_from.B, bucket_from, bucket_to)
+    if key in _PREWARMED:
+        return
+    _PREWARMED.add(key)
+    try:
+        ensure_compile_cache()
+        P, O, B = spec_from.P, spec_from.O, spec_from.B
+        E = _packed_E_struct(bucket_from, P, O, B)
+        q = jax.ShapeDtypeStruct((bucket_from, P, 3), jnp.float32)
+        lat = jax.ShapeDtypeStruct((bucket_from, P), jnp.float32)
+        sel = jax.ShapeDtypeStruct((bucket_to,), jnp.int32)
+        _transition_jit(None).lower(E, q, lat, sel).compile()
+        _classify_first_call(_trans_cls(E.shape, np.dtype(E.dtype), bucket_to, False))
+    except Exception:
+        pass
+
+
 #: set when the fused pallas kernel fails to compile/run on this platform;
 #: all later rungs route to top4 (per process — a wedged compile is sticky)
 _FUSED_BROKEN: list = []
@@ -1933,6 +2251,24 @@ def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=Non
     return out
 
 
+def _transition_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None) -> list[tuple]:
+    """Every (rung class, bucket_from, bucket_to) transition hop of the
+    device-resident ladder these lanes walk — the companion of
+    :func:`_ladder_specs` for the rung-transition kernels, so ``warmup
+    --grid`` also precompiles the hops between rungs. Consecutive entries
+    of each group's ladder walk pair up: the hop's input is the earlier
+    rung's packed output at its lane bucket, its ``sel`` axis the later
+    rung's (shrunken) bucket."""
+    pairs: list[tuple] = []
+    by_group: dict[tuple, list[tuple]] = {}
+    for spec, bucket in _ladder_specs(lanes, adder_size, carry_size, mesh):
+        by_group.setdefault((spec.O, spec.B), []).append((spec, bucket))
+    for rungs in by_group.values():
+        for (spec_a, bucket_a), (_spec_b, bucket_b) in zip(rungs, rungs[1:]):
+            pairs.append((spec_a, bucket_a, bucket_b))
+    return pairs
+
+
 def prewarm_for_kernels(
     kernel_groups: list[list[NDArray]],
     method0: str = 'wmc',
@@ -2038,6 +2374,14 @@ def prewarm_for_kernels(
                     if key not in warmed:
                         warmed.add(key)
                         _prewarm_class(*got)
+                if full_ladder and _device_resident_enabled():
+                    # the rung-transition hops between those classes, too —
+                    # a warm resident chain must meet zero in-line compiles
+                    for hop in _transition_specs(lanes, adder_size, carry_size, mesh):
+                        tkey = ('transition', *hop)
+                        if tkey not in warmed:
+                            warmed.add(tkey)
+                            _prewarm_transition(*hop)
 
     warmed: set = set()
     if inline:

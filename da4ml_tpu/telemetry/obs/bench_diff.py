@@ -321,7 +321,12 @@ def diff_metrics(a: dict[str, float], b: dict[str, float], budgets: 'Budgets | N
         status = 'info'
         max_drop = rule.get('max_drop_pct') if rule else None
         max_rise = rule.get('max_rise_pct') if rule else None
-        if max_drop is None and max_rise is None:
+        min_value = rule.get('min_value') if rule else None
+        if max_drop is None and max_rise is None and min_value is not None:
+            # an absolute floor alone opts the metric out of the relative
+            # defaults — the floor IS the budget
+            pass
+        elif max_drop is None and max_rise is None:
             # defaults by classification
             if kind == 'exact':
                 max_drop = budgets.defaults['exact_drop']
@@ -340,6 +345,15 @@ def diff_metrics(a: dict[str, float], b: dict[str, float], budgets: 'Budgets | N
         if max_rise is not None:
             limit = (limit + ',' if limit else '') + f'rise<={max_rise:g}%'
             if delta is not None and delta > max_rise + 1e-9:
+                status = 'regressed'
+            elif status == 'info':
+                status = 'ok'
+        if min_value is not None:
+            # absolute floor on the CURRENT value (baseline-independent):
+            # gates a hard-won level — e.g. the device-resident ladder's
+            # jax_rate — rather than a relative drop from a noisy baseline
+            limit = (limit + ',' if limit else '') + f'min>={min_value:g}'
+            if vb < min_value - 1e-9:
                 status = 'regressed'
             elif status == 'info':
                 status = 'ok'

@@ -129,6 +129,120 @@ def test_heterogeneous_batch_matches_solo(rng):
     np.testing.assert_array_equal(np.asarray(batched[1].kernel, np.float64), big)
 
 
+# ---------------------------------------------------------------------------
+# device-resident rung ladder (DA4ML_JAX_DEVICE_RESIDENT): the resident
+# chain (on-device transitions, decisions-only fetch, host-side digit
+# replay) must be byte-identical to the legacy host-state rung loop.
+# ---------------------------------------------------------------------------
+
+
+def _solve_pair(kernels, monkeypatch, **kw):
+    """(resident, legacy) solves of the same batch; env restored after."""
+    monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+    resident = solve_jax_many(kernels, **kw)
+    monkeypatch.setenv('DA4ML_JAX_DEVICE_RESIDENT', '0')
+    legacy = solve_jax_many(kernels, **kw)
+    monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+    return resident, legacy
+
+
+def test_device_resident_fuzz_grid_edges(rng, monkeypatch):
+    """Resident == legacy op-for-op across grid-edge shapes (pow2 and
+    3*2^k boundaries) whose ladders span multiple rungs."""
+    shapes = [(7, 6, 3), (9, 5, 4), (12, 12, 5), (16, 12, 5)]
+    kernels = [random_kernel(rng, *s) for s in shapes]
+    resident, legacy = _solve_pair(kernels, monkeypatch)
+    for a, b in zip(resident, legacy):
+        assert_pipelines_identical(a, b)
+
+
+def test_device_resident_resume_traffic_and_metrics(rng, monkeypatch):
+    """A multi-rung lane chains on device: the resident solve reports
+    ``sched.device_resident_rungs`` > 0 and a fraction of the legacy
+    host<->device traffic, at byte-identical decisions (R_in resume)."""
+    from da4ml_tpu.telemetry.metrics import disable_metrics, enable_metrics, metrics_snapshot, reset_metrics
+
+    kernel = random_kernel(rng, 16, 12, 5)
+    enable_metrics()
+    try:
+        reset_metrics()
+        monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+        (res,) = solve_jax_many([kernel])
+        s_res = metrics_snapshot()
+        reset_metrics()
+        monkeypatch.setenv('DA4ML_JAX_DEVICE_RESIDENT', '0')
+        (leg,) = solve_jax_many([kernel])
+        s_leg = metrics_snapshot()
+    finally:
+        monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+        disable_metrics()
+        reset_metrics()
+    assert_pipelines_identical(res, leg)
+    assert s_res.get('sched.device_resident_rungs', {}).get('value', 0) > 0
+    assert s_leg.get('sched.device_resident_rungs', {}).get('value', 0) == 0
+    # decisions-only fetch: a fraction of the full-state fetch, and the
+    # resident chain re-uploads no state between rungs
+    assert s_res['sched.fetch_bytes']['value'] < s_leg['sched.fetch_bytes']['value'] / 2
+    assert s_res['sched.upload_bytes']['value'] < s_leg['sched.upload_bytes']['value']
+
+
+def test_device_resident_prefix_fork_parity(rng, monkeypatch):
+    """Beam-fork (LanePrefix) lanes — heterogeneous cur0, full-capacity op
+    records — ride the resident ladder bit-exactly."""
+    kernels = [random_kernel(rng, 12, 8, 4), random_kernel(rng, 9, 6, 3)]
+    quality = {'beam': 2, 'depth': 1, 'focus': 1}
+    resident, legacy = _solve_pair(kernels, monkeypatch, quality=quality)
+    for a, b in zip(resident, legacy):
+        assert_pipelines_identical(a, b)
+
+
+def test_device_resident_deadline_abort(rng, monkeypatch):
+    """An expired cooperative deadline aborts the resident ladder between
+    rungs exactly like the legacy loop (SolveTimeout raised, no hang, no
+    stuck device carry)."""
+    import time
+
+    from da4ml_tpu.reliability import deadline as dl
+    from da4ml_tpu.reliability.errors import SolveTimeout
+
+    kernel = random_kernel(rng, 16, 12, 5)
+    for env in (None, '0'):
+        if env is None:
+            monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+        else:
+            monkeypatch.setenv('DA4ML_JAX_DEVICE_RESIDENT', env)
+        dl._local.deadline = time.monotonic() - 1.0
+        try:
+            with pytest.raises(SolveTimeout):
+                solve_jax_many([kernel])
+        finally:
+            dl._local.deadline = None
+    monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+
+
+def test_device_resident_mesh_parity(rng, monkeypatch):
+    """The resident transition under a sharded lane mesh (4- and 8-device
+    sub-meshes of the virtual cpu mesh) matches both the legacy mesh path
+    and the unsharded solve bit-exactly."""
+    import jax
+    from jax.sharding import Mesh
+
+    kernels = [random_kernel(rng, 16, 10, 5), random_kernel(rng, 8, 6, 3)]
+    base, legacy0 = _solve_pair(kernels, monkeypatch)
+    for a, b in zip(base, legacy0):
+        assert_pipelines_identical(a, b)
+    for nd in (4, 8):
+        mesh = Mesh(np.asarray(jax.devices('cpu')[:nd]), ('batch',))
+        monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+        resident = solve_jax_many(kernels, mesh=mesh)
+        monkeypatch.setenv('DA4ML_JAX_DEVICE_RESIDENT', '0')
+        legacy = solve_jax_many(kernels, mesh=mesh)
+        monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+        for a, b, c in zip(resident, legacy, base):
+            assert_pipelines_identical(a, b)
+            assert_pipelines_identical(a, c)
+
+
 def test_explicit_step_ladder_bit_identical(rng):
     """The legacy explicit-step rung policy and the default geometric
     ladder pause the resumable search at different rungs but decide

@@ -175,9 +175,13 @@ def test_warmup_grid_mirror(rng, monkeypatch):
     monkeypatch.setattr(js, '_build_cse_fn', real_build)
 
     warmed: list = []
+    trans: list = []
     monkeypatch.setattr(js, '_prewarm_class', lambda spec, bucket: warmed.append(spec))
+    monkeypatch.setattr(js, '_prewarm_transition', lambda s, b1, b2: trans.append((s, b1, b2)))
     n = js.prewarm_for_kernels([kernels], full_ladder=True, inline=True)
-    assert n == len(warmed) and n > 0
+    assert n == len(warmed) + len(trans) and n > 0
+    # the full-ladder grid also precompiles the device-resident rung hops
+    assert trans, 'full_ladder warmup enumerated no rung-transition classes'
     missing = set(used) - set(warmed)
     assert not missing, f'live classes missing from the warmup grid: {missing}'
 
