@@ -9,7 +9,11 @@ src/da4ml/_cli/__init__.py:8-27):
 - ``report`` — parse vendor synthesis reports from project directories into
   a summary table;
 - ``verify`` — run the DAIS static-analysis verifier over saved programs or
-  generated project directories (docs/analysis.md);
+  generated project directories (docs/analysis.md); ``--conformance`` adds
+  the cross-backend differential pass, ``--fuzz N`` runs the corpus
+  conformance + transfer-soundness sweep without paths;
+- ``lint-opcodes`` — fail on opcode dispatch sites outside the declarative
+  opcode table's allowlisted consumers (docs/analysis.md#drift-lint);
 - ``warmup`` — pre-compile the device-search shape classes;
 - ``stats`` — summarize a telemetry trace captured with ``--trace`` /
   ``DA4ML_TRACE`` (docs/telemetry.md); ``--follow`` tails a streaming
@@ -59,6 +63,14 @@ def main(argv: list[str] | None = None) -> int:
     p_verify = sub.add_parser('verify', help='Statically verify saved DAIS programs (well-formedness, intervals, lint)')
     add_verify_args(p_verify)
     p_verify.set_defaults(func=verify_main)
+
+    from ..analysis.driftlint import add_lint_opcodes_args, lint_opcodes_main
+
+    p_lint = sub.add_parser(
+        'lint-opcodes', help='Fail on opcode dispatch sites outside the declarative table consumers'
+    )
+    add_lint_opcodes_args(p_lint)
+    p_lint.set_defaults(func=lint_opcodes_main)
 
     from .stats import add_stats_args, stats_main
 

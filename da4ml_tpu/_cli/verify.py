@@ -8,6 +8,15 @@ slots directly into CI::
 
     da4ml-tpu verify examples/kernels/*.json
     da4ml-tpu verify build/my_project --json
+    da4ml-tpu verify prog.json --conformance     # + differential backends
+    da4ml-tpu verify --fuzz 12 --out report.json # corpus conformance +
+                                                 # transfer-soundness sweep
+
+``--conformance`` adds the opt-in cross-backend conformance pass per
+program; ``--fuzz N`` needs no paths — it sweeps N randomized ``ir.synth``
+programs through every runtime mode against the table-generated reference
+interpreter and fuzz-proves the per-opcode interval transfers
+(docs/analysis.md#conformance).
 """
 
 from __future__ import annotations
@@ -18,15 +27,36 @@ from pathlib import Path
 
 
 def add_verify_args(parser: argparse.ArgumentParser) -> None:
-    parser.add_argument('paths', nargs='+', type=Path, help='saved program .json files or project directories')
+    parser.add_argument('paths', nargs='*', type=Path, help='saved program .json files or project directories')
     parser.add_argument('--json', action='store_true', dest='as_json', help='emit machine-readable JSON diagnostics')
     parser.add_argument('--strict', action='store_true', help='exit non-zero on warnings as well as errors')
     parser.add_argument('--no-warnings', action='store_true', help='hide warnings from the text output')
     parser.add_argument(
         '--passes',
         default=None,
-        help='comma-separated pass subset to run (default: all); available: wellformed,qinterval,deadcode',
+        help='comma-separated pass subset to run (default: all non-opt-in); '
+        'available: wellformed,qinterval,deadcode,conformance',
     )
+    parser.add_argument(
+        '--conformance',
+        action='store_true',
+        help='also run the cross-backend conformance pass per program (differential execution '
+        'of numpy/unroll/scan/level vs the table-generated reference interpreter)',
+    )
+    parser.add_argument(
+        '--fuzz',
+        type=int,
+        default=0,
+        metavar='N',
+        help='no paths needed: run the N-program ir.synth differential conformance corpus plus '
+        'the per-opcode transfer-soundness fuzz, and exit non-zero on any finding',
+    )
+    parser.add_argument('--seed', type=int, default=0, help='base seed for --fuzz / --conformance inputs')
+    parser.add_argument('--samples', type=int, default=64, help='input samples per program for conformance runs')
+    parser.add_argument(
+        '--modes', default=None, help='comma-separated backend modes for conformance (default: numpy,unroll,scan,level)'
+    )
+    parser.add_argument('--out', type=Path, default=None, help='write the --fuzz JSON report to this path')
 
 
 def _resolve_program_file(path: Path) -> Path:
@@ -63,12 +93,60 @@ def _schedule_stats(program) -> list[dict]:
     return per
 
 
+def _fuzz_main(args: argparse.Namespace) -> int:
+    """Corpus mode: differential conformance + transfer-soundness fuzz."""
+    from ..analysis.conformance import CONFORMANCE_MODES, run_conformance_corpus
+    from ..analysis.soundness import check_transfer_soundness
+
+    modes = tuple(m.strip() for m in args.modes.split(',') if m.strip()) if args.modes else CONFORMANCE_MODES
+    conf_report, conf_diags = run_conformance_corpus(
+        n_programs=args.fuzz, n_samples=args.samples, seed=args.seed, modes=modes
+    )
+    sound_report, sound_diags = check_transfer_soundness(seed=args.seed)
+    report = {
+        'ok': conf_report['ok'] and sound_report['ok'],
+        'conformance': conf_report,
+        'transfer_soundness': sound_report,
+    }
+    if args.out:
+        args.out.write_text(json.dumps(report, indent=2))
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f'conformance: {args.fuzz} programs x {len(modes)} modes ({",".join(modes)}), {args.samples} samples each')
+        for oc, info in conf_report['per_opcode'].items():
+            print(f'  opcode {oc:>3} [{info["family"]}]: {info["ops"]} ops, {info["mismatches"]} mismatches')
+        for d in conf_diags:
+            print(f'  {d}')
+        print('transfer-soundness:')
+        for key, info in sound_report['per_family'].items():
+            print(
+                f'  {key} {tuple(info["opcodes"])}: {info["cases"]} cases x {info["samples_per_case"]} samples, '
+                f'{info["counterexamples"]} counterexamples'
+            )
+        for d in sound_diags:
+            print(f'  {d}')
+        print('opcode conformance: ' + ('ok' if report['ok'] else 'FAILED'))
+    return 0 if report['ok'] else 1
+
+
 def verify_main(args: argparse.Namespace) -> int:
     from ..analysis import verify
+
+    if args.fuzz:
+        return _fuzz_main(args)
+    if not args.paths:
+        print('verify: provide program paths, or --fuzz N for the corpus sweep')
+        return 2
 
     passes = None
     if args.passes:
         passes = tuple(p.strip() for p in args.passes.split(',') if p.strip())
+    if args.conformance:
+        from ..analysis import OPT_IN_PASSES, PASSES
+
+        base = passes if passes is not None else tuple(p for p in PASSES if p not in OPT_IN_PASSES)
+        passes = tuple(dict.fromkeys(base + ('conformance',)))
 
     results = []
     rc = 0

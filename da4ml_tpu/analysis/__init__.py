@@ -8,17 +8,27 @@ Three passes over ``CombLogic`` / ``Pipeline`` (docs/analysis.md):
   interval and flagging unsound annotations (overflow hazards), bad steps,
   and precision loss;
 - **deadcode** — unreachable ops, negative/NaN latency or cost, latency
-  monotonicity.
+  monotonicity;
+- **conformance** (opt-in) — differential execution of every runtime
+  backend against the reference interpreter generated from the declarative
+  opcode table (``ir/optable.py``), reporting per-opcode bit mismatches.
+
+The opcode-specific parts of every pass — legality ranges, interval
+transfer functions, the mutation catalog — are generated from the same
+table, and the :mod:`.soundness` checker fuzz-proves the transfers against
+the concrete replay semantics.
 
 Entry points: :func:`verify` (full diagnostics), :func:`verify_or_raise`
 (fail-fast, used by codegen preconditions and the ``DA4ML_VERIFY=1``
-post-solve hook), the ``da4ml-tpu verify`` CLI subcommand, and the
-:mod:`.mutation` corruption harness for self-tests.
+post-solve hook), the ``da4ml-tpu verify`` CLI subcommand (``--conformance``
+per program, ``--fuzz`` for the corpus sweep), and the :mod:`.mutation`
+corruption harness for self-tests.
 """
 
+from .conformance import CONFORMANCE_MODES, check_conformance, run_conformance_corpus
 from .deadcode import check_deadcode, live_ops
 from .diagnostics import ERROR, INFO, RULES, WARNING, Diagnostic, VerificationError, VerifyResult
-from .interval import check_intervals, is_pow2, representable
+from .interval import check_intervals, compute_intervals, is_pow2, representable
 from .mutation import (
     COMB_CORRUPTIONS,
     PIPELINE_CORRUPTIONS,
@@ -27,6 +37,7 @@ from .mutation import (
     corruption_by_name,
 )
 from .runner import (
+    OPT_IN_PASSES,
     PASSES,
     codegen_verify_enabled,
     post_solve_verify_enabled,
@@ -34,6 +45,7 @@ from .runner import (
     verify_comb,
     verify_or_raise,
 )
+from .soundness import check_spec_soundness, check_transfer_soundness
 from .wellformed import DAIS_V1_OPCODES, check_pipeline_interfaces, check_wellformed
 
 __all__ = [
@@ -45,6 +57,7 @@ __all__ = [
     'WARNING',
     'INFO',
     'PASSES',
+    'OPT_IN_PASSES',
     'verify',
     'verify_comb',
     'verify_or_raise',
@@ -53,7 +66,13 @@ __all__ = [
     'check_wellformed',
     'check_pipeline_interfaces',
     'check_intervals',
+    'compute_intervals',
     'check_deadcode',
+    'check_conformance',
+    'run_conformance_corpus',
+    'check_spec_soundness',
+    'check_transfer_soundness',
+    'CONFORMANCE_MODES',
     'live_ops',
     'is_pow2',
     'representable',

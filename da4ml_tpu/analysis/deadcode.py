@@ -16,6 +16,7 @@ from __future__ import annotations
 from math import isfinite
 
 from ..ir.comb import CombLogic
+from ..ir.optable import COPY_OPCODES
 from .diagnostics import Diagnostic
 from .wellformed import op_operands
 
@@ -44,10 +45,6 @@ def check_deadcode(
     skip_ops: frozenset[int] = frozenset(),
 ) -> list[Diagnostic]:
     diags: list[Diagnostic] = []
-
-    def emit(rule: str, message: str, op_index: int):
-        diags.append(Diagnostic(rule, message, op_index=op_index, stage=stage))
-
     n = len(comb.ops)
     live = live_ops(comb)
 
@@ -55,13 +52,16 @@ def check_deadcode(
         if i in skip_ops:
             continue
 
+        def emit(rule: str, message: str, op_index: int, _oc=op.opcode):
+            diags.append(Diagnostic(rule, message, op_index=op_index, stage=stage, opcode=_oc))
+
         for name, v in (('latency', op.latency), ('cost', op.cost)):
             if not isinstance(v, (int, float)) or not isfinite(v):
                 emit('D302', f'op {name} is {v!r}', i)
             elif v < 0:
                 emit('D302', f'op {name} is negative ({v})', i)
 
-        if not live[i] and op.opcode != -1:
+        if not live[i] and op.opcode not in COPY_OPCODES:
             emit('D301', f'op result (opcode {op.opcode}) never reaches an output', i)
 
         if isinstance(op.latency, (int, float)) and isfinite(op.latency):

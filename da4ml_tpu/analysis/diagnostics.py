@@ -1,34 +1,16 @@
 """Structured diagnostics for the DAIS static-analysis framework.
 
 Every finding a pass emits is a :class:`Diagnostic`: a stable rule id from
-the catalog below, a severity, the op index it anchors to (when applicable)
-and a human-readable message. Diagnostics are plain data — JSON-serializable
-via :meth:`Diagnostic.to_dict` — so the CLI, the post-solve hook and CI can
-all consume the same objects.
+the catalog below, a severity, the op index it anchors to (when applicable),
+the DAIS opcode it concerns (when applicable — sourced from the declarative
+opcode table so ``da4ml-tpu verify --json`` output can be grouped
+per-opcode), and a human-readable message. Diagnostics are plain data —
+JSON-serializable via :meth:`Diagnostic.to_dict` — so the CLI, the
+post-solve hook and CI can all consume the same objects.
 
-Rule catalog (docs/analysis.md keeps the user-facing copy):
-
-======  ==================  ========  =============================================
-id      name                severity  meaning
-======  ==================  ========  =============================================
-W101    shape-mismatch      error     io binding arrays inconsistent with ``shape``
-W102    unknown-opcode      error     opcode not in the DAIS v1 table
-W103    operand-violation   error     operand slot out of range or not earlier (SSA)
-W104    input-lane          error     copy op reads a non-existent input lane
-W105    output-binding      error     output bound to a non-existent op slot
-W106    shift-range         error     implausible power-of-two shift magnitude
-W110    lut-binding         error     lookup references a missing/invalid table
-W111    bitwise-subop       error     unknown bitwise sub-opcode
-W120    stage-interface     error     pipeline stage widths do not chain
-Q201    step-not-pow2       error     ``QInterval.step`` not a positive power of two
-Q202    interval-bounds     error     NaN/inf interval bound, or min > max
-Q210    interval-unsound    error     annotation cannot hold the computed interval
-Q220    precision-loss      warning   quantize op drops bits vs its operand
-Q221    lut-interval        warning   lookup annotation disagrees with its table
-D301    dead-op             warning   op result never reaches an output
-D302    cost-model          error     negative/NaN latency or cost
-D303    latency-monotone    warning   op latency below an operand's latency
-======  ==================  ========  =============================================
+The user-facing rule catalog in docs/analysis.md is *generated* from
+``RULES`` below (``python -m da4ml_tpu.analysis.docgen``); CI diffs the
+regenerated section against the committed file.
 """
 
 from __future__ import annotations
@@ -42,25 +24,29 @@ INFO = 'info'
 
 _SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
 
-#: rule id -> (short name, default severity)
-RULES: dict[str, tuple[str, str]] = {
-    'W101': ('shape-mismatch', ERROR),
-    'W102': ('unknown-opcode', ERROR),
-    'W103': ('operand-violation', ERROR),
-    'W104': ('input-lane', ERROR),
-    'W105': ('output-binding', ERROR),
-    'W106': ('shift-range', ERROR),
-    'W110': ('lut-binding', ERROR),
-    'W111': ('bitwise-subop', ERROR),
-    'W120': ('stage-interface', ERROR),
-    'Q201': ('step-not-pow2', ERROR),
-    'Q202': ('interval-bounds', ERROR),
-    'Q210': ('interval-unsound', ERROR),
-    'Q220': ('precision-loss', WARNING),
-    'Q221': ('lut-interval', WARNING),
-    'D301': ('dead-op', WARNING),
-    'D302': ('cost-model', ERROR),
-    'D303': ('latency-monotone', WARNING),
+#: rule id -> (short name, default severity, meaning). The meaning column is
+#: the docs/analysis.md catalog text (analysis.docgen renders it).
+RULES: dict[str, tuple[str, str, str]] = {
+    'W101': ('shape-mismatch', ERROR, 'io binding arrays inconsistent with `shape`'),
+    'W102': ('unknown-opcode', ERROR, 'opcode not in the DAIS v1 table'),
+    'W103': ('operand-violation', ERROR, 'operand slot out of range or not earlier (SSA)'),
+    'W104': ('input-lane', ERROR, 'copy op reads a non-existent input lane'),
+    'W105': ('output-binding', ERROR, 'output bound to a non-existent op slot'),
+    'W106': ('shift-range', ERROR, 'implausible power-of-two shift magnitude'),
+    'W110': ('lut-binding', ERROR, 'lookup references a missing/invalid table'),
+    'W111': ('bitwise-subop', ERROR, 'unknown bitwise sub-opcode'),
+    'W120': ('stage-interface', ERROR, 'pipeline stage widths do not chain'),
+    'Q201': ('step-not-pow2', ERROR, '`QInterval.step` not a positive power of two'),
+    'Q202': ('interval-bounds', ERROR, 'NaN/inf interval bound, or min > max'),
+    'Q210': ('interval-unsound', ERROR, 'annotation cannot hold the computed interval'),
+    'Q220': ('precision-loss', WARNING, 'quantize op drops bits vs its operand'),
+    'Q221': ('lut-interval', WARNING, 'lookup annotation disagrees with its table'),
+    'D301': ('dead-op', WARNING, 'op result never reaches an output'),
+    'D302': ('cost-model', ERROR, 'negative/NaN latency or cost'),
+    'D303': ('latency-monotone', WARNING, 'op latency below an operand\'s latency'),
+    'D310': ('transfer-unsound', ERROR, 'a concrete result escapes the abstract transfer interval (verifier bug)'),
+    'C401': ('backend-mismatch', ERROR, 'a runtime backend diverges bit-wise from the table-generated reference'),
+    'C402': ('coverage-gap', ERROR, 'an opcode of the DAIS v1 table has no coverage in the fuzz corpus'),
 }
 
 
@@ -73,6 +59,7 @@ class Diagnostic:
     op_index: int | None = None
     stage: int | None = None
     severity: str = field(default='')
+    opcode: int | None = None
 
     def __post_init__(self):
         if self.rule not in RULES:
@@ -86,6 +73,13 @@ class Diagnostic:
     def name(self) -> str:
         return RULES[self.rule][0]
 
+    @property
+    def opcode_family(self) -> str | None:
+        """Stable family label from the opcode table (None when no opcode)."""
+        from ..ir.optable import family_of
+
+        return family_of(self.opcode)
+
     def to_dict(self) -> dict:
         return {
             'rule': self.rule,
@@ -93,6 +87,8 @@ class Diagnostic:
             'severity': self.severity,
             'stage': self.stage,
             'op': self.op_index,
+            'opcode': self.opcode,
+            'opcode_family': self.opcode_family,
             'message': self.message,
         }
 
@@ -102,6 +98,8 @@ class Diagnostic:
             where += f'stage {self.stage} '
         if self.op_index is not None:
             where += f'op {self.op_index} '
+        if self.opcode is not None:
+            where += f'(opcode {self.opcode}) '
         return f'{self.severity.upper()} {self.rule} [{self.name}] {where.strip()}: {self.message}'.replace(' :', ':')
 
 
@@ -127,6 +125,13 @@ class VerifyResult:
 
     def by_rule(self, rule: str) -> list[Diagnostic]:
         return [d for d in self.diagnostics if d.rule == rule]
+
+    def by_opcode(self) -> dict[int | None, list[Diagnostic]]:
+        """Diagnostics grouped by the DAIS opcode they concern."""
+        groups: dict[int | None, list[Diagnostic]] = {}
+        for d in self.diagnostics:
+            groups.setdefault(d.opcode, []).append(d)
+        return groups
 
     def sorted(self) -> list[Diagnostic]:
         return sorted(

@@ -1,0 +1,105 @@
+"""Documentation generator for the table-owned reference sections.
+
+The opcode reference table in ``docs/dais.md`` and the rule catalog in
+``docs/analysis.md`` are *generated* from the single sources of truth
+(``ir/optable.py`` rows and ``analysis.diagnostics.RULES``) between marker
+comments::
+
+    <!-- BEGIN GENERATED: dais-opcode-table -->
+    ...
+    <!-- END GENERATED: dais-opcode-table -->
+
+Usage::
+
+    python -m da4ml_tpu.analysis.docgen            # rewrite in place
+    python -m da4ml_tpu.analysis.docgen --check    # exit 1 on drift (CI)
+
+Edits inside the markers are overwritten; the prose around them is never
+touched. The CI lint job runs ``--check`` so a table/rule change cannot
+land without its regenerated docs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+from ..ir.optable import OP_TABLE
+from .diagnostics import RULES
+
+
+def render_opcode_table() -> str:
+    """The docs/dais.md opcode reference, one row per table entry."""
+    lines = [
+        '| opcode | family | semantics | payload (`data`) | cost/latency model |',
+        '|---|---|---|---|---|',
+    ]
+    for spec in OP_TABLE:
+        ocs = ' / '.join(f'`{oc}`' for oc in spec.opcodes)
+        lines.append(f'| {ocs} | {spec.family} | {spec.semantics} | {spec.payload} | {spec.cost_model} |')
+    return '\n'.join(lines)
+
+
+def render_rule_catalog() -> str:
+    """The docs/analysis.md diagnostic rule catalog."""
+    lines = ['| rule | name | severity | meaning |', '|---|---|---|---|']
+    for rule, (name, severity, meaning) in RULES.items():
+        lines.append(f'| {rule} | {name} | {severity} | {meaning} |')
+    return '\n'.join(lines)
+
+
+#: doc file (relative to repo root) -> {marker name -> renderer}
+SECTIONS: dict[str, dict[str, object]] = {
+    'docs/dais.md': {'dais-opcode-table': render_opcode_table},
+    'docs/analysis.md': {'analysis-rule-catalog': render_rule_catalog},
+}
+
+
+def _splice(text: str, marker: str, body: str) -> str:
+    begin = f'<!-- BEGIN GENERATED: {marker} -->'
+    end = f'<!-- END GENERATED: {marker} -->'
+    pattern = re.compile(re.escape(begin) + r'.*?' + re.escape(end), re.DOTALL)
+    if not pattern.search(text):
+        raise ValueError(f'marker {marker!r} not found')
+    return pattern.sub(f'{begin}\n{body}\n{end}', text)
+
+
+def apply(root: str | Path | None = None, check: bool = False) -> list[str]:
+    """Regenerate every marked section. Returns the list of drifted files
+    (``check=True`` leaves files untouched)."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    root = Path(root)
+    drifted: list[str] = []
+    for rel, markers in SECTIONS.items():
+        path = root / rel
+        text = original = path.read_text()
+        for marker, renderer in markers.items():
+            text = _splice(text, marker, renderer())
+        if text != original:
+            drifted.append(rel)
+            if not check:
+                path.write_text(text)
+    return drifted
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog='python -m da4ml_tpu.analysis.docgen', description=__doc__)
+    parser.add_argument('--check', action='store_true', help='exit 1 if the committed docs drift from the table')
+    parser.add_argument('--root', default=None, help='repository root (default: the installed package root)')
+    args = parser.parse_args(argv)
+    drifted = apply(args.root, check=args.check)
+    if not drifted:
+        print('docgen: generated doc sections are in sync')
+        return 0
+    if args.check:
+        print(f'docgen: DRIFT in {drifted} — run `python -m da4ml_tpu.analysis.docgen` and commit')
+        return 1
+    print(f'docgen: regenerated {drifted}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

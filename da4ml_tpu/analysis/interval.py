@@ -5,7 +5,10 @@ semantics (the same semantics ``CombLogic.__call__`` replays) and flags
 annotations that cannot hold the computed values — an overflow hazard, since
 codegen sizes every wire from ``minimal_kif(op.qint)``.
 
-Soundness conventions this pass must respect (learned from the producers):
+The per-opcode transfer functions live in the declarative opcode table
+(``ir/optable.py``, one ``transfer`` per row) — this pass only owns the
+structural interval checks (finite ordered bounds, power-of-two step) and
+the dispatch loop. The producer conventions the transfers respect:
 
 - The greedy CMVM optimizer (cmvm/core.py ``to_solution``) tracks negative
   adder-tree contributions by *sign-flipping* the stored interval, so an
@@ -24,7 +27,9 @@ Soundness conventions this pass must respect (learned from the producers):
   not the four-corner product hull.
 
 Every interval is dyadic and computed the same way the producers compute it,
-so comparisons use an epsilon only as belt-and-braces.
+so comparisons use an epsilon only as belt-and-braces. The per-opcode
+transfer functions are fuzz-verified against the concrete replay semantics
+by the transfer-soundness checker (``analysis.soundness``).
 """
 
 from __future__ import annotations
@@ -32,7 +37,8 @@ from __future__ import annotations
 from math import isfinite, log2
 
 from ..ir.comb import CombLogic
-from ..ir.types import QInterval, minimal_kif, qint_add
+from ..ir.optable import OPCODE_TO_SPEC
+from ..ir.types import QInterval, minimal_kif
 from .diagnostics import Diagnostic
 
 _EPS = 1e-9
@@ -50,25 +56,14 @@ def _tol(*vals: float) -> float:
     return _EPS * max(1.0, *(abs(v) for v in vals if isfinite(v)))
 
 
-def _contains(outer: QInterval, lo: float, hi: float, step: float) -> bool:
-    t = _tol(lo, hi)
-    return outer.min <= lo + t and outer.max >= hi - t and outer.step <= step * (1.0 + _EPS)
-
-
-def _neg(lo: float, hi: float) -> tuple[float, float]:
-    return -hi, -lo
-
-
-def check_intervals(
+def compute_intervals(
     comb: CombLogic,
-    stage: int | None = None,
     skip_ops: frozenset[int] = frozenset(),
-) -> list[Diagnostic]:
+) -> tuple[list[QInterval | None], list[Diagnostic]]:
+    """Abstractly interpret the op list; returns (per-op computed intervals,
+    diagnostics). ``None`` marks a slot whose interval could not be computed
+    (structurally bad or skipped)."""
     diags: list[Diagnostic] = []
-
-    def emit(rule: str, message: str, op_index: int):
-        diags.append(Diagnostic(rule, message, op_index=op_index, stage=stage))
-
     n_ops = len(comb.ops)
     computed: list[QInterval | None] = [None] * n_ops
 
@@ -82,136 +77,49 @@ def check_intervals(
             continue
         q = op.qint
 
+        def emit(rule: str, message: str, _i=i, _oc=op.opcode):
+            diags.append(Diagnostic(rule, message, op_index=_i, opcode=_oc))
+
         # ---- structural interval validity (applies to every opcode)
         bad = False
         for name, v in (('min', q.min), ('max', q.max), ('step', q.step)):
             if not isinstance(v, (int, float)) or not isfinite(v):
-                emit('Q202', f'QInterval.{name} is {v!r}', i)
+                emit('Q202', f'QInterval.{name} is {v!r}')
                 bad = True
         if not bad and q.min > q.max + _tol(q.min, q.max):
-            emit('Q202', f'QInterval has min {q.min} > max {q.max}', i)
+            emit('Q202', f'QInterval has min {q.min} > max {q.max}')
             bad = True
         # zero-point intervals mark dead/constant-zero slots; any step is
         # accepted there, mirroring minimal_kif's early return
         if not bad and not (q.min == q.max == 0.0) and not is_pow2(q.step):
-            emit('Q201', f'QInterval.step must be a positive power of two, got {q.step}', i)
+            emit('Q201', f'QInterval.step must be a positive power of two, got {q.step}')
             bad = True
         if bad:
             continue  # computed[i] stays None: downstream checks skip
 
-        opc = op.opcode
+        # ---- per-opcode abstract interpretation (table-generated dispatch)
+        spec = OPCODE_TO_SPEC.get(op.opcode)
+        if spec is None:
+            continue  # W102 territory; wellformed flags it
+        c, checks = spec.transfer(comb, op, q, operand)
+        computed[i] = c
+        for rule, message in checks:
+            emit(rule, message)
 
-        # ---- per-opcode abstract interpretation
-        if opc in (-1, 2, -2, 3, -3):
-            # quantize family: the annotation defines the result container.
-            # Warn when it is strictly coarser than the operand's values.
-            src = operand(int(op.id0)) if opc != -1 else None
-            if src is not None and q.step > src.step * (1.0 + _EPS):
-                emit(
-                    'Q220',
-                    f'quantize drops precision: result step {q.step} is coarser than operand step {src.step}',
-                    i,
-                )
-            computed[i] = q
+    return computed, diags
 
-        elif opc in (0, 1):
-            q0, q1 = operand(int(op.id0)), operand(int(op.id1))
-            if q0 is None or q1 is None:
-                computed[i] = q
-                continue
-            try:
-                c = qint_add(q0, q1, int(op.data), False, opc == 1)
-            except OverflowError:
-                computed[i] = q
-                continue
-            computed[i] = c
-            if _contains(q, c.min, c.max, c.step):
-                continue
-            nlo, nhi = _neg(c.min, c.max)
-            if _contains(q, nlo, nhi, c.step):
-                continue
-            # CMVM sign-flip mixing can shift the position; span and step are
-            # invariant under it, so that is the weakest sound criterion
-            span_c, span_q = c.max - c.min, q.max - q.min
-            if span_q + _tol(span_c) >= span_c and q.step <= c.step * (1.0 + _EPS):
-                continue
-            emit(
-                'Q210',
-                f'annotation [{q.min}, {q.max}] step {q.step} cannot hold computed '
-                f'[{c.min}, {c.max}] step {c.step}',
-                i,
-            )
 
-        elif opc == 4:
-            q0 = operand(int(op.id0))
-            if q0 is None:
-                computed[i] = q
-                continue
-            c_add = int(op.data) * q.step
-            c = QInterval(q0.min + c_add, q0.max + c_add, min(q0.step, q.step))
-            computed[i] = c
-            if not (_contains(q, c.min, c.max, c.step) or _contains(q, *_neg(c.min, c.max), c.step)):
-                emit(
-                    'Q210',
-                    f'annotation [{q.min}, {q.max}] cannot hold operand + {c_add} = [{c.min}, {c.max}]',
-                    i,
-                )
-
-        elif opc == 5:
-            value = int(op.data) * q.step
-            computed[i] = QInterval(value, value, q.step)
-            t = _tol(value)
-            if not (q.min - t <= value <= q.max + t or q.min - t <= -value <= q.max + t):
-                emit('Q210', f'constant value {value} lies outside its annotation [{q.min}, {q.max}]', i)
-
-        elif opc in (6, -6):
-            # branch-correlated annotations are legitimately narrower than the
-            # branch hull (e.g. ``abs``), so the annotation is trusted both as
-            # the result container and for downstream propagation
-            computed[i] = q
-
-        elif opc == 7:
-            q0, q1 = operand(int(op.id0)), operand(int(op.id1))
-            if q0 is None or q1 is None:
-                computed[i] = q
-                continue
-            if int(op.id0) == int(op.id1):
-                ends = [q0.min * q0.min, q0.max * q0.max]
-                if q0.min < 0 < q0.max:
-                    ends.append(0.0)
-            else:
-                ends = [q0.min * q1.min, q0.min * q1.max, q0.max * q1.min, q0.max * q1.max]
-            c = QInterval(min(ends), max(ends), q0.step * q1.step)
-            computed[i] = c
-            if not (_contains(q, c.min, c.max, c.step) or _contains(q, *_neg(c.min, c.max), c.step)):
-                emit(
-                    'Q210',
-                    f'annotation [{q.min}, {q.max}] step {q.step} cannot hold product '
-                    f'[{c.min}, {c.max}] step {c.step}',
-                    i,
-                )
-
-        elif opc == 8:
-            tables = comb.lookup_tables
-            tbl = int(op.data)
-            if tables is None or not 0 <= tbl < len(tables):
-                computed[i] = q  # W110 already flagged it
-                continue
-            ft = tables[tbl].float_table
-            lo, hi = float(ft.min()), float(ft.max())
-            step = tables[tbl].spec.out_qint.step
-            computed[i] = q
-            if not (_contains(q, lo, hi, step) or _contains(q, *_neg(lo, hi), step)):
-                emit(
-                    'Q221',
-                    f'lookup annotation [{q.min}, {q.max}] step {q.step} disagrees with its '
-                    f'table range [{lo}, {hi}] step {step}',
-                    i,
-                )
-
-        else:  # bitwise ops (9/-9/10): the annotation defines the container
-            computed[i] = q
-
+def check_intervals(
+    comb: CombLogic,
+    stage: int | None = None,
+    skip_ops: frozenset[int] = frozenset(),
+) -> list[Diagnostic]:
+    _, diags = compute_intervals(comb, skip_ops=skip_ops)
+    if stage is not None:
+        diags = [
+            Diagnostic(d.rule, d.message, op_index=d.op_index, stage=stage, severity=d.severity, opcode=d.opcode)
+            for d in diags
+        ]
     return diags
 
 
@@ -223,4 +131,4 @@ def representable(q: QInterval) -> QInterval:
     return QInterval(-span if k else 0.0, span - step, step)
 
 
-__all__ = ['check_intervals', 'is_pow2', 'representable']
+__all__ = ['check_intervals', 'compute_intervals', 'is_pow2', 'representable']

@@ -1,10 +1,18 @@
 """Deterministic IR corruption harness for verifier self-tests.
 
 Each :class:`Corruption` damages one field of one op of a given DAIS opcode
-family and names the verifier rule that must catch it. Corruptions are wired
-into the fault-injection plan machinery (reliability/faults.py): site
-``ir.mutate.<name>`` with mode ``corrupt`` arms one corruption, so a chaos
-drill can corrupt programs exactly the way it degrades backends::
+family and names the verifier rule that must catch it. The per-opcode
+entries are *generated* from the declarative opcode table — every
+``OpSpec.mutations`` row of ``ir/optable.py`` becomes a catalog entry, so a
+new opcode ships with its corruption (and its detection test) by
+construction, with no hand-maintained list to drift. Only the container-
+level corruptions (io bindings, cost fields, pipeline interfaces) live
+here, since they are not tied to an opcode.
+
+Corruptions are wired into the fault-injection plan machinery
+(reliability/faults.py): site ``ir.mutate.<name>`` with mode ``corrupt``
+arms one corruption, so a chaos drill can corrupt programs exactly the way
+it degrades backends::
 
     with fault_injection('ir.mutate.add.forward_ref=corrupt:1'):
         prog = apply_planned_corruptions(prog)   # mutates iff armed
@@ -13,7 +21,7 @@ drill can corrupt programs exactly the way it degrades backends::
 
 The mutation self-test (tests/test_verifier.py) asserts every catalog entry
 is caught with a structured diagnostic; the catalog covers every opcode
-family of the DAIS v1 table.
+family of the DAIS v1 table by construction.
 """
 
 from __future__ import annotations
@@ -23,59 +31,14 @@ from math import nan
 from typing import Callable
 
 from ..ir.comb import CombLogic, Pipeline
-from ..ir.types import QInterval
+from ..ir.optable import OP_TABLE, _find_op, mutate_op
 from ..reliability.faults import fault_active
 
 FAULT_SITE_PREFIX = 'ir.mutate.'
 
 
-def _find(comb: CombLogic, opcodes: tuple[int, ...]) -> int:
-    for i, op in enumerate(comb.ops):
-        if op.opcode in opcodes:
-            return i
-    raise ValueError(f'program has no op with opcode in {opcodes}; cannot apply corruption')
-
-
-def _mutate_op(comb: CombLogic, opcodes: tuple[int, ...], **fields) -> CombLogic:
-    i = _find(comb, opcodes)
-    ops = list(comb.ops)
-    ops[i] = ops[i]._replace(**fields)
-    return comb._replace(ops=ops)
-
-
-def _mutate_qint(comb: CombLogic, opcodes: tuple[int, ...], fn: Callable[[QInterval], QInterval]) -> CombLogic:
-    i = _find(comb, opcodes)
-    ops = list(comb.ops)
-    ops[i] = ops[i]._replace(qint=fn(ops[i].qint))
-    return comb._replace(ops=ops)
-
-
-def _self_reference(comb: CombLogic, opcodes: tuple[int, ...], field: str) -> CombLogic:
-    i = _find(comb, opcodes)
-    ops = list(comb.ops)
-    ops[i] = ops[i]._replace(**{field: i})
-    return comb._replace(ops=ops)
-
-
-def _corrupt_mux_cond(comb: CombLogic) -> CombLogic:
-    i = _find(comb, (6, -6))
-    ops = list(comb.ops)
-    data = int(ops[i].data)
-    shift = data >> 32  # keep the shift word, repoint the condition at self
-    ops[i] = ops[i]._replace(data=(shift << 32) | i)
-    return comb._replace(ops=ops)
-
-
-def _corrupt_bitbin_subop(comb: CombLogic) -> CombLogic:
-    i = _find(comb, (10,))
-    ops = list(comb.ops)
-    data = int(ops[i].data)
-    ops[i] = ops[i]._replace(data=(9 << 56) | (data & ((1 << 56) - 1)))
-    return comb._replace(ops=ops)
-
-
 def _corrupt_outputs_dead(comb: CombLogic) -> CombLogic:
-    copy = _find(comb, (-1,))
+    copy = _find_op(comb, (-1,))
     return comb._replace(out_idxs=[copy] * len(comb.out_idxs))
 
 
@@ -110,51 +73,20 @@ class Corruption:
     apply: Callable  # CombLogic -> CombLogic (or Pipeline -> Pipeline)
 
 
-COMB_CORRUPTIONS: tuple[Corruption, ...] = (
-    Corruption('copy.bad_lane', 'copy', 'W104', lambda c: _mutate_op(c, (-1,), id0=c.shape[0] + 7)),
-    Corruption('add.forward_ref', 'add/sub', 'W103', lambda c: _self_reference(c, (0, 1), 'id1')),
-    Corruption('add.bad_shift', 'add/sub', 'W106', lambda c: _mutate_op(c, (0, 1), data=3000)),
-    Corruption(
-        'relu.step_not_pow2',
-        'relu-quantize',
-        'Q201',
-        lambda c: _mutate_qint(c, (2, -2), lambda q: QInterval(q.min, q.max, q.step * 0.75)),
-    ),
-    Corruption(
-        'quantize.inverted_bounds',
-        'quantize',
-        'Q202',
-        lambda c: _mutate_qint(c, (3, -3), lambda q: QInterval(q.max + 1.0, q.min, q.step)),
-    ),
-    Corruption(
-        'cadd.bias_drift',
-        'const-add',
-        'Q210',
-        lambda c: _mutate_op(c, (4,), data=int(c.ops[_find(c, (4,))].data) + (1 << 16)),
-    ),
-    Corruption(
-        'const.value_drift',
-        'const',
-        'Q210',
-        lambda c: _mutate_op(c, (5,), data=int(c.ops[_find(c, (5,))].data) + (1 << 16) + 1),
-    ),
-    Corruption('mux.cond_forward', 'msb-mux', 'W103', _corrupt_mux_cond),
-    Corruption(
-        'mul.narrowed_interval',
-        'mul',
-        'Q210',
-        lambda c: _mutate_qint(c, (7,), lambda q: QInterval(q.min / 64.0, q.max / 64.0, q.step)),
-    ),
-    Corruption('lut.bad_table', 'lut', 'W110', lambda c: _mutate_op(c, (8,), data=99)),
-    Corruption('bit_unary.bad_subop', 'unary-bitwise', 'W111', lambda c: _mutate_op(c, (9, -9), data=7)),
-    Corruption('bit_binary.bad_subop', 'binary-bitwise', 'W111', _corrupt_bitbin_subop),
-    Corruption('any.unknown_opcode', 'any', 'W102', lambda c: _mutate_op(c, (0, 1), opcode=42)),
-    Corruption('any.nan_latency', 'any', 'D302', lambda c: _mutate_op(c, (0, 1), latency=nan)),
-    Corruption('any.negative_cost', 'any', 'D302', lambda c: _mutate_op(c, (2, -2, 3, -3), cost=-1.0)),
+#: container-level corruptions: not tied to one opcode row
+_CONTAINER_CORRUPTIONS: tuple[Corruption, ...] = (
+    Corruption('any.unknown_opcode', 'any', 'W102', lambda c: mutate_op(c, (0, 1), opcode=42)),
+    Corruption('any.nan_latency', 'any', 'D302', lambda c: mutate_op(c, (0, 1), latency=nan)),
+    Corruption('any.negative_cost', 'any', 'D302', lambda c: mutate_op(c, (2, -2, 3, -3), cost=-1.0)),
     Corruption('io.out_of_range_output', 'io', 'W105', _corrupt_out_binding),
     Corruption('io.truncated_inp_shifts', 'io', 'W101', _corrupt_inp_shifts),
     Corruption('io.dead_subgraph', 'io', 'D301', _corrupt_outputs_dead),
 )
+
+#: one corruption family per opcode-table row, plus the container-level set
+COMB_CORRUPTIONS: tuple[Corruption, ...] = tuple(
+    Corruption(m.name, spec.family, m.expect_rule, m.apply) for spec in OP_TABLE for m in spec.mutations
+) + _CONTAINER_CORRUPTIONS
 
 PIPELINE_CORRUPTIONS: tuple[Corruption, ...] = (
     Corruption('pipeline.stage_interface', 'pipeline', 'W120', _corrupt_stage_interface),

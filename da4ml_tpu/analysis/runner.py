@@ -30,17 +30,30 @@ class PassFn(Protocol):
     ) -> list[Diagnostic]: ...  # pragma: no cover - typing only
 
 
+def _conformance_pass(comb, stage, skip_ops):
+    from .conformance import conformance_pass
+
+    return conformance_pass(comb, stage, skip_ops)
+
+
 #: name -> pass; order is execution order ('wellformed' must stay first)
 PASSES: dict[str, Callable] = {
     'wellformed': lambda comb, stage, skip_ops: check_wellformed(comb, stage=stage),
     'qinterval': lambda comb, stage, skip_ops: check_intervals(comb, stage=stage, skip_ops=skip_ops),
     'deadcode': lambda comb, stage, skip_ops: check_deadcode(comb, stage=stage, skip_ops=skip_ops),
+    'conformance': _conformance_pass,
 }
+
+#: passes excluded from the default selection (expensive: the conformance
+#: pass compiles and runs the program through every jax execution mode) —
+#: opt in explicitly via ``passes=(..., 'conformance')`` or the CLI's
+#: ``--conformance`` flag
+OPT_IN_PASSES = frozenset({'conformance'})
 
 
 def _resolve_passes(passes) -> list[str]:
     if passes is None:
-        return list(PASSES)
+        return [p for p in PASSES if p not in OPT_IN_PASSES]
     unknown = [p for p in passes if p not in PASSES]
     if unknown:
         raise ValueError(f'unknown analysis pass(es) {unknown}; available: {list(PASSES)}')

@@ -1,64 +1,38 @@
 """Well-formedness pass: structural SSA validity of a DAIS program.
 
 Checks that the program is executable at all — every operand reference names
-an earlier buffer slot (SSA causality), every opcode is in the DAIS v1 table
-(ir/types.py), packed payloads (mux condition/shift, bitwise sub-opcodes,
-lookup table indices) are in range, and the io binding arrays are consistent
-with ``shape``. Runs in O(n_ops); the other passes assume a program that
-passed this one (the runner feeds them the set of structurally-bad ops to
-skip).
+an earlier buffer slot (SSA causality), every opcode is in the DAIS v1 table,
+packed payloads (mux condition/shift, bitwise sub-opcodes, lookup table
+indices) are in range, and the io binding arrays are consistent with
+``shape``. Runs in O(n_ops); the other passes assume a program that passed
+this one (the runner feeds them the set of structurally-bad ops to skip).
+
+Everything opcode-specific here is *generated* from the declarative opcode
+table (``ir/optable.py``): the legal opcode set, which ops read ``id1`` /
+carry a condition slot in ``data``, how payload shifts are extracted, and
+the per-row payload legality checks. A new opcode lands by adding a table
+row — this pass picks it up without edits.
 """
 
 from __future__ import annotations
 
-from ..ir.comb import CombLogic, Pipeline, _i32
-from ..ir.types import Op
+from ..ir.comb import CombLogic, Pipeline
+from ..ir.optable import (
+    BINARY_OPCODES as _BINARY_OPCODES,  # noqa: F401  (re-export for consumers)
+    DAIS_V1_OPCODES,
+    OPCODE_TO_SPEC,
+    SHIFT_LIMIT,
+    op_operands,
+    op_shift,
+)
 from .diagnostics import Diagnostic
-
-#: every opcode of the DAIS v1 table (docs/dais.md)
-DAIS_V1_OPCODES = frozenset((-1, 0, 1, 2, -2, 3, -3, 4, 5, 6, -6, 7, 8, 9, -9, 10))
-
-#: opcodes whose id1 names a second operand slot
-_BINARY_OPCODES = frozenset((0, 1, 6, -6, 7, 10))
-
-#: largest plausible power-of-two shift in an op payload (DAIS values are
-#: fixed-point with at most a few hundred bits; anything beyond is corruption
-#: and would overflow float replay)
-SHIFT_LIMIT = 256
-
-_UNARY_BIT_SUBOPS = (0, 1, 2)  # NOT, OR-reduce, AND-reduce
-_BINARY_BIT_SUBOPS = (0, 1, 2)  # AND, OR, XOR
-
-
-def op_shift(op: Op) -> int | None:
-    """The power-of-two shift an op applies to its second operand, if any."""
-    if op.opcode in (0, 1):
-        return int(op.data)
-    if op.opcode in (6, -6):
-        return _i32(int(op.data) >> 32)
-    if op.opcode == 10:
-        return _i32(int(op.data))
-    return None
-
-
-def op_operands(op: Op) -> list[int]:
-    """Buffer slots an op reads (input lanes of copy ops are *not* slots)."""
-    reads = []
-    if op.opcode == -1 or op.opcode == 5:
-        return reads
-    reads.append(int(op.id0))
-    if op.opcode in _BINARY_OPCODES:
-        reads.append(int(op.id1))
-    if op.opcode in (6, -6):
-        reads.append(int(op.data) & 0xFFFFFFFF)
-    return reads
 
 
 def check_wellformed(comb: CombLogic, stage: int | None = None) -> list[Diagnostic]:
     diags: list[Diagnostic] = []
 
-    def emit(rule: str, message: str, op_index: int | None = None):
-        diags.append(Diagnostic(rule, message, op_index=op_index, stage=stage))
+    def emit(rule: str, message: str, op_index: int | None = None, opcode: int | None = None):
+        diags.append(Diagnostic(rule, message, op_index=op_index, stage=stage, opcode=opcode))
 
     # ---- container-level consistency
     n_in, n_out = (int(v) for v in comb.shape)
@@ -74,40 +48,37 @@ def check_wellformed(comb: CombLogic, stage: int | None = None) -> list[Diagnost
         )
 
     n_ops = len(comb.ops)
-    n_tables = len(comb.lookup_tables) if comb.lookup_tables is not None else 0
+    n_tables = len(comb.lookup_tables) if comb.lookup_tables is not None else None
 
-    # ---- per-op checks
+    # ---- per-op checks (legality data generated from the opcode table)
     for i, op in enumerate(comb.ops):
-        if op.opcode not in DAIS_V1_OPCODES:
-            emit('W102', f'opcode {op.opcode} is not in the DAIS v1 table', i)
+        spec = OPCODE_TO_SPEC.get(op.opcode)
+        if spec is None:
+            emit('W102', f'opcode {op.opcode} is not in the DAIS v1 table', i, opcode=int(op.opcode))
             continue
 
-        if op.opcode == -1:
+        if spec.id0 == 'lane':
             lane = int(op.id0)
             if not 0 <= lane < n_in:
-                emit('W104', f'copy op reads input lane {lane}, program has {n_in} inputs', i)
+                emit('W104', f'copy op reads input lane {lane}, program has {n_in} inputs', i, opcode=op.opcode)
         else:
             for slot in op_operands(op):
                 if not 0 <= slot < i:
-                    which = 'condition' if op.opcode in (6, -6) and slot not in (op.id0, op.id1) else 'operand'
-                    emit('W103', f'{which} slot {slot} is not an earlier SSA slot (op is at slot {i})', i)
+                    which = 'condition' if spec.cond_in_data and slot not in (op.id0, op.id1) else 'operand'
+                    emit(
+                        'W103',
+                        f'{which} slot {slot} is not an earlier SSA slot (op is at slot {i})',
+                        i,
+                        opcode=op.opcode,
+                    )
 
         shift = op_shift(op)
         if shift is not None and abs(shift) > SHIFT_LIMIT:
-            emit('W106', f'shift {shift} exceeds the plausible range +-{SHIFT_LIMIT}', i)
+            emit('W106', f'shift {shift} exceeds the plausible range +-{SHIFT_LIMIT}', i, opcode=op.opcode)
 
-        if op.opcode == 8:
-            tbl = int(op.data)
-            if comb.lookup_tables is None:
-                emit('W110', f'lookup op references table {tbl} but the program carries no tables', i)
-            elif not 0 <= tbl < n_tables:
-                emit('W110', f'lookup op references table {tbl}, program has {n_tables} tables', i)
-        elif op.opcode in (9, -9) and int(op.data) not in _UNARY_BIT_SUBOPS:
-            emit('W111', f'unary bitwise sub-opcode {int(op.data)} (valid: 0=NOT, 1=OR-reduce, 2=AND-reduce)', i)
-        elif op.opcode == 10:
-            subop = (int(op.data) >> 56) & 0xFF
-            if subop not in _BINARY_BIT_SUBOPS:
-                emit('W111', f'binary bitwise sub-opcode {subop} (valid: 0=AND, 1=OR, 2=XOR)', i)
+        if spec.payload_check is not None:
+            for rule, message in spec.payload_check(op, n_tables):
+                emit(rule, message, i, opcode=op.opcode)
 
     # ---- output bindings (out_idx == -1 marks an intentionally dead lane)
     for j, idx in enumerate(comb.out_idxs):

@@ -1,11 +1,18 @@
 from .comb import CombLogic, Pipeline
 from .lut import LookupTable, TableSpec, interpret_as, lsb_loc
+from .optable import DAIS_V1_OPCODES, OP_TABLE, OPCODE_TO_SPEC, OpSpec, family_of, spec_of
 from .schedule import LevelSchedule, levelize, levelize_comb, levelize_program
 from .types import Op, Precision, QInterval, minimal_kif, qint_add, quantize_float, relu_float
 
 __all__ = [
     'CombLogic',
     'Pipeline',
+    'OP_TABLE',
+    'OPCODE_TO_SPEC',
+    'OpSpec',
+    'DAIS_V1_OPCODES',
+    'family_of',
+    'spec_of',
     'LevelSchedule',
     'levelize',
     'levelize_comb',

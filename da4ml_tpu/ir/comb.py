@@ -18,120 +18,20 @@ from typing import NamedTuple, Sequence
 import numpy as np
 from numpy.typing import NDArray
 
-from ..ops.numeric import apply_binary_bit_op, apply_quantize, apply_relu, apply_unary_bit_op
+from ..ops.numeric import apply_quantize
 from .lut import LookupTable
+from .optable import OP_TABLE, i32 as _i32  # noqa: F401  (_i32 re-exported for consumers)
 from .types import Op, QInterval, minimal_kif
 
-
-def _i32(x: int) -> int:
-    """Interpret the low 32 bits of x as a signed int32."""
-    return ((int(x) & 0xFFFFFFFF) + (1 << 31)) % (1 << 32) - (1 << 31)
-
-
 # ---------------------------------------------------------------------------
-# per-opcode replay semantics
-#
-# One handler per opcode family, shared by the numeric (float) and symbolic
-# (tracer-variable) replay paths — the same registry style as the tracer's
-# ``_ENCODERS`` (trace/tracer.py) and the numpy runtime's dispatch, so op
-# semantics live in exactly one place per representation. Handlers receive
-# the program, the op, the value buffer so far, and the scaled inputs, and
-# return the op's value.
+# per-opcode replay semantics, generated from the declarative opcode table
+# (ir/optable.py) — one handler per opcode family, shared by the numeric
+# (float) and symbolic (tracer-variable) replay paths. The table is the
+# single source of truth: runtime kernels, verifier rules and the mutation
+# catalog are generated from the same rows.
 # ---------------------------------------------------------------------------
 
-_REPLAY: dict[int, object] = {}
-
-
-def _replays(*opcodes: int):
-    def register(fn):
-        for oc in opcodes:
-            _REPLAY[oc] = fn
-        return fn
-
-    return register
-
-
-@_replays(-1)
-def _rp_input(comb: 'CombLogic', op: Op, buf: list, inputs: list):
-    return inputs[op.id0]
-
-
-@_replays(0, 1)
-def _rp_shift_add(comb, op, buf, inputs):
-    shifted = buf[op.id1] * 2.0**op.data
-    return buf[op.id0] + shifted if op.opcode == 0 else buf[op.id0] - shifted
-
-
-@_replays(2, -2)
-def _rp_relu(comb, op, buf, inputs):
-    _, i, f = minimal_kif(op.qint)
-    return apply_relu(buf[op.id0], i, f, inv=op.opcode < 0, round_mode='TRN')
-
-
-@_replays(3, -3)
-def _rp_quantize(comb, op, buf, inputs):
-    v = buf[op.id0] if op.opcode > 0 else -buf[op.id0]
-    k, i, f = minimal_kif(op.qint)
-    return apply_quantize(v, k, i, f, round_mode='TRN', force_wrap=True)
-
-
-@_replays(4)
-def _rp_const_add(comb, op, buf, inputs):
-    return buf[op.id0] + op.data * op.qint.step
-
-
-@_replays(5)
-def _rp_const(comb, op, buf, inputs):
-    return op.data * op.qint.step
-
-
-@_replays(6, -6)
-def _rp_msb_mux(comb, op, buf, inputs):
-    cond_slot = op.data & 0xFFFFFFFF
-    shift = _i32(op.data >> 32)
-    key = buf[cond_slot]
-    on_neg = buf[op.id0]
-    on_pos = buf[op.id1] * 2.0**shift
-    if op.opcode < 0:
-        on_pos = -on_pos
-    if hasattr(key, 'msb_mux'):  # symbolic replay
-        return key.msb_mux(on_neg, on_pos, op.qint)
-    q_key = comb.ops[cond_slot].qint
-    if q_key.min < 0:
-        return on_neg if key < 0 else on_pos
-    _, i, _ = minimal_kif(q_key)  # unsigned key: MSB = top magnitude bit
-    return on_neg if key >= 2.0 ** (i - 1) else on_pos
-
-
-@_replays(7)
-def _rp_mul(comb, op, buf, inputs):
-    return buf[op.id0] * buf[op.id1]
-
-
-@_replays(8)
-def _rp_lookup(comb, op, buf, inputs):
-    if comb.lookup_tables is None:
-        raise ValueError('No lookup table for lookup op')
-    return comb.lookup_tables[op.data].lookup(buf[op.id0], comb.ops[op.id0].qint)
-
-
-@_replays(9, -9)
-def _rp_bit_unary(comb, op, buf, inputs):
-    v = buf[op.id0] if op.opcode > 0 else -buf[op.id0]
-    return apply_unary_bit_op(v, op.data, comb.ops[op.id0].qint, op.qint)
-
-
-@_replays(10)
-def _rp_bit_binary(comb, op, buf, inputs):
-    v0 = -buf[op.id0] if (op.data >> 32) & 1 else buf[op.id0]
-    v1 = -buf[op.id1] if (op.data >> 33) & 1 else buf[op.id1]
-    shift = _i32(op.data)
-    subop = (op.data >> 56) & 0xFF
-    s = 2.0**shift
-    q1 = comb.ops[op.id1].qint
-    return apply_binary_bit_op(
-        v0, v1 * s, subop, comb.ops[op.id0].qint, QInterval(q1.min * s, q1.max * s, q1.step * s), op.qint
-    )
+_REPLAY: dict[int, object] = {oc: spec.replay for spec in OP_TABLE for oc in spec.opcodes}
 
 
 class CombLogic(NamedTuple):

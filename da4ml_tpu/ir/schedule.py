@@ -127,7 +127,7 @@ def levelize_comb(comb) -> LevelSchedule:
     """Level schedule of a :class:`~.comb.CombLogic` op list.
 
     The mux condition slot lives in the low half of ``op.data``
-    (comb.py ``_rp_msb_mux``).
+    (optable.py ``_rp_msb_mux``).
     """
     ops = comb.ops
     opcode = np.fromiter((op.opcode for op in ops), dtype=np.int64, count=len(ops))

@@ -19,9 +19,35 @@ from __future__ import annotations
 import numpy as np
 
 from .dais_binary import DaisProgram
+from .optable import OP_TABLE
 
 #: opcode families the generator can emit (keys for the ``families`` arg)
 FAMILIES = ('add', 'relu', 'quant', 'cadd', 'const', 'mux', 'mul', 'lookup', 'bitu', 'bitb')
+
+# coverage audit: every row of the declarative opcode table (ir/optable.py)
+# must name a generator family here (copy ops are implicit — one per input
+# lane of every program). A table row without fuzz coverage would silently
+# exempt its opcode from the conformance corpus, so this fails at import,
+# not in some later CI job.
+_uncovered = [spec.key for spec in OP_TABLE if spec.synth_family is not None and spec.synth_family not in FAMILIES]
+if _uncovered:
+    raise RuntimeError(
+        f'opcode table rows without ir.synth fuzz coverage: {_uncovered}; '
+        f'add a generator family to random_program and list it in FAMILIES'
+    )
+_stale = [f for f in FAMILIES if f not in {spec.synth_family for spec in OP_TABLE}]
+if _stale:
+    raise RuntimeError(f'ir.synth families without an opcode-table row: {_stale}')
+
+
+def opcode_counts(progs) -> dict[int, int]:
+    """Per-opcode op counts over a corpus of :class:`DaisProgram` — the
+    coverage numbers the synth-audit test and the ``--fuzz`` report surface."""
+    counts: dict[int, int] = {oc: 0 for spec in OP_TABLE for oc in spec.opcodes}
+    for prog in progs:
+        for oc in prog.opcode.tolist():
+            counts[int(oc)] = counts.get(int(oc), 0) + 1
+    return counts
 
 
 def _width_for(bound: int, f: int) -> int:

@@ -53,6 +53,7 @@ from numpy.typing import NDArray
 
 from .. import telemetry
 from ..ir.dais_binary import DaisProgram, decode
+from ..ir.optable import VECTOR_CLASS
 from ..ir.schedule import levelize_program
 from ..telemetry.obs import profile as _prof
 
@@ -622,8 +623,9 @@ class DaisExecutor:
         dlo_arr = prog.data_lo.astype(np.int64)
         dhi_arr = prog.data_hi.astype(np.int64)
 
-        branch_of = {-1: 0, 0: 1, 1: 1, 2: 2, -2: 2, 3: 3, -3: 3, 4: 4, 5: 5, 6: 6, -6: 6, 7: 7, 8: 8, 9: 9, -9: 9, 10: 10}
-        branch_arr = np.array([branch_of[int(o)] for o in oc_arr], np.int32)
+        # runtime dispatch class per op, generated from the opcode table —
+        # the scan switch branches and level groups below index by it
+        branch_arr = np.array([VECTOR_CLASS[int(o)] for o in oc_arr], np.int32)
         neg_arr = (oc_arr < 0).astype(np_dt)
         sub_arr = (oc_arr == 1).astype(np_dt)  # subtraction is opcode +1, not a negative opcode
 

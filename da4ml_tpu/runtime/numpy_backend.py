@@ -49,8 +49,12 @@ def _msb(v: NDArray, signed: int, width: int) -> NDArray:
     return v >= (np.int64(1) << (width - 1))
 
 
-def run_program(prog: DaisProgram, data: NDArray[np.float64]) -> NDArray[np.float64]:
-    """Run a decoded DAIS program over a (n_samples, n_in) float batch."""
+def run_program(prog: DaisProgram, data: NDArray[np.float64], return_buf: bool = False):
+    """Run a decoded DAIS program over a (n_samples, n_in) float batch.
+
+    ``return_buf`` additionally returns the (n_ops, n_samples) int64
+    execution buffer (the conformance checker compares it slot-by-slot
+    against the table-generated reference interpreter's)."""
     prog.validate()
     data = np.asarray(data, dtype=np.float64).reshape(len(data), -1)
     if data.shape[1] != prog.n_in:
@@ -163,6 +167,8 @@ def run_program(prog: DaisProgram, data: NDArray[np.float64]) -> NDArray[np.floa
         if prog.out_negs[j]:
             v = -v
         out[:, j] = v.astype(np.float64) * 2.0 ** (int(prog.out_shifts[j]) - int(prog.fractionals[idx]))
+    if return_buf:
+        return out, buf
     return out
 
 

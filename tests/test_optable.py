@@ -1,0 +1,347 @@
+"""The declarative opcode table and everything generated from it.
+
+- table sanity: the rows cover DAIS v1 exactly, with the runtime dispatch
+  classes and synth coverage the consumers expect;
+- the table-generated reference interpreter is bit-exact with the numpy
+  oracle over the synth fuzz corpus;
+- the cross-backend conformance checker passes clean on every runtime mode
+  and catches an injected backend bug with a per-opcode C401 diagnostic;
+- the transfer-soundness fuzz proves every row's QInterval transfer against
+  the concrete replay semantics, and catches an injected transfer bug
+  (D310);
+- satellites: the synth coverage audit (per-opcode corpus counts in the
+  test output), the opcode-dispatch drift lint, the doc-drift check, the
+  diagnostics' stable ``opcode`` field, and the generated mutation catalog
+  (same entries the hand-written PR-2 catalog had — no detection
+  regressions).
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.analysis import (
+    COMB_CORRUPTIONS,
+    OPT_IN_PASSES,
+    PASSES,
+    check_conformance,
+    check_spec_soundness,
+    check_transfer_soundness,
+    run_conformance_corpus,
+    verify,
+)
+from da4ml_tpu.ir import DAIS_V1_OPCODES, OP_TABLE, OPCODE_TO_SPEC
+from da4ml_tpu.ir.optable import COPY_OPCODES, VECTOR_CLASS, spec_of
+from da4ml_tpu.ir.synth import FAMILIES, opcode_counts, random_inputs, random_program
+from da4ml_tpu.runtime import reference
+from da4ml_tpu.runtime.numpy_backend import run_program
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# table sanity
+# ---------------------------------------------------------------------------
+
+
+def test_table_covers_dais_v1_exactly():
+    seen: list[int] = []
+    for spec in OP_TABLE:
+        seen.extend(spec.opcodes)
+    assert len(seen) == len(set(seen)), 'an opcode appears in two table rows'
+    assert set(seen) == set(DAIS_V1_OPCODES)
+    assert set(seen) == {-1, 0, 1, 2, -2, 3, -3, 4, 5, 6, -6, 7, 8, 9, -9, 10}
+    # dispatch classes are per-row and dense (the scan switch indexes by them)
+    classes = [spec.vector_class for spec in OP_TABLE]
+    assert classes == list(range(len(OP_TABLE)))
+    assert all(VECTOR_CLASS[oc] == spec.vector_class for spec in OP_TABLE for oc in spec.opcodes)
+    assert COPY_OPCODES == {-1}
+    assert spec_of(7).family == 'mul' and spec_of(99) is None
+
+
+def test_every_row_is_complete():
+    for spec in OP_TABLE:
+        assert callable(spec.replay) and callable(spec.kernel) and callable(spec.transfer)
+        assert callable(spec.sample)
+        assert spec.mutations, f'{spec.key}: every row must ship a mutation family'
+        assert spec.semantics and spec.payload and spec.cost_model
+        if spec.synth_family is not None:
+            assert spec.synth_family in FAMILIES
+
+
+def test_synth_coverage_audit():
+    """Every table opcode is emitted by the fuzz generator; counts surfaced."""
+    progs = [random_program(np.random.default_rng(100_003 + pi), n_ops=180, n_in=6, n_out=5) for pi in range(4)]
+    counts = opcode_counts(progs)
+    print('\nper-opcode synth corpus counts:')
+    for oc in sorted(counts):
+        print(f'  opcode {oc:>3} [{OPCODE_TO_SPEC[oc].family}]: {counts[oc]}')
+    missing = [oc for oc, n in counts.items() if n == 0]
+    assert not missing, f'table opcodes without synth coverage: {missing}'
+
+
+# ---------------------------------------------------------------------------
+# reference interpreter & conformance
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('seed,wide', [(0, False), (1, False), (2, True)])
+def test_reference_matches_numpy_oracle(seed, wide):
+    rng = np.random.default_rng(seed)
+    prog = random_program(rng, n_ops=220, n_in=6, n_out=5, wide=wide)
+    data = random_inputs(rng, prog, 97)
+    ref, ref_buf = reference.run_program(prog, data, return_buf=True)
+    got, got_buf = run_program(prog, data, return_buf=True)
+    np.testing.assert_array_equal(got, ref)
+    np.testing.assert_array_equal(got_buf, ref_buf)
+
+
+def test_conformance_corpus_all_modes_clean():
+    report, diags = run_conformance_corpus(n_programs=3, n_ops=150, n_samples=48, seed=0)
+    assert report['ok'], [str(d) for d in diags]
+    assert set(report['per_opcode']) == {str(oc) for oc in DAIS_V1_OPCODES}
+    assert all(info['mismatches'] == 0 for info in report['per_opcode'].values())
+    # the report is a JSON-ready artifact
+    json.dumps(report)
+
+
+def test_conformance_coverage_gap_flagged():
+    # an add-only corpus leaves most of the table uncovered -> C402 per gap
+    rng = np.random.default_rng(0)
+    prog = random_program(rng, n_ops=40, n_in=4, n_out=2, families=('add',))
+    import da4ml_tpu.analysis.conformance as conf
+
+    def fake_random_program(*a, **k):
+        return prog
+
+    orig = conf.random_program
+    conf.random_program = fake_random_program
+    try:
+        report, diags = conf.run_conformance_corpus(n_programs=1, n_samples=16, modes=('numpy',))
+    finally:
+        conf.random_program = orig
+    gaps = [d for d in diags if d.rule == 'C402']
+    assert gaps and not report['ok']
+    assert all(d.opcode is not None for d in gaps)
+
+
+def test_conformance_catches_broken_backend(monkeypatch):
+    """An injected numpy-backend bug is a C401 anchored at the divergent op."""
+    from da4ml_tpu.runtime import numpy_backend
+
+    rng = np.random.default_rng(3)
+    prog = random_program(rng, n_ops=120, n_in=5, n_out=4)
+    real = numpy_backend.run_program
+
+    def broken(p, data, return_buf=False):
+        out, buf = real(p, data, return_buf=True)
+        bad = next(i for i in range(p.n_ops) if int(p.opcode[i]) == 7)
+        buf = buf.copy()
+        buf[bad] += 1
+        idx = int(p.out_idxs[0]) if int(p.out_idxs[0]) >= 0 else 0
+        out = out.copy()
+        out[:, 0] = buf[idx] + 1  # force an output divergence too
+        return (out, buf) if return_buf else out
+
+    monkeypatch.setattr(numpy_backend, 'run_program', broken)
+    diags = check_conformance(prog, modes=('numpy',), n_samples=32)
+    assert diags and all(d.rule == 'C401' for d in diags)
+    d = diags[0]
+    assert d.opcode == 7 and d.op_index is not None
+    assert OPCODE_TO_SPEC[d.opcode].family == 'mul'
+    assert d.to_dict()['opcode_family'] == 'mul'
+
+
+def test_conformance_is_opt_in_pass():
+    assert 'conformance' in PASSES and 'conformance' in OPT_IN_PASSES
+    rng = np.random.default_rng(5)
+    prog = random_program(rng, n_ops=60, n_in=4, n_out=3)
+    # a structurally clean program passes the full opt-in selection
+    from da4ml_tpu.analysis.conformance import check_conformance as chk
+
+    assert not chk(prog, modes=('numpy',), n_samples=16)
+
+
+# ---------------------------------------------------------------------------
+# transfer soundness
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_soundness_all_rows_clean():
+    report, diags = check_transfer_soundness(n_cases=20, n_samples=12, seed=1)
+    assert report['ok'], [str(d) for d in diags]
+    assert set(report['per_family']) == {spec.key for spec in OP_TABLE}
+
+
+def test_soundness_catches_broken_transfer(monkeypatch):
+    """A transfer that narrows the add interval is caught as D310."""
+    from da4ml_tpu.ir import optable
+    from da4ml_tpu.ir.types import QInterval
+
+    add_spec = next(s for s in OP_TABLE if s.key == 'add')
+
+    def narrowing_transfer(comb, op, q, operand):
+        c, _ = optable._tf_add(comb, op, q, operand)
+        return QInterval(c.min / 64.0, c.max / 64.0, c.step), []
+
+    broken = add_spec._replace(transfer=narrowing_transfer)
+    monkeypatch.setitem(optable.OPCODE_TO_SPEC, 0, broken)
+    monkeypatch.setitem(optable.OPCODE_TO_SPEC, 1, broken)
+    diags = check_spec_soundness(broken, np.random.default_rng(0), n_cases=10, n_samples=16)
+    assert diags and all(d.rule == 'D310' for d in diags)
+    assert diags[0].opcode in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# satellites: drift lint, doc drift, diagnostics opcode field, mutations
+# ---------------------------------------------------------------------------
+
+
+def test_driftlint_repo_is_clean():
+    from da4ml_tpu.analysis.driftlint import lint_opcodes
+
+    violations, stale = lint_opcodes(REPO_ROOT)
+    assert not violations, [f'{s.path}:{s.lineno} {s.snippet}' for s in violations]
+    assert not stale, f'stale allowlist entries: {stale}'
+
+
+def test_driftlint_catches_new_dispatch_site(tmp_path):
+    from da4ml_tpu.analysis.driftlint import lint_opcodes, scan_file
+
+    pkg = tmp_path / 'da4ml_tpu'
+    pkg.mkdir()
+    evil = pkg / 'evil.py'
+    evil.write_text('def f(op):\n    if op.opcode == 7:\n        return 1\n    return abs(op.opcode) == 6\n')
+    violations, _ = lint_opcodes(tmp_path)
+    assert {v.lineno for v in violations} == {2, 4}
+    assert all(v.path == 'da4ml_tpu/evil.py' for v in violations)
+
+    # pattern coverage: ==, in-tuple, abs() wrap, match; assignments and
+    # table-constant membership are NOT dispatch sites
+    probe = pkg / 'probe.py'
+    probe.write_text(
+        'def f(op, oc, COPY_OPCODES):\n'
+        '    a = oc in (1, 2)\n'
+        '    match op.opcode:\n'
+        '        case 5: pass\n'
+        '    opcode = 5\n'
+        '    b = op.opcode in COPY_OPCODES\n'
+        '    return a, b\n'
+    )
+    sites = scan_file(probe, 'probe.py')
+    assert {s.lineno for s in sites} == {2, 3}
+
+
+def test_cli_lint_opcodes():
+    from da4ml_tpu._cli import main
+
+    assert main(['lint-opcodes', '--root', str(REPO_ROOT)]) == 0
+
+
+def test_generated_docs_in_sync():
+    from da4ml_tpu.analysis.docgen import apply
+
+    drifted = apply(REPO_ROOT, check=True)
+    assert not drifted, f'doc sections drifted from the table: {drifted} (run python -m da4ml_tpu.analysis.docgen)'
+
+
+def test_docgen_detects_drift(tmp_path):
+    from da4ml_tpu.analysis.docgen import apply
+
+    docs = tmp_path / 'docs'
+    docs.mkdir()
+    for rel in ('dais.md', 'analysis.md'):
+        (docs / rel).write_text((REPO_ROOT / 'docs' / rel).read_text())
+    text = (docs / 'dais.md').read_text().replace('| `7` | mul |', '| `7` | HAND-EDITED |')
+    (docs / 'dais.md').write_text(text)
+    assert apply(tmp_path, check=True) == ['docs/dais.md']
+    # non-check mode repairs it
+    assert apply(tmp_path, check=False) == ['docs/dais.md']
+    assert apply(tmp_path, check=True) == []
+
+
+def test_diagnostics_carry_opcode(tmp_path):
+    """verify --json output can be grouped per-opcode downstream."""
+    from da4ml_tpu._cli import main as cli_main
+    from da4ml_tpu.analysis import corruption_by_name
+
+    rng = np.random.default_rng(9)
+    prog_rng = np.random.default_rng(4)
+    del rng
+    # a traced comb with a corrupted mul interval -> Q210 diagnostic
+    from da4ml_tpu.cmvm import solve
+    from da4ml_tpu.ir import QInterval
+
+    kernel = prog_rng.integers(-8, 8, (5, 4)).astype(np.float64)
+    pipe = solve(kernel, qintervals=[QInterval(-8.0, 7.0, 1.0)] * 5)
+    bad = corruption_by_name('add.bad_shift').apply(pipe.stages[0])
+    result = verify(bad)
+    flagged = [d for d in result.diagnostics if d.rule == 'W106']
+    assert flagged and flagged[0].opcode in (0, 1)
+    assert flagged[0].to_dict()['opcode_family'] == 'add/sub'
+    groups = result.by_opcode()
+    assert any(k in (0, 1) for k in groups)
+
+    path = tmp_path / 'bad.json'
+    bad.save(path)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(['verify', str(path), '--json'])
+    assert rc == 1
+    payload = json.loads(buf.getvalue())
+    w106 = [d for d in payload['diagnostics'] if d['rule'] == 'W106']
+    assert w106 and w106[0]['opcode'] in (0, 1) and w106[0]['opcode_family'] == 'add/sub'
+
+
+def test_mutation_catalog_is_generated_without_regressions():
+    """The table-generated catalog carries exactly the entries the
+    hand-written PR-2 catalog had (same names, same expected rules)."""
+    legacy = {
+        'copy.bad_lane': 'W104',
+        'add.forward_ref': 'W103',
+        'add.bad_shift': 'W106',
+        'relu.step_not_pow2': 'Q201',
+        'quantize.inverted_bounds': 'Q202',
+        'cadd.bias_drift': 'Q210',
+        'const.value_drift': 'Q210',
+        'mux.cond_forward': 'W103',
+        'mul.narrowed_interval': 'Q210',
+        'lut.bad_table': 'W110',
+        'bit_unary.bad_subop': 'W111',
+        'bit_binary.bad_subop': 'W111',
+        'any.unknown_opcode': 'W102',
+        'any.nan_latency': 'D302',
+        'any.negative_cost': 'D302',
+        'io.out_of_range_output': 'W105',
+        'io.truncated_inp_shifts': 'W101',
+        'io.dead_subgraph': 'D301',
+    }
+    got = {c.name: c.expect_rule for c in COMB_CORRUPTIONS}
+    assert got == legacy
+    # one mutation family per table row, by construction
+    per_row = {spec.key: [m.name for m in spec.mutations] for spec in OP_TABLE}
+    assert all(per_row[spec.key] for spec in OP_TABLE)
+
+
+def test_cli_verify_fuzz(tmp_path):
+    from da4ml_tpu._cli import main as cli_main
+
+    out = tmp_path / 'report.json'
+    rc = cli_main(['verify', '--fuzz', '2', '--samples', '16', '--modes', 'numpy', '--out', str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report['ok'] and report['conformance']['ok'] and report['transfer_soundness']['ok']
+    assert set(report['conformance']['per_opcode']) == {str(oc) for oc in DAIS_V1_OPCODES}
+    assert report['transfer_soundness']['per_family']['add']['counterexamples'] == 0
+
+
+def test_cli_verify_no_paths_errors(capsys):
+    from da4ml_tpu._cli import main as cli_main
+
+    assert cli_main(['verify']) == 2
+    assert 'fuzz' in capsys.readouterr().out
