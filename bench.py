@@ -508,6 +508,7 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
         # multiplier vs the greedy device solve — the CI quality-gate's
         # committed-corpus twin (ci/quality_gate.py gates the same invariants)
         from da4ml_tpu.cmvm.jax_search import solve_jax_many
+        from da4ml_tpu.telemetry.metrics import metrics_snapshot
 
         k1 = _section_kernels('1_16x16_int4', n1, limited)
         host_sols, _ = _host_solve(k1, host_backend)
@@ -517,10 +518,16 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
         greedy = solve_jax_many(k1)
         greedy_wall = time.perf_counter() - t0
         greedy_costs = np.asarray([s.cost for s in greedy])
+        pre = metrics_snapshot()
         t0 = time.perf_counter()
         beam = solve_jax_many(k1, quality='search')
         beam_wall = time.perf_counter() - t0
+        post = metrics_snapshot()
         beam_costs = np.asarray([s.cost for s in beam])
+
+        def _delta(metric: str) -> int:
+            return int(post.get(metric, {}).get('value', 0) - pre.get(metric, {}).get('value', 0))
+
         return {
             'quality': 'search',
             'n_kernels': len(k1),
@@ -533,6 +540,17 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
             'greedy_wall_s': round(greedy_wall, 2),
             'beam_wall_s': round(beam_wall, 2),
             'wall_multiplier': round(beam_wall / greedy_wall, 2) if greedy_wall > 0 else None,
+            # device-resident beam evidence (docs/benchmarks.md#device-resident):
+            # host<->device traffic of the whole quality solve, on-device
+            # fork/prune activity, and the entry-carry handoffs — A/B against
+            # `--no-device-resident` (host beam + legacy ladder) for the drop
+            'fetch_bytes': _delta('sched.fetch_bytes'),
+            'upload_bytes': _delta('sched.upload_bytes'),
+            'resident_rungs': _delta('sched.device_resident_rungs'),
+            'device_forks': _delta('search.device_forks'),
+            'device_prunes': _delta('search.device_prunes'),
+            'host_seeded_lanes': _delta('search.host_seeded_lanes'),
+            'entry_carry_groups': _delta('sched.entry_carry_groups'),
         }
     if name == 'quality_1000':
         # on-demand (not in the default budget): the reference-scale quality

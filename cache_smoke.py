@@ -50,6 +50,14 @@ def _child() -> None:
     steady = time.perf_counter() - t0
     for k, s in zip(kernels, sols):
         assert np.array_equal(np.asarray(s.kernel, np.float64), k), 'parity violated'
+    # a quality='search' solve walks the device-beam classes on top (fork
+    # step, frontier prune, widened-sel fan-out gathers, full_rec CSE
+    # rungs) — the warm process must be compile-free for those too
+    t0 = time.perf_counter()
+    qsols = solve_jax_many(kernels[:2], quality='search')
+    quality_s = time.perf_counter() - t0
+    for k, s in zip(kernels, qsols):
+        assert np.array_equal(np.asarray(s.kernel, np.float64), k), 'quality parity violated'
 
     snap = metrics_snapshot()
     print(
@@ -58,6 +66,7 @@ def _child() -> None:
                 'cache_dir': cache_dir,
                 'first_s': round(first, 3),
                 'steady_s': round(steady, 3),
+                'quality_s': round(quality_s, 3),
                 'jax_compile_s': round(max(first - steady, 0.0), 3),
                 'buckets': executable_classes(),
                 'jit_compile': int(snap.get('jit.compile', {}).get('value', 0)),
@@ -67,6 +76,9 @@ def _child() -> None:
                 'resident_rungs': int(snap.get('sched.device_resident_rungs', {}).get('value', 0)),
                 'fetch_bytes': int(snap.get('sched.fetch_bytes', {}).get('value', 0)),
                 'upload_bytes': int(snap.get('sched.upload_bytes', {}).get('value', 0)),
+                # device-beam evidence: the quality solve's on-device forks
+                # (its fork/prune/fan-out classes ride the same cache gate)
+                'device_forks': int(snap.get('search.device_forks', {}).get('value', 0)),
                 'metrics': snap,
             }
         )
@@ -131,6 +143,9 @@ def main() -> int:
                 # device-resident transition kernels in play (they are
                 # compile classes too, markered + persisted like the rungs)
                 and runs[1].get('resident_rungs', 0) > 0
+                # ... and with the device-beam fork/prune classes in play
+                # (the quality='search' solve above)
+                and runs[1].get('device_forks', 0) > 0
             ),
         }
         print(json.dumps({k: v for k, v in result.items() if k != 'runs'} | {'runs': [

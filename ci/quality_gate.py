@@ -7,7 +7,10 @@ against the host oracle and gates on the PR's acceptance invariants:
 - zero cost regressions (beam <= oracle on EVERY kernel);
 - at least ``--min-strict-wins`` strict wins (beam < oracle);
 - never worse than the greedy device solve on any kernel;
-- wall-clock <= ``--max-wall-multiplier`` x the greedy device solve.
+- wall-clock <= ``--max-wall-multiplier`` x the greedy device solve;
+- device-resident beam vs the host-beam path (DA4ML_JAX_DEVICE_RESIDENT=0):
+  byte-identical costs on every kernel and ``sched.fetch_bytes`` at least
+  ``--min-fetch-drop`` x lower (docs/cmvm.md#search-strategies).
 
 Writes a JSON report (uploaded as a CI artifact) whose ``quality_beam.*``
 metrics ride the ci/budgets.toml rules through ``da4ml-tpu bench-diff``.
@@ -19,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -45,7 +49,8 @@ def main() -> int:
     ap.add_argument('--corpus', default='ci/quality_corpus.npz')
     ap.add_argument('--out', default=None, help='JSON report path')
     ap.add_argument('--min-strict-wins', type=int, default=1)
-    ap.add_argument('--max-wall-multiplier', type=float, default=4.0)
+    ap.add_argument('--max-wall-multiplier', type=float, default=2.5)
+    ap.add_argument('--min-fetch-drop', type=float, default=3.0, help='resident-beam fetch_bytes must be this factor lower than the host-beam path')
     ap.add_argument('--regen', action='store_true', help='regenerate the committed corpus and exit')
     args = ap.parse_args()
 
@@ -61,14 +66,38 @@ def main() -> int:
 
     host_costs = np.asarray([float(host_api.solve(k, backend='auto').cost) for k in kernels])
 
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot, reset_metrics
+
     solve_jax_many(kernels[:2])  # warm the dominant shape classes off the clock
+    solve_jax_many(kernels[:2], quality='search')  # fork/prune classes too
     t0 = time.perf_counter()
     greedy_costs = np.asarray([float(s.cost) for s in solve_jax_many(kernels)])
     greedy_wall = time.perf_counter() - t0
+    enable_metrics()
+    reset_metrics()
     t0 = time.perf_counter()
     beam_sols = solve_jax_many(kernels, quality='search')
     beam_wall = time.perf_counter() - t0
+    res_snap = metrics_snapshot()
     beam_costs = np.asarray([float(s.cost) for s in beam_sols])
+
+    # the host-beam / legacy-ladder A/B: the resident beam must match its
+    # costs byte-for-byte (CostRanker) at a fraction of the traffic
+    reset_metrics()
+    os.environ['DA4ML_JAX_DEVICE_RESIDENT'] = '0'
+    try:
+        hostbeam_costs = np.asarray([float(s.cost) for s in solve_jax_many(kernels, quality='search')])
+    finally:
+        os.environ.pop('DA4ML_JAX_DEVICE_RESIDENT', None)
+    leg_snap = metrics_snapshot()
+
+    def _m(snap, key):
+        return float(snap.get(key, {}).get('value', 0))
+
+    fetch_res = _m(res_snap, 'sched.fetch_bytes')
+    fetch_leg = _m(leg_snap, 'sched.fetch_bytes')
+    fetch_drop = fetch_leg / fetch_res if fetch_res > 0 else float('inf')
+    resident_mismatch = int((beam_costs != hostbeam_costs).sum())
 
     # exactness first: a cheap wrong answer must fail loudly
     for k, s in zip(kernels, beam_sols):
@@ -92,6 +121,14 @@ def main() -> int:
             'greedy_wall_s': round(greedy_wall, 2),
             'beam_wall_s': round(beam_wall, 2),
             'wall_multiplier': round(mult, 2),
+            # device-resident beam vs host-beam path A/B (the fetch columns
+            # ride ci/budgets.toml through bench-diff)
+            'resident_cost_mismatches': resident_mismatch,
+            'fetch_bytes': int(fetch_res),
+            'fetch_bytes_hostbeam': int(fetch_leg),
+            'fetch_drop': round(fetch_drop, 2) if fetch_drop != float('inf') else None,
+            'device_forks': int(_m(res_snap, 'search.device_forks')),
+            'entry_carry_groups': int(_m(res_snap, 'sched.entry_carry_groups')),
         }
     }
     print(json.dumps(report, indent=1))
@@ -108,10 +145,17 @@ def main() -> int:
         failures.append(f'only {strict_wins} strict wins (< {args.min_strict_wins})')
     if mult > args.max_wall_multiplier:
         failures.append(f'wall multiplier {mult:.2f}x exceeds {args.max_wall_multiplier}x')
+    if resident_mismatch:
+        failures.append(f'{resident_mismatch} kernels cost differently resident vs host-beam (must be byte-identical)')
+    if fetch_drop < args.min_fetch_drop:
+        failures.append(f'resident fetch drop {fetch_drop:.2f}x below the {args.min_fetch_drop}x floor')
     if failures:
         print('QUALITY GATE FAILED:\n  - ' + '\n  - '.join(failures), file=sys.stderr)
         return 1
-    print(f'quality gate OK: {strict_wins}/{len(kernels)} strict wins, 0 regressions, {mult:.2f}x wall')
+    print(
+        f'quality gate OK: {strict_wins}/{len(kernels)} strict wins, 0 regressions, '
+        f'{mult:.2f}x wall, {fetch_drop:.1f}x resident fetch drop'
+    )
     return 0
 
 

@@ -54,6 +54,13 @@ def add_warmup_args(parser) -> None:
         action='store_true',
         help='AOT-precompile the canonical grid but skip the live solve ladder',
     )
+    parser.add_argument(
+        '--quality',
+        default=None,
+        help="Also warm the device-beam classes of this search preset (e.g. 'search'): "
+        'fork/prune/fan-out kernels and the fork lanes’ full-record CSE rungs, so a '
+        "warm quality= solve compiles nothing (default: greedy classes only)",
+    )
     parser.add_argument('--verbose', '-v', action='store_true')
 
 
@@ -88,7 +95,7 @@ def warmup_main(args) -> int:
         # processes classify their first calls as jit.cache_load
         t0 = time.perf_counter()
         n_classes = prewarm_for_kernels(
-            [[k] for k in kernels.values()], full_ladder=True, inline=True
+            [[k] for k in kernels.values()], full_ladder=True, inline=True, quality=getattr(args, 'quality', None)
         )
         dt = time.perf_counter() - t0
         telemetry.histogram('warmup.grid_s').observe(dt)
@@ -99,7 +106,7 @@ def warmup_main(args) -> int:
         for d in dims:
             kern = kernels[d]
             t0 = time.perf_counter()
-            sol = solve_jax_many([kern])[0]
+            sol = solve_jax_many([kern], quality=getattr(args, 'quality', None))[0]
             assert np.array_equal(np.asarray(sol.kernel, np.float64), kern)
             dt = time.perf_counter() - t0
             telemetry.histogram('warmup.compile_s').observe(dt)

@@ -517,6 +517,129 @@ def _topk_scan(vals, k: int):
     return jnp.stack(out_v, -1), jnp.stack(out_c, -1)
 
 
+# ---- shared device ops (greedy CSE loop + beam fork kernel) ---------------
+#
+# The greedy rung program (_build_cse_fn) and the beam fork kernel
+# (_build_fork_fn) must commit byte-identical substitutions for identical
+# decisions, so the pair-application primitives live at module level and both
+# builders close over them with their own shape constants.
+
+
+def _dev_rank_parts(sub, s, i, j, P: int, B: int):
+    """The host scan-order rank of candidate (sub, s, i, j), split into an
+    id-major part and a (sub, shift) minor part (both int32-safe).
+
+    The host heuristics scan the freq map sorted by (id1, id0, sub, shift)
+    ascending and update on ``>=``, so among equal scores the LARGEST key
+    wins (heuristics.py / indexers.cc). id1 = max(i, j), id0 = min(i, j);
+    shift = +s when i < j else -s.
+    """
+    id0 = jnp.minimum(i, j)
+    id1 = jnp.maximum(i, j)
+    shift = jnp.where(i < j, s, -s)
+    major = id1 * P + id0
+    minor = sub * (2 * B + 1) + shift + B
+    return major, minor
+
+
+def _dev_rank_decode(major, minor, P: int, B: int):
+    """Invert :func:`_dev_rank_parts` back to (sub, s, i, j)."""
+    id1, id0 = jnp.divmod(major, P)
+    sub, sk = jnp.divmod(minor, 2 * B + 1)
+    shift = sk - B
+    i = jnp.where(shift >= 0, id0, id1)
+    j = jnp.where(shift >= 0, id1, id0)
+    return sub.astype(jnp.int32), jnp.abs(shift).astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
+
+
+def _dev_argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax, P: int, B: int):
+    """Argmax with ties resolved exactly as the host scan: among maxima,
+    take the largest (id1, id0, sub, shift) key — a three-pass reduce
+    (max score, then max id-major, then max minor)."""
+    m = jnp.max(score)
+    tie = score == m
+    major, minor = _dev_rank_parts(sub_ax, s_ax, i_ax, j_ax, P, B)
+    r1 = jnp.max(jnp.where(tie, major, -1))
+    tie &= major == r1
+    r2 = jnp.max(jnp.where(tie, minor, -1))
+    return m != -jnp.inf, *_dev_rank_decode(r1, r2, P, B)
+
+
+def _dev_substitute(E, sub, s, i, j, O: int, B: int):
+    """Dense substitution of pair (row i bit b) + ±(row j bit b+s).
+
+    Returns (E_updated, new_row [O,B] placed at anchor bits, n_matched).
+    For i == j a sequential scan over bits reproduces the host's
+    ascending-bit greedy chain matching (state_opr.cc:249-280).
+    """
+    b_idx = jnp.arange(B)
+    row_i = E[i]  # [O, B]
+    row_j = E[j]
+    # row_j shifted down by s: val at bit b+s -> position b
+    shifted_j = jnp.where((b_idx[None, :] + s) < B, jnp.take(row_j, jnp.minimum(b_idx + s, B - 1), axis=1), 0)
+    target = jnp.where(sub == 1, -1, 1)
+    sign_ok = (row_i != 0) & (shifted_j != 0) & (row_i * shifted_j == target)
+
+    # i == j: digits can chain (b, b+s, b+2s); greedily match ascending.
+    # B is a small static constant, so the ascending-bit scan is unrolled
+    # in Python rather than written as a fori_loop: nested control flow
+    # (loop-in-loop) inside the vmapped while body is disproportionately
+    # expensive for the TPU backend to compile, and under vmap the
+    # branch-free form costs nothing extra (a batched cond lowers to
+    # both-sides + select anyway).
+    avail = row_i != 0
+    matched = jnp.zeros((O, B), dtype=bool)
+    in_range = b_idx + s < B  # [B] traced per-bit guard
+    for b in range(B):
+        nxt = jnp.minimum(b + s, B - 1)
+        partner = jnp.where(in_range[b], jnp.take(avail, nxt, axis=1), False)
+        ok = sign_ok[:, b] & avail[:, b] & partner
+        avail = avail.at[:, b].set(avail[:, b] & ~ok)
+        cleared = jnp.take(avail, nxt, axis=1) & ~ok
+        avail = avail.at[:, nxt].set(jnp.where(in_range[b], cleared, jnp.take(avail, nxt, axis=1)))
+        matched = matched.at[:, b].set(ok)
+
+    M = jnp.where(i == j, matched, sign_ok)
+
+    # clear matched digits: row i at b, row j at b+s
+    M_up = jnp.zeros((O, B), dtype=bool)
+    M_up = jnp.where((b_idx[None, :] - s >= 0), jnp.take(M, jnp.maximum(b_idx - s, 0), axis=1), M_up)
+    new_row_i = jnp.where(M, 0, row_i)
+    E = E.at[i].set(new_row_i)
+    row_j2 = E[j]  # re-read: if i == j this is already-cleared row
+    E = E.at[j].set(jnp.where(M_up, 0, row_j2))
+
+    # anchor: original id0 = i if i < j (digit at b), else j (digit at b+s).
+    # i == j uses the high-bit anchor (negative-shift convention), matching
+    # the host's same-row pair generation (state.py _row_pairs).
+    anchor_lo = M * row_i  # digits of row i at matched positions
+    anchor_hi = M_up * row_j  # digits of row j at matched positions (bit b+s)
+    new_row = jnp.where(i < j, anchor_lo, anchor_hi).astype(jnp.int8)
+    return E, new_row, M.sum()
+
+
+def _dev_commit_pair(qmeta, lat, sub, s, i, j, adder_size: int, carry_size: int):
+    """Metadata of committing one pair: (new qmeta row [3], new latency,
+    record row [4] int32, adder cost). qint_add(q0, q1, shift, sub0=False,
+    sub1=sub) — f32 for scoring only; the host re-derives op metadata in
+    f64 from the records. Shared by the greedy loop's ``record_decision``
+    and the fork kernel so the two can never diverge."""
+    id0 = jnp.minimum(i, j)
+    id1 = jnp.maximum(i, j)
+    shift = jnp.where(i < j, s, -s)
+    sp = jnp.exp2(shift.astype(jnp.float32))
+    lo0, hi0, st0 = qmeta[id0, 0], qmeta[id0, 1], qmeta[id0, 2]
+    lo1, hi1, st1 = qmeta[id1, 0], qmeta[id1, 1], qmeta[id1, 2]
+    is_sub = sub == 1
+    dlat, dcost = _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, sp, is_sub, adder_size, carry_size)
+    nlat = jnp.maximum(lat[id0], lat[id1]) + dlat
+    min1 = jnp.where(is_sub, -hi1, lo1) * sp
+    max1 = jnp.where(is_sub, -lo1, hi1) * sp
+    qrow = jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)])
+    rec_row = jnp.stack([id0, id1, sub, shift])
+    return qrow, nlat, rec_row, dcost
+
+
 @dataclass(frozen=True)
 class _KernelSpec:
     P: int  # total slots (inputs + max CSE intermediates)
@@ -678,42 +801,10 @@ def _build_cse_fn(spec: _KernelSpec):
         j_ax = jax.lax.broadcasted_iota(jnp.int32, (1, B, P, P), 3)
         return (s_ax > 0) | (i_ax < j_ax)
 
-    def _host_rank_parts(sub, s, i, j):
-        """The host scan-order rank of candidate (sub, s, i, j), split into an
-        id-major part and a (sub, shift) minor part (both int32-safe).
-
-        The host heuristics scan the freq map sorted by (id1, id0, sub,
-        shift) ascending and update on ``>=``, so among equal scores the
-        LARGEST key wins (heuristics.py / indexers.cc). id1 = max(i, j),
-        id0 = min(i, j); shift = +s when i < j else -s.
-        """
-        id0 = jnp.minimum(i, j)
-        id1 = jnp.maximum(i, j)
-        shift = jnp.where(i < j, s, -s)
-        major = id1 * P + id0
-        minor = sub * (2 * B + 1) + shift + B
-        return major, minor
-
-    def _rank_decode(major, minor):
-        """Invert _host_rank_parts back to (sub, s, i, j)."""
-        id1, id0 = jnp.divmod(major, P)
-        sub, sk = jnp.divmod(minor, 2 * B + 1)
-        shift = sk - B
-        i = jnp.where(shift >= 0, id0, id1)
-        j = jnp.where(shift >= 0, id1, id0)
-        return sub.astype(jnp.int32), jnp.abs(shift).astype(jnp.int32), i.astype(jnp.int32), j.astype(jnp.int32)
-
     def _argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax):
-        """Argmax with ties resolved exactly as the host scan: among maxima,
-        take the largest (id1, id0, sub, shift) key — a three-pass reduce
-        (max score, then max id-major, then max minor)."""
-        m = jnp.max(score)
-        tie = score == m
-        major, minor = _host_rank_parts(sub_ax, s_ax, i_ax, j_ax)
-        r1 = jnp.max(jnp.where(tie, major, -1))
-        tie &= major == r1
-        r2 = jnp.max(jnp.where(tie, minor, -1))
-        return m != -jnp.inf, *_rank_decode(r1, r2)
+        """Module-level :func:`_dev_argmax_host_order` with this class's
+        shape constants (host-scan tie order)."""
+        return _dev_argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax, P, B)
 
     def select_pair(Cs, Cd, nov, dlat, method):
         """Masked scoring + host-order argmax over the [2, S, P, P] tensor.
@@ -734,79 +825,18 @@ def _build_cse_fn(spec: _KernelSpec):
     b_idx = jnp.arange(B)
 
     def record_decision(qmeta, lat, op_rec, sub, s, i, j, cur, cur0):
-        """Book-keep one accepted pair: new slot metadata + the op record.
-
-        Shared by both select modes so the emitted records can never diverge
-        for identical decisions. qint_add(q0, q1, shift, sub0=False,
-        sub1=sub) — f32 for scoring only; the host re-derives op metadata in
-        f64 from the records.
-        """
-        id0 = jnp.minimum(i, j)
-        id1 = jnp.maximum(i, j)
-        shift = jnp.where(i < j, s, -s)
-        sp = jnp.exp2(shift.astype(jnp.float32))
-        lo0, hi0, st0 = qmeta[id0, 0], qmeta[id0, 1], qmeta[id0, 2]
-        lo1, hi1, st1 = qmeta[id1, 0], qmeta[id1, 1], qmeta[id1, 2]
-        is_sub = sub == 1
-        dlat, _ = _cost_add_vec(lo0, hi0, st0, lo1, hi1, st1, sp, is_sub, adder_size, carry_size)
-        nlat = jnp.maximum(lat[id0], lat[id1]) + dlat
-        min1 = jnp.where(is_sub, -hi1, lo1) * sp
-        max1 = jnp.where(is_sub, -lo1, hi1) * sp
-        qmeta = qmeta.at[cur].set(jnp.stack([lo0 + min1, hi0 + max1, jnp.minimum(st0, st1 * sp)]))
+        """Book-keep one accepted pair: new slot metadata + the op record
+        (:func:`_dev_commit_pair` — shared with the fork kernel so the
+        emitted records can never diverge for identical decisions)."""
+        qrow, nlat, rec_row, _ = _dev_commit_pair(qmeta, lat, sub, s, i, j, adder_size, carry_size)
+        qmeta = qmeta.at[cur].set(qrow)
         lat = lat.at[cur].set(nlat)
-        op_rec = op_rec.at[cur - cur0].set(jnp.stack([id0, id1, sub, shift]))
+        op_rec = op_rec.at[cur - cur0].set(rec_row)
         return qmeta, lat, op_rec
 
     def substitute(E, sub, s, i, j):
-        """Dense substitution of pair (row i bit b) + ±(row j bit b+s).
-
-        Returns (E_updated, new_row [O,B] placed at anchor bits, n_matched).
-        For i == j a sequential scan over bits reproduces the host's
-        ascending-bit greedy chain matching (state_opr.cc:249-280).
-        """
-        row_i = E[i]  # [O, B]
-        row_j = E[j]
-        # row_j shifted down by s: val at bit b+s -> position b
-        shifted_j = jnp.where((b_idx[None, :] + s) < B, jnp.take(row_j, jnp.minimum(b_idx + s, B - 1), axis=1), 0)
-        target = jnp.where(sub == 1, -1, 1)
-        sign_ok = (row_i != 0) & (shifted_j != 0) & (row_i * shifted_j == target)
-
-        # i == j: digits can chain (b, b+s, b+2s); greedily match ascending.
-        # B is a small static constant, so the ascending-bit scan is unrolled
-        # in Python rather than written as a fori_loop: nested control flow
-        # (loop-in-loop) inside the vmapped while body is disproportionately
-        # expensive for the TPU backend to compile, and under vmap the
-        # branch-free form costs nothing extra (a batched cond lowers to
-        # both-sides + select anyway).
-        avail = row_i != 0
-        matched = jnp.zeros((O, B), dtype=bool)
-        in_range = b_idx + s < B  # [B] traced per-bit guard
-        for b in range(B):
-            nxt = jnp.minimum(b + s, B - 1)
-            partner = jnp.where(in_range[b], jnp.take(avail, nxt, axis=1), False)
-            ok = sign_ok[:, b] & avail[:, b] & partner
-            avail = avail.at[:, b].set(avail[:, b] & ~ok)
-            cleared = jnp.take(avail, nxt, axis=1) & ~ok
-            avail = avail.at[:, nxt].set(jnp.where(in_range[b], cleared, jnp.take(avail, nxt, axis=1)))
-            matched = matched.at[:, b].set(ok)
-
-        M = jnp.where(i == j, matched, sign_ok)
-
-        # clear matched digits: row i at b, row j at b+s
-        M_up = jnp.zeros((O, B), dtype=bool)
-        M_up = jnp.where((b_idx[None, :] - s >= 0), jnp.take(M, jnp.maximum(b_idx - s, 0), axis=1), M_up)
-        new_row_i = jnp.where(M, 0, row_i)
-        E = E.at[i].set(new_row_i)
-        row_j2 = E[j]  # re-read: if i == j this is already-cleared row
-        E = E.at[j].set(jnp.where(M_up, 0, row_j2))
-
-        # anchor: original id0 = i if i < j (digit at b), else j (digit at b+s).
-        # i == j uses the high-bit anchor (negative-shift convention), matching
-        # the host's same-row pair generation (state.py _row_pairs).
-        anchor_lo = M * row_i  # digits of row i at matched positions
-        anchor_hi = M_up * row_j  # digits of row j at matched positions (bit b+s)
-        new_row = jnp.where(i < j, anchor_lo, anchor_hi).astype(jnp.int8)
-        return E, new_row, M.sum()
+        """Module-level :func:`_dev_substitute` with this class's dims."""
+        return _dev_substitute(E, sub, s, i, j, O, B)
 
     # ---- top4 select: an O(S*P) per-iteration score cache -----------------
     #
@@ -1064,6 +1094,14 @@ class LanePrefix:
     E: NDArray
     qmeta: NDArray
     lat: NDArray
+    #: cached dedupe key — the scheduler's lane fan-out and the beam memo
+    #: hash (rec, E) once at construction instead of re-serializing both
+    #: tensors on every scheduling pass
+    key: tuple = None
+
+    def __post_init__(self):
+        if self.key is None:
+            self.key = (self.rec.tobytes(), self.E.tobytes())
 
 
 @dataclass
@@ -1302,6 +1340,603 @@ def _replay_digits(E0: NDArray, rec: NDArray, n_applied: int, n_in_max: int, n_s
     return E
 
 
+# --------------------------------------------------------------------------
+# device-resident beam search: fork, score, and prune inside the rung ladder
+# --------------------------------------------------------------------------
+#
+# The host beam (search/beam.py) explores the first ``depth`` substitutions
+# of each eligible lane with the reference state machinery: candidate
+# enumeration, ranker scoring, and frontier pruning all run in Python, and
+# every surviving trajectory re-uploads its digit tensor as a fresh prefix
+# lane. The device beam below keeps the whole fork generation on device:
+#
+# - **fork** — a frontier lane's still-on-device carry fans out into K beam
+#   slots of the next rung's lane bucket through the SAME widened-``sel``
+#   gather the resident rung chain uses (``_transition_jit``); each beam slot
+#   then applies its rank-th candidate with one fork step
+#   (``_build_fork_fn``: full pair-count einsums + host-scan-order top-K
+#   extraction + the shared ``_dev_substitute``/``_dev_commit_pair``
+#   primitives, so decisions are byte-identical to the greedy rung program's
+#   for identical choices);
+# - **prune** — an on-device ranker kernel (``_build_prune_fn``): the
+#   CostRanker / LearnedRanker features (count, overlap, latency_skew,
+#   depth_remaining, novelty) are extracted from the packed per-child stats,
+#   scored as one einsum against the folded ranker weights, and
+#   ``lax.top_k`` over each source lane's frontier feeds the next rung's
+#   lane bucket — ties resolve by generation order exactly like the host
+#   beam's stable sort;
+# - the host fetches only the per-rung decision records + prune selections
+#   (O(decisions) bytes); surviving prefixes are re-derived by replaying
+#   those decisions through the host state machinery
+#   (``search.beam.replay_fork_prefix`` — byte-identical LanePrefix, f64
+#   metadata), and in two-phase ``focus`` mode the surviving forks' carries
+#   stay on device and enter the CSE rung ladder directly (``entry_carry``).
+#
+# ``DA4ML_JAX_DEVICE_RESIDENT=0`` (and multi-process meshes) restore the
+# host beam — the parity oracle: fork-for-fork byte identity under
+# CostRanker is pinned by tests/test_beam_search.py.
+
+
+@dataclass(frozen=True)
+class _ForkSpec:
+    """Compile class of one beam fork step (single greedy substitution at a
+    caller-chosen candidate rank, plus per-child ranking stats)."""
+
+    P: int  # fork-phase row capacity (root rows + beam depth, pow2)
+    O: int
+    B: int
+    adder_size: int
+    carry_size: int
+    beam: int  # top-K candidates enumerated per frontier state
+
+
+def _fork_fmt(O: int, B: int) -> str:
+    """Packed digit-row format of the fork phase (mirrors ``_pack_digits``)."""
+    if (O * B) % 16 == 0:
+        return 'trit'
+    if (O * B) % 4 == 0:
+        return 'byte'
+    return 'raw'
+
+
+#: int32 word whose 16 trit codes all decode to digit 0 (code 1 per 2 bits)
+_TRIT_ZERO_WORD = np.int32(0x55555555)
+
+
+def _pack_rows_np(E: NDArray, fmt: str) -> NDArray:
+    """Host-side row packing [..., rows, O, B] int8 -> the fork/rung wire
+    format (``_trit_pack_np`` twin of the device ``_pack_digits``)."""
+    rows = E.shape[-3]
+    OB = E.shape[-2] * E.shape[-1]
+    flat = E.reshape(*E.shape[:-3], rows, OB)
+    if fmt == 'trit':
+        return _trit_pack_np(flat)
+    if fmt == 'byte':
+        return np.ascontiguousarray(flat).view(np.int32)
+    return E
+
+
+@lru_cache(maxsize=64)
+def _build_fork_fn(spec: _ForkSpec):
+    """One beam fork step as a vmapped+jitted device function.
+
+    Lane inputs:  E packed [P, W] (fork wire format), qmeta [P, 3] f32,
+                  lat [P] f32, cur [] i32, method [] i32, rank [] i32
+                  (-1 = dead beam slot), cost_in [] f32 (accumulated DAIS
+                  cost of the trajectory so far).
+    Lane outputs: packed E', qmeta', lat' (the child carry — stays on
+                  device), rec [4] i32 (the committed decision), and a
+                  ranking-stat vector [8] f32:
+                  (count, n_overlap, latency_skew, d_cost, tail_estimate,
+                  cost_out, took, valid).
+
+    Candidate enumeration materializes the full [2, S, P, P] pair counts
+    (P here is the *fork-phase* capacity — root rows + depth — so the
+    quadratic tensors stay small) and extracts the global top-``beam``
+    candidates in exact host scan order: iterated
+    :func:`_dev_argmax_host_order` with the already-taken candidate masked
+    out, so rank r is precisely ``heuristics.top_candidates(...)[r]``.
+    ``tail_estimate`` counts the residual adder-tree emissions per output
+    column (search/ranker.py ``tail_estimate``); all stat values are
+    integer-valued in practice and therefore exact in f32.
+    """
+    P, O, B, K = spec.P, spec.O, spec.B, spec.beam
+    adder_size, carry_size = spec.adder_size, spec.carry_size
+    fmt = _fork_fmt(O, B)
+    _ED = _einsum_dtype()
+
+    def unpack(Ep):
+        if fmt == 'trit':
+            w = jax.lax.bitcast_convert_type(Ep, jnp.uint32)
+            code = (w[..., None] >> (2 * jnp.arange(16, dtype=jnp.uint32))) & 3
+            return (code.astype(jnp.int8) - 1).reshape(P, O, B)
+        if fmt == 'byte':
+            return jax.lax.bitcast_convert_type(Ep, jnp.int8).reshape(P, O, B)
+        return Ep
+
+    def pack(E):
+        if fmt == 'trit':
+            code = (E.astype(jnp.int32) + 1).reshape(P, (O * B) // 16, 16)
+            return (code << (2 * jnp.arange(16, dtype=jnp.int32))).sum(-1).astype(jnp.int32)
+        if fmt == 'byte':
+            return jax.lax.bitcast_convert_type(E.reshape(P, (O * B) // 4, 4), jnp.int32)
+        return E
+
+    def lane_fork(Ep, qmeta, lat, cur, meth, rank, cost_in):
+        E = unpack(Ep)
+        Ef = E.astype(_ED)
+        # full pair counts (pair_counts twin): C_same/C_diff [S=B, P, P]
+        pad = jnp.pad(Ef, ((0, 0), (0, 0), (0, B)))
+        idx2 = jnp.arange(B)[:, None] + jnp.arange(B)[None, :]
+        sh = pad[:, :, idx2]  # [P, O, S, B]
+        A = jnp.einsum('iob,josb->sij', Ef, sh, preferred_element_type=jnp.float32)
+        D = jnp.einsum('iob,josb->sij', jnp.abs(Ef), jnp.abs(sh), preferred_element_type=jnp.float32)
+        C = jnp.stack([(D + A) * 0.5, (D - A) * 0.5])  # [2, S, P, P] f32
+        lo, hi, st = qmeta[:, 0], qmeta[:, 1], qmeta[:, 2]
+        nov = _overlap_vec(lo[:, None], hi[:, None], st[:, None], lo[None, :], hi[None, :], st[None, :])
+        dlt = jnp.abs(lat[:, None] - lat[None, :])
+
+        shp = (2, B, P, P)
+        sub_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+        s_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+        i_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
+        j_ax = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
+        pair_ok = (s_ax > 0) | (i_ax < j_ax)
+        score = _score_cand(C, nov[None, None], dlt[None, None], meth, pair_ok)
+
+        zero = jnp.int32(0)
+        found = jnp.bool_(False)
+        any0 = jnp.bool_(False)
+        sub = s = i = j = zero
+        cnt_sel = nov_sel = dl_sel = jnp.float32(0.0)
+        for k in range(K):
+            ok_k, sub_k, s_k, i_k, j_k = _dev_argmax_host_order(score, sub_ax, s_ax, i_ax, j_ax, P, B)
+            if k == 0:
+                any0 = ok_k
+            take = (rank == k) & ok_k
+            found = found | take
+            sub = jnp.where(take, sub_k, sub)
+            s = jnp.where(take, s_k, s)
+            i = jnp.where(take, i_k, i)
+            j = jnp.where(take, j_k, j)
+            cnt_sel = jnp.where(take, C[sub_k, s_k, i_k, j_k], cnt_sel)
+            nov_sel = jnp.where(take, nov[i_k, j_k], nov_sel)
+            dl_sel = jnp.where(take, dlt[i_k, j_k], dl_sel)
+            if k + 1 < K:
+                hit = (sub_ax == sub_k) & (s_ax == s_k) & (i_ax == i_k) & (j_ax == j_k)
+                score = jnp.where(hit & ok_k, -jnp.inf, score)
+
+        def do_apply(args):
+            E0, q0, l0 = args
+            E2, new_row, _ = _dev_substitute(E0, sub, s, i, j, O, B)
+            E2 = E2.at[cur].set(new_row)
+            qrow, nlat, rec_row, dcost = _dev_commit_pair(q0, l0, sub, s, i, j, adder_size, carry_size)
+            return E2, q0.at[cur].set(qrow), l0.at[cur].set(nlat), rec_row, dcost
+
+        def no_apply(args):
+            E0, q0, l0 = args
+            return E0, q0, l0, jnp.zeros((4,), jnp.int32), jnp.float32(0.0)
+
+        E2, q2, l2, rec_row, dcost = jax.lax.cond(found, do_apply, no_apply, (E, qmeta, lat))
+
+        alive = rank >= 0
+        took = found & alive
+        # a frontier state with no candidate at all is carried through the
+        # pruning unchanged (rank 0 only) — the host beam's drained branch
+        valid = alive & (took | ((rank == 0) & ~any0))
+        # residual adder-tree tail (search/ranker.py tail_estimate): per
+        # output column, (terms - 1) tree adds over all surviving digits
+        terms = (E2 != 0).sum(axis=(0, 2)).astype(jnp.float32)  # [O]
+        tail = jnp.maximum(terms - 1.0, 0.0).sum()
+        fcnt = jnp.where(took, cnt_sel, 0.0)
+        # feature conventions follow heuristics._score: mc-family reports no
+        # overlap weight, plain mc no latency skew either
+        fnov = jnp.where(took & (meth >= 3), nov_sel, 0.0)
+        fdlt = jnp.where(took & (meth != 0), dl_sel, 0.0)
+        cost_out = cost_in + jnp.where(took, dcost, 0.0)
+        stats = jnp.stack(
+            [
+                fcnt,
+                fnov,
+                fdlt,
+                jnp.where(took, dcost, 0.0),
+                tail,
+                cost_out,
+                took.astype(jnp.float32),
+                valid.astype(jnp.float32),
+            ]
+        )
+        return pack(E2), q2, l2, cur + took.astype(jnp.int32), rec_row, stats
+
+    donate = (0, 1, 2) if _donate_ok() else ()
+    return jax.jit(jax.vmap(lane_fork), donate_argnums=donate)
+
+
+@lru_cache(maxsize=64)
+def _build_prune_fn(C: int, K: int, kind: str):
+    """On-device frontier pruning for one fork generation.
+
+    Vmapped over source lanes: per lane, ``C`` children (frontier x rank,
+    generation order = slot order) are scored — ``kind='cost'`` is the exact
+    DAIS CostRanker ``-(cost_so_far + tail)``, ``kind='learned'`` one einsum
+    of the five ranker features against the folded LearnedRanker weights —
+    and ``lax.top_k`` keeps the best ``K``. ``top_k`` breaks ties by first
+    position, which is generation order: exactly the host beam's stable
+    ``sorted(key=(-score, order))``. Novelty is derived here (it needs the
+    sibling decisions): 1/(1 + times this exact pair was already taken
+    earlier in generation order). Returns the kept child indices, -1 for
+    empty slots.
+    """
+
+    def prune(stats, rec, depth_rem, w, b):
+        cnt, novf, dlt = stats[:, 0], stats[:, 1], stats[:, 2]
+        tail, cost = stats[:, 4], stats[:, 5]
+        took = stats[:, 6] > 0.5
+        valid = stats[:, 7] > 0.5
+        same = (rec[:, None, :] == rec[None, :, :]).all(-1)  # [C, C]
+        prior = jnp.arange(C)[None, :] < jnp.arange(C)[:, None]
+        seen = (same & prior & took[None, :]).sum(-1).astype(jnp.float32)
+        novelty = jnp.where(took, 1.0 / (1.0 + seen), 0.0)
+        if kind == 'cost':
+            score = -(cost + tail)
+        else:
+            feats = jnp.stack([cnt, novf, dlt, jnp.broadcast_to(depth_rem, cnt.shape), novelty], -1)
+            score = -(feats @ w + b)
+        score = jnp.where(valid, score, -jnp.inf)
+        v, idx = jax.lax.top_k(score, K)
+        return jnp.where(v == -jnp.inf, -1, idx.astype(jnp.int32))
+
+    return jax.jit(jax.vmap(prune, in_axes=(0, 0, None, None, None)))
+
+
+_SEED_JITS: dict[tuple, object] = {}
+
+
+def _fork_seed_jit(fmt: str, rows_from: int, P_to: int):
+    """Row-adapting seed gather: fan parked base-batch root carries (rows =
+    the base group's trimmed R_in) out into the fork phase's row capacity.
+    One gather + row pad, jitted per (format, rows, capacity) class."""
+    key = (fmt, rows_from, P_to)
+    fn = _SEED_JITS.get(key)
+    if fn is None:
+        pad_rows = P_to - rows_from
+
+        def seed(Ep, q, l, sel):
+            idx = jnp.maximum(sel, 0)
+            gE = jnp.take(Ep, idx, axis=0)
+            gq = jnp.take(q, idx, axis=0)
+            gl = jnp.take(l, idx, axis=0)
+            if pad_rows:
+                if fmt == 'trit':
+                    padE = jnp.full((gE.shape[0], pad_rows, gE.shape[2]), _TRIT_ZERO_WORD, jnp.int32)
+                elif fmt == 'byte':
+                    padE = jnp.zeros((gE.shape[0], pad_rows, gE.shape[2]), jnp.int32)
+                else:
+                    padE = jnp.zeros((gE.shape[0], pad_rows) + gE.shape[2:], jnp.int8)
+                gE = jnp.concatenate([gE, padE], axis=1)
+                pad_q = jnp.tile(jnp.asarray([0.0, 0.0, 1.0], jnp.float32), (gq.shape[0], pad_rows, 1))
+                gq = jnp.concatenate([gq, pad_q], axis=1)
+                gl = jnp.concatenate([gl, jnp.zeros((gl.shape[0], pad_rows), jnp.float32)], axis=1)
+            return gE, gq, gl
+
+        fn = jax.jit(seed)
+        _SEED_JITS[key] = fn
+    return fn
+
+
+def _device_beam_ok() -> bool:
+    """Whether the device-resident beam may run: the resident ladder must be
+    enabled and the carry locally addressable (single process). A
+    multi-process mesh forces the host beam path, noted once."""
+    if not _device_resident_enabled():
+        return False
+    try:
+        multi = jax.process_count() > 1
+    except Exception:
+        multi = False
+    if multi:
+        telemetry.warn_once(
+            'search.host_beam_multiproc',
+            'multi-process mesh: beam fork generation runs the host beam path '
+            '(the device-resident fork needs a locally addressable carry)',
+        )
+        return False
+    return True
+
+
+def _learned_fold(ranker):
+    """LearnedRanker.folded() cast for the device prune einsum (f32)."""
+    w, b = ranker.folded()
+    return np.asarray(w, np.float32), np.float32(b)
+
+
+def _device_beam_expand(lanes: list, spec, adder_size: int, carry_size: int, park: dict | None = None):
+    """Beam-expand eligible stage-0 lanes with the fork/score/prune loop on
+    device (the resident twin of ``search.beam.expand_beam_lanes``).
+
+    Returns ``(forks, entry_carry)``: ``forks`` is the host-beam contract
+    ``[(lane_index, fork_lane, trace_meta), ...]`` (fork-for-fork identical
+    to the host beam under CostRanker — the fuzz tests pin this), and
+    ``entry_carry`` maps each fork's position to ``(carrier, slot)`` so a
+    two-phase caller can hand the surviving forks' still-on-device carries
+    straight into ``solve_single_lanes`` without re-uploading prefixes.
+    """
+    from .search.beam import replay_fork_prefix
+    from .search.ranker import get_ranker
+
+    ranker = get_ranker(spec.ranker)
+    kind = 'cost' if getattr(ranker, 'name', '') == 'cost' else 'learned'
+    if kind == 'learned':
+        w_eff, b_eff = _learned_fold(ranker)
+    else:
+        w_eff, b_eff = np.zeros(5, np.float32), np.float32(0.0)
+
+    K, depth = int(spec.beam), int(spec.depth)
+    # unique eligible source lanes (the host-beam memo key), order preserved
+    uniq: dict[tuple, int] = {}
+    lane_rep: list[int] = []
+    key_of: list = [None] * len(lanes)
+    for idx, lane in enumerate(lanes):
+        if lane.method == 'dummy':
+            continue
+        if lane.csd is None:
+            _prepare_lane(lane)
+        key = (
+            lane.kernel.tobytes(),
+            lane.kernel.shape,
+            lane.method,
+            tuple(lane.qintervals),
+            tuple(lane.latencies),
+            None if lane.perm is None else lane.perm.tobytes(),
+        )
+        key_of[idx] = key
+        if key not in uniq:
+            uniq[key] = len(lane_rep)
+            lane_rep.append(idx)
+    if not lane_rep:
+        return [], {}
+    ensure_compile_cache()
+
+    groups: dict[tuple[int, int], list[int]] = {}
+    for g, idx in enumerate(lane_rep):
+        ln = lanes[idx]
+        gk = (_canon_dim(ln.csd.shape[1], 8), _canon_dim(ln.csd.shape[2], 2))
+        groups.setdefault(gk, []).append(g)
+
+    #: per unique lane: [(LanePrefix, meta, carrier, slot), ...]
+    by_uniq: dict[int, list[tuple]] = {}
+    n_forks_dev = n_prunes = 0
+
+    for (O, B), gs in sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True):
+        G = len(gs)
+        n_in_max = _next_pow2(max(lanes[lane_rep[g]].csd.shape[0] for g in gs))
+        P_f = _next_pow2(n_in_max + depth)
+        fmt = _fork_fmt(O, B)
+        fspec = _ForkSpec(P_f, O, B, adder_size, carry_size, spec.beam)
+        G_b = _bucket_lanes(G, None)
+        mcode_g = np.asarray([_METHOD_CODES[lanes[lane_rep[g]].method] for g in gs], np.int32)
+        ni_g = [lanes[lane_rep[g]].csd.shape[0] for g in gs]
+
+        # --- roots: fan out of the parked base-batch carry, else upload ---
+        src_outs = None
+        ent = park.get((O, B)) if park else None
+        if (
+            ent is not None
+            and ent['fmt'] == fmt
+            and ent['rows'] <= P_f  # the base group's trimmed rows must fit the fork capacity
+            and all(id(lanes[lane_rep[g]]) in ent['pos'] for g in gs)
+        ):
+            sel0 = np.zeros((G_b,), np.int32)
+            for x, g in enumerate(gs):
+                sel0[x] = ent['pos'][id(lanes[lane_rep[g]])]
+            seed = _fork_seed_jit(fmt, ent['rows'], P_f)
+            src_outs = seed(ent['E'], ent['q'], ent['l'], jnp.asarray(sel0))
+            telemetry.counter('search.root_park_hits').inc(G)
+            telemetry.counter('sched.upload_bytes').inc(int(sel0.nbytes))
+        if src_outs is None:
+            rE = np.zeros((G_b, P_f, O, B), np.int8)
+            rq = np.zeros((G_b, P_f, 3), np.float32)
+            rq[:, :, 2] = 1.0
+            rl = np.zeros((G_b, P_f), np.float32)
+            for x, g in enumerate(gs):
+                ln = lanes[lane_rep[g]]
+                ni, no, nb = ln.csd.shape
+                rE[x, :ni, :no, :nb] = ln.csd
+                for i2 in range(ni):
+                    sf = 2.0 ** float(ln.shift0[i2])
+                    qi = ln.qintervals[ln.slot(i2)]
+                    lo, hi, stp = qi.min * sf, qi.max * sf, qi.step * sf
+                    if not all(np.isfinite(v) and abs(v) < 3e38 for v in (lo, hi, stp)):
+                        lo, hi, stp = 0.0, 0.0, 1.0
+                    rq[x, i2] = (lo, hi, stp)
+                    rl[x, i2] = ln.latencies[ln.slot(i2)]
+            rE_send = _pack_rows_np(rE, fmt)
+            telemetry.counter('sched.upload_bytes').inc(int(rE_send.nbytes + rq.nbytes + rl.nbytes))
+            src_outs = (jnp.asarray(rE_send), jnp.asarray(rq), jnp.asarray(rl))
+            src_pos = {(x, 0): x for x in range(G)}
+        else:
+            src_pos = {(x, 0): x for x in range(G)}
+
+        # frontier bookkeeping (host): per (g, f) slot — alive, cur, cost,
+        # and the committed decision log [(rec, rung, seen, rank), ...]
+        frontier: list[list[dict | None]] = [
+            [{'cur': n_in_max, 'cost': 0.0, 'log': []}] + [None] * (K - 1) for _ in range(G)
+        ]
+        F = 1
+        for t in range(depth):
+            C = F * K
+            bucket = G_b * C
+            sel = np.zeros((bucket,), np.int32)
+            rank = np.full((bucket,), -1, np.int32)
+            cur = np.full((bucket,), P_f, np.int32)
+            meth = np.zeros((bucket,), np.int32)
+            cost = np.zeros((bucket,), np.float32)
+            for x in range(G):
+                for f in range(F):
+                    fr = frontier[x][f]
+                    for k in range(K):
+                        c = x * C + f * K + k
+                        if fr is not None:
+                            sel[c] = src_pos[(x, f)]
+                            rank[c] = k
+                            cur[c] = fr['cur']
+                            meth[c] = mcode_g[x]
+                            cost[c] = fr['cost']
+            telemetry.counter('sched.upload_bytes').inc(int(sel.nbytes + rank.nbytes + cur.nbytes + meth.nbytes + cost.nbytes))
+
+            # fork = widened-sel fan-out of the surviving carries
+            t0 = time.perf_counter()
+            oE, oq, ol = src_outs[0], src_outs[1], src_outs[2]
+            t_cls = _trans_cls(oE.shape, oE.dtype, bucket, False)
+            with _prof.annotate('cmvm.fork.fanout'):
+                gE, gq, gl = _transition_jit(None)(oE, oq, ol, jnp.asarray(sel))
+            if t_cls not in _SEEN_CLASSES:
+                _SEEN_CLASSES.add(t_cls)
+                try:
+                    jax.block_until_ready(gE)
+                except Exception:
+                    pass
+                _record_first_call(t_cls, time.perf_counter() - t0)
+
+            fork_fn = _build_fork_fn(fspec)
+            f_cls = ('fork', fspec, bucket)
+            t0 = time.perf_counter()
+            with _prof.annotate('cmvm.fork.step'):
+                Ep2, q2, l2, cur2, rec_d, stats_d = fork_fn(
+                    gE, gq, gl, jnp.asarray(cur), jnp.asarray(meth), jnp.asarray(rank), jnp.asarray(cost)
+                )
+            if f_cls not in _SEEN_CLASSES:
+                _SEEN_CLASSES.add(f_cls)
+                try:
+                    jax.block_until_ready(Ep2)
+                except Exception:
+                    pass
+                _record_first_call(f_cls, time.perf_counter() - t0)
+
+            prune_fn = _build_prune_fn(C, K, kind)
+            p_cls = ('prune', C, K, kind, G_b)
+            t0 = time.perf_counter()
+            with _prof.annotate('cmvm.fork.prune'):
+                sel_k = prune_fn(
+                    stats_d.reshape(G_b, C, 8),
+                    rec_d.reshape(G_b, C, 4),
+                    jnp.float32(depth - t),
+                    jnp.asarray(w_eff),
+                    b_eff,
+                )
+            if p_cls not in _SEEN_CLASSES:
+                _SEEN_CLASSES.add(p_cls)
+                try:
+                    jax.block_until_ready(sel_k)
+                except Exception:
+                    pass
+                _record_first_call(p_cls, time.perf_counter() - t0)
+
+            # the host sees only the decisions: records, stats, selections
+            with _prof.annotate('cmvm.fork.fetch'):
+                h_rec, h_stats, h_sel = _fetch_local((rec_d, stats_d, sel_k))
+            h_rec, h_stats, h_sel = np.asarray(h_rec), np.asarray(h_stats), np.asarray(h_sel)
+            telemetry.counter('sched.fetch_bytes').inc(int(h_rec.nbytes + h_stats.nbytes + h_sel.nbytes))
+
+            new_frontier: list[list[dict | None]] = []
+            new_pos: dict[tuple, int] = {}
+            for x in range(G):
+                # reconstruct the host beam's `taken` dict: how many prior
+                # children (generation order) committed the same exact pair
+                seen_of = np.zeros((C,), np.int64)
+                taken: dict[bytes, int] = {}
+                for c0 in range(C):
+                    c = x * C + c0
+                    if h_stats[c, 6] > 0.5:  # took
+                        kk = h_rec[c].tobytes()
+                        seen_of[c0] = taken.get(kk, 0)
+                        taken[kk] = seen_of[c0] + 1
+                n_valid = int((h_stats[x * C : (x + 1) * C, 7] > 0.5).sum())
+                row: list[dict | None] = []
+                kept = 0
+                for f2 in range(K):
+                    c0 = int(h_sel[x, f2])
+                    if c0 < 0:
+                        row.append(None)
+                        continue
+                    kept += 1
+                    c = x * C + c0
+                    parent = frontier[x][c0 // K]
+                    entry = {'cur': int(cur[c]), 'cost': float(cost[c]), 'log': list(parent['log'])}
+                    if h_stats[c, 6] > 0.5:
+                        entry['cur'] += 1
+                        entry['cost'] += float(h_stats[c, 3])
+                        entry['log'].append((h_rec[c].copy(), t, int(seen_of[c0]), c0 % K))
+                        n_forks_dev += 1
+                    row.append(entry)
+                    new_pos[(x, f2)] = c
+                n_prunes += max(n_valid - kept, 0)
+                new_frontier.append(row)
+            frontier = new_frontier
+            src_pos = new_pos
+            src_outs = (Ep2, q2, l2)
+            F = K
+
+        carrier = {'outs': src_outs, 'P': P_f, 'n_in_max': n_in_max, 'OB': (O, B)}
+        for x, g in enumerate(gs):
+            idx = lane_rep[g]
+            ln = lanes[idx]
+            ni = ni_g[x]
+            shift_dn = n_in_max - ni
+            out_g: list[tuple] = []
+            for f2 in range(K):
+                fr = frontier[x][f2]
+                if fr is None or not fr['log']:
+                    continue  # dead slot or no decision committed
+                steps = []
+                for rec, t, seen, rk in fr['log']:
+                    r = rec.astype(np.int64)
+                    id0 = r[0] - shift_dn if r[0] >= n_in_max else r[0]
+                    id1 = r[1] - shift_dn if r[1] >= n_in_max else r[1]
+                    steps.append(((int(id0), int(id1), int(r[2]), int(r[3])), t, seen, rk))
+                pfx, meta = replay_fork_prefix(ln, steps, depth, adder_size, carry_size)
+                out_g.append((pfx, meta, carrier, src_pos[(x, f2)]))
+            by_uniq[g] = out_g
+
+    # reassemble in the host beam's lane-major order, duplicates sharing
+    # their representative's expansion (and carry slots) byte-for-byte
+    out: list[tuple] = []
+    entry_carry: dict[int, tuple] = {}
+    for idx, lane in enumerate(lanes):
+        key = key_of[idx]
+        if key is None:
+            continue
+        for pfx, meta, carrier, slot in by_uniq.get(uniq[key], []):
+            entry_carry[len(out)] = (carrier, slot)
+            out.append((idx, _Lane(lane.kernel, lane.qintervals, lane.latencies, lane.method, perm=lane.perm, prefix=pfx), meta))
+    telemetry.counter('search.lanes_expanded').inc(len(lane_rep))
+    telemetry.counter('search.fork_lanes').inc(len(out))
+    telemetry.counter('search.device_forks').inc(n_forks_dev)
+    telemetry.counter('search.device_prunes').inc(n_prunes)
+    telemetry.counter('search.frontier_culled').inc(n_prunes)
+    return out, entry_carry
+
+
+def _fetch_local(tree):
+    """Single-process device->host fetch (the fork phase never runs under a
+    multi-process mesh — ``_device_beam_ok`` gates that)."""
+    return jax.device_get(tree)
+
+
+def _expand_forks(lanes_sub: list, spec, adder_size: int, carry_size: int, park: dict | None = None):
+    """Beam expansion dispatcher: the device-resident fork/score/prune loop
+    when the resident ladder is available, the host beam (parity oracle,
+    ``DA4ML_JAX_DEVICE_RESIDENT=0`` / multi-process meshes) otherwise.
+    Returns ``(forks, entry_carry)`` — the host path has no carry."""
+    if _device_beam_ok():
+        with telemetry.span('cmvm.jax.fork', n_lanes=len(lanes_sub), beam=spec.beam, depth=spec.depth):
+            return _device_beam_expand(lanes_sub, spec, adder_size, carry_size, park=park)
+    from .search.beam import expand_beam_lanes
+
+    with telemetry.span('cmvm.search.expand', n_lanes=len(lanes_sub), beam=spec.beam, depth=spec.depth):
+        return expand_beam_lanes(lanes_sub, spec, adder_size, carry_size), {}
+
+
 def solve_single_lanes(
     lanes: list[_Lane],
     adder_size: int,
@@ -1309,6 +1944,8 @@ def solve_single_lanes(
     mesh=None,
     step: int | None = None,
     raw: bool = False,
+    entry_carry: dict | None = None,
+    park_roots: dict | None = None,
 ) -> list[CombLogic]:
     """Solve a batch of independent CMVM instances on device, emit on host.
 
@@ -1336,6 +1973,14 @@ def solve_single_lanes(
 
     ``mesh=None`` resolves via ``_auto_mesh`` (all local devices on a
     multi-device TPU backend; ``DA4ML_JAX_MESH`` overrides).
+
+    ``entry_carry`` (device-beam handoff): lane index -> ``(carrier, slot)``
+    pairs whose still-on-device fork-phase carry enters the rung ladder
+    directly — a covered group skips the host-side prefix upload entirely
+    and starts resident at rung 0. ``park_roots`` (two-phase beam): a dict
+    the first rung of every group parks its uploaded root carry into
+    (keyed ``(O, B)``), so a later fork phase fans out of the resident
+    base-batch carry instead of re-uploading roots.
     """
     with telemetry.span('cmvm.jax.csd', n_lanes=len(lanes)):
         for lane in lanes:
@@ -1361,7 +2006,8 @@ def solve_single_lanes(
             tuple(ln.latencies),
             None if ln.perm is None else ln.perm.tobytes(),
             # beam forks of one lane differ only in their decision prefix
-            None if ln.prefix is None else (ln.prefix.rec.tobytes(), ln.prefix.E.tobytes()),
+            # (LanePrefix.key is hashed once at construction)
+            None if ln.prefix is None else ln.prefix.key,
         )
         if key in _uniq:
             dup_of[k] = _uniq[key]
@@ -1552,6 +2198,22 @@ def solve_single_lanes(
             #: still-on-device carry of the previous rung's single chunk:
             #: {'outs': rung outputs, 'pos': lane idx -> chunk slot, 'P': P}
             dev_carry: dict | None = None
+            if entry_carry and resident_on:
+                # device-beam handoff: when one fork-phase carrier covers
+                # every active lane of this group at matching slot geometry,
+                # the group enters the ladder resident — rung 0 gathers the
+                # surviving forks' carries instead of re-uploading prefixes
+                ents = [entry_carry.get(k) for k in active]
+                car = ents[0][0] if (ents and ents[0] is not None) else None
+                if (
+                    car is not None
+                    and all(e is not None and e[0] is car for e in ents)
+                    and car['n_in_max'] == n_in_max
+                    and car['OB'] == (O, B)
+                ):
+                    dev_carry = {'outs': car['outs'], 'pos': {a: ents[a][1] for a in range(n_act)}, 'P': car['P']}
+                    telemetry.counter('sched.entry_carry_groups').inc()
+            first_rung = True
 
             def _spill_carry(to_host: bool = True) -> None:
                 """Fetch the device-resident carry back into host lane state
@@ -1905,6 +2567,29 @@ def solve_single_lanes(
                         args = tuple(
                             jax.device_put(v, sh) if sh is not None else jnp.asarray(v) for v in (cE_send, cq, cl, cc, cm)
                         )
+                        if first_rung and has_prefix:
+                            # prefix lanes seeded by host upload (the device
+                            # beam's entry carry bypasses this path)
+                            n_pfx = sum(1 for a in chunk if lanes[active[a]].prefix is not None)
+                            if n_pfx:
+                                telemetry.counter('search.host_seeded_lanes').inc(n_pfx)
+                        if park_roots is not None and first_rung and single_chunk and sh is None:
+                            # park the root carry for a later device-beam
+                            # fork phase (copies where donation would
+                            # invalidate the dispatched args)
+                            pE, pq, pl = args[0], args[1], args[2]
+                            if _rung_donate(spec):
+                                pE, pq, pl = jnp.copy(pE), jnp.copy(pq), jnp.copy(pl)
+                            rows_h2 = rows_in if rows_in < P else P
+                            fmtp = 'raw' if cE_send.dtype == np.int8 else _fork_fmt(O, B)
+                            park_roots[(O, B)] = {
+                                'E': pE,
+                                'q': pq,
+                                'l': pl,
+                                'rows': rows_h2,
+                                'fmt': fmtp,
+                                'pos': {id(lanes[active[a]]): x for x, a in enumerate(chunk)},
+                            }
                     run = fn if sh is not None else _class_runner(spec, bucket, fn, args)
                     t0 = time.perf_counter() if _timed else 0.0
                     try:
@@ -1927,6 +2612,7 @@ def solve_single_lanes(
                 while inflight:
                     _drain(inflight.pop(0))
                 pend = next_pend
+                first_rung = False
 
             emit_jobs: list[tuple[int, NDArray, NDArray, NDArray]] = []  # (lane idx, E_lane, rec, shift0)
             for a, k in enumerate(active):
@@ -2209,22 +2895,26 @@ def _first_rung_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh
     return out
 
 
-def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None) -> list[tuple]:
+def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None, prefix_depth: int = 0) -> list[tuple]:
     """Every (spec, bucket) rung of every canonical bucket these lanes walk
     — the full-ladder extension of :func:`_first_rung_specs`, mirroring the
     live rung loop's resume policy (geometric ``_ladder_P``, resume buckets
     shrink to the lanes whose slot demand outgrows a rung). Used by the
-    warmup CLI to AOT-precompile a whole grid without running solves."""
+    warmup CLI to AOT-precompile a whole grid without running solves.
+    ``prefix_depth > 0`` mirrors beam-fork lanes instead: the ladder starts
+    ``prefix_depth`` committed decisions in and every rung class carries
+    full-capacity op records (``full_rec``)."""
     active = [ln for ln in lanes if ln.method != 'dummy']
     for ln in active:
         if ln.csd is None:
             _prepare_lane(ln)
     pmax = _pmax()
-    active = [ln for ln in active if _lane_demand(ln) <= pmax]
+    active = [ln for ln in active if _lane_demand(ln) + prefix_depth <= pmax]
     if not active:
         return []
     if mesh is None:
         mesh = _auto_mesh()
+    full_rec = prefix_depth > 0
     groups: dict[tuple[int, int], list[_Lane]] = {}
     for ln in active:
         gk = (_canon_dim(ln.csd.shape[1], 8), _canon_dim(ln.csd.shape[2], 2))
@@ -2232,18 +2922,19 @@ def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=Non
     out: list[tuple] = []
     for (O, B), grp in sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True):
         n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in grp))
-        demands = [_lane_demand(ln) for ln in grp]
-        cur = n_in_max
+        demands = [_lane_demand(ln) + prefix_depth for ln in grp]
+        cur0 = n_in_max + prefix_depth
+        cur = cur0
         while True:
             P = _ladder_P(cur, None)
             if P > pmax:
                 if cur >= pmax:
                     break
                 P = pmax
-            pending = [d for d in demands if d > cur] if cur > n_in_max else demands
+            pending = [d for d in demands if d > cur] if cur > cur0 else demands
             if not pending:
                 break
-            spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(cur))
+            spec = _resolve_rung_class(P, O, B, adder_size, carry_size, _select(), pmax, _next_pow2(cur), full_rec=full_rec)
             out.append((spec, _bucket_lanes(len(pending), mesh)))
             if P >= max(demands) or P >= pmax:
                 break
@@ -2251,22 +2942,127 @@ def _ladder_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=Non
     return out
 
 
-def _transition_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None) -> list[tuple]:
+def _transition_specs(lanes: list[_Lane], adder_size: int, carry_size: int, mesh=None, prefix_depth: int = 0) -> list[tuple]:
     """Every (rung class, bucket_from, bucket_to) transition hop of the
     device-resident ladder these lanes walk — the companion of
     :func:`_ladder_specs` for the rung-transition kernels, so ``warmup
     --grid`` also precompiles the hops between rungs. Consecutive entries
     of each group's ladder walk pair up: the hop's input is the earlier
     rung's packed output at its lane bucket, its ``sel`` axis the later
-    rung's (shrunken) bucket."""
+    rung's (shrunken) bucket. ``prefix_depth`` mirrors the beam-fork
+    ladder (see :func:`_ladder_specs`)."""
     pairs: list[tuple] = []
     by_group: dict[tuple, list[tuple]] = {}
-    for spec, bucket in _ladder_specs(lanes, adder_size, carry_size, mesh):
+    for spec, bucket in _ladder_specs(lanes, adder_size, carry_size, mesh, prefix_depth=prefix_depth):
         by_group.setdefault((spec.O, spec.B), []).append((spec, bucket))
     for rungs in by_group.values():
         for (spec_a, bucket_a), (_spec_b, bucket_b) in zip(rungs, rungs[1:]):
             pairs.append((spec_a, bucket_a, bucket_b))
     return pairs
+
+
+def _beam_specs(lanes: list[_Lane], spec, adder_size: int, carry_size: int) -> list[tuple]:
+    """Every device compile class of the beam fork phase these lanes walk —
+    the :func:`_ladder_specs` companion for ``quality=`` solves, consumed
+    by the warmup CLI and the in-solve prewarm so a warm ``quality=
+    'search'`` process meets zero in-line compiles.
+
+    Returns tagged tuples: ``('fork', _ForkSpec, bucket)`` for the fork
+    step, ``('prune', C, K, kind, G_b)`` for the ranker kernel, and
+    ``('trans', rung_like_spec, bucket_from, bucket_to)`` for the
+    widened-``sel`` fan-out gathers (the fork transitions ride the same
+    ``_transition_jit`` executables as the rung chain). The caller applies
+    any ``focus`` subsetting before calling; a drifted estimate wastes one
+    background compile and can never change results.
+    """
+    eligible: list[_Lane] = []
+    seen_keys: set = set()
+    for ln in lanes:
+        if ln.method == 'dummy':
+            continue
+        if ln.csd is None:
+            _prepare_lane(ln)
+        key = (ln.kernel.tobytes(), ln.kernel.shape, ln.method, None if ln.perm is None else ln.perm.tobytes())
+        if key in seen_keys:
+            continue
+        seen_keys.add(key)
+        eligible.append(ln)
+    if not eligible or not getattr(spec, 'forks', False):
+        return []
+    K, depth = int(spec.beam), int(spec.depth)
+    kind = 'cost' if spec.ranker == 'cost' else 'learned'
+    groups: dict[tuple[int, int], list[_Lane]] = {}
+    for ln in eligible:
+        gk = (_canon_dim(ln.csd.shape[1], 8), _canon_dim(ln.csd.shape[2], 2))
+        groups.setdefault(gk, []).append(ln)
+    out: list[tuple] = []
+    for (O, B), grp in sorted(groups.items(), key=lambda it: (it[0][0] * it[0][1] ** 2, it[0]), reverse=True):
+        n_in_max = _next_pow2(max(ln.csd.shape[0] for ln in grp))
+        P_f = _next_pow2(n_in_max + depth)
+        fspec = _ForkSpec(P_f, O, B, adder_size, carry_size, K)
+        G_b = _bucket_lanes(len(grp), None)
+        shape_like = _KernelSpec(P_f, O, B, adder_size, carry_size)  # rows/dims carrier for _packed_E_struct
+        bucket_prev = G_b
+        for t in range(depth):
+            C = (1 if t == 0 else K) * K
+            bucket = G_b * C
+            out.append(('trans', shape_like, bucket_prev, bucket))
+            out.append(('fork', fspec, bucket))
+            out.append(('prune', C, K, kind, G_b))
+            bucket_prev = bucket
+    return out
+
+
+def _prewarm_fork(fspec: _ForkSpec, bucket: int) -> None:
+    """AOT-compile one beam fork-step class (lower + compile, no execution;
+    idempotent, failures swallowed — see :func:`_prewarm_class`)."""
+    key = ('fork', fspec, bucket)
+    if key in _PREWARMED:
+        return
+    _PREWARMED.add(key)
+    try:
+        ensure_compile_cache()
+        fn = _build_fork_fn(fspec)
+        E = _packed_E_struct(bucket, fspec.P, fspec.O, fspec.B)
+        q = jax.ShapeDtypeStruct((bucket, fspec.P, 3), jnp.float32)
+        lat = jax.ShapeDtypeStruct((bucket, fspec.P), jnp.float32)
+        i32 = jax.ShapeDtypeStruct((bucket,), jnp.int32)
+        f32 = jax.ShapeDtypeStruct((bucket,), jnp.float32)
+        fn.lower(E, q, lat, i32, i32, i32, f32).compile()
+        _classify_first_call(key)
+    except Exception:
+        pass
+
+
+def _prewarm_prune(C: int, K: int, kind: str, G_b: int) -> None:
+    """AOT-compile one on-device frontier-prune class (idempotent)."""
+    key = ('prune', C, K, kind, G_b)
+    if key in _PREWARMED:
+        return
+    _PREWARMED.add(key)
+    try:
+        ensure_compile_cache()
+        fn = _build_prune_fn(C, K, kind)
+        stats = jax.ShapeDtypeStruct((G_b, C, 8), jnp.float32)
+        rec = jax.ShapeDtypeStruct((G_b, C, 4), jnp.int32)
+        dr = jax.ShapeDtypeStruct((), jnp.float32)
+        w = jax.ShapeDtypeStruct((5,), jnp.float32)
+        b = jax.ShapeDtypeStruct((), jnp.float32)
+        fn.lower(stats, rec, dr, w, b).compile()
+        _classify_first_call(key)
+    except Exception:
+        pass
+
+
+def _prewarm_beam_entry(entry: tuple) -> None:
+    """Dispatch one :func:`_beam_specs` entry to its prewarmer."""
+    tag = entry[0]
+    if tag == 'fork':
+        _prewarm_fork(entry[1], entry[2])
+    elif tag == 'prune':
+        _prewarm_prune(entry[1], entry[2], entry[3], entry[4])
+    elif tag == 'trans':
+        _prewarm_transition(entry[1], entry[2], entry[3])
 
 
 def prewarm_for_kernels(
@@ -2283,6 +3079,7 @@ def prewarm_for_kernels(
     mesh=None,
     full_ladder: bool = False,
     inline: bool = False,
+    quality=None,
     **_ignored,
 ) -> int:
     """Model-level background prewarm: AOT-compile every device shape class a
@@ -2310,9 +3107,22 @@ def prewarm_for_kernels(
     job synchronously on the caller's thread (bypassing the platform gate —
     an explicit warmup is user intent) and returns the number of classes
     compiled. The warmup CLI uses both to populate the persistent cache.
+
+    ``quality`` (a preset name / SearchSpec / dict) additionally enumerates
+    the device-beam classes a ``quality=`` solve walks: the fork-step and
+    frontier-prune kernels, the widened-``sel`` fan-out transitions, and
+    the fork lanes' full-capacity-record CSE ladder (``_beam_specs``) — so
+    a warm ``quality='search'`` process compiles nothing.
     """
     if not inline and not _prewarm_enabled():
         return 0
+    qspec = None
+    if quality is not None:
+        from .search.spec import resolve_quality
+
+        qspec = resolve_quality(quality)
+        if not qspec.forks:
+            qspec = None
     groups = [[np.ascontiguousarray(np.asarray(k, np.float64)) for k in g] for g in kernel_groups if g]
     groups = [g for g in groups if all(k.ndim == 2 and k.size for k in g)]
     if not groups:
@@ -2347,6 +3157,7 @@ def prewarm_for_kernels(
                 splits_u = [kernel_decompose(kernels[mi], dc) for mi, dc in uniq_md]
             lanes0: list[_Lane] = []
             lanes1: list[_Lane] = []
+            lanes0_mi: list[int] = []
             def _probe(mat, meth, dc):
                 return _Lane(
                     mat,
@@ -2366,6 +3177,7 @@ def prewarm_for_kernels(
                 # toward the lane bucket.
                 copies = n_restarts if p0.method != 'dummy' else 1
                 lanes0.extend([p0] * copies)
+                lanes0_mi.extend([mi] * copies)
                 lanes1.extend([p1] * copies)
             _estimate = _ladder_specs if full_ladder else _first_rung_specs
             for lanes in (lanes0, lanes1):
@@ -2378,6 +3190,40 @@ def prewarm_for_kernels(
                     # the rung-transition hops between those classes, too —
                     # a warm resident chain must meet zero in-line compiles
                     for hop in _transition_specs(lanes, adder_size, carry_size, mesh):
+                        tkey = ('transition', *hop)
+                        if tkey not in warmed:
+                            warmed.add(tkey)
+                            _prewarm_transition(*hop)
+            if qspec is not None:
+                # the device-beam classes of a quality= solve over this
+                # group: fork/prune/fan-out of the fork phase plus the fork
+                # lanes' full-capacity-record CSE ladder. Under focus > 0
+                # only each matrix's focus cheapest trajectories fork; which
+                # ones win is cost-dependent, so the estimate takes the
+                # first focus probes per matrix (same class dims — a drift
+                # wastes one background compile, never changes results).
+                if qspec.focus > 0:
+                    cnt: dict[int, int] = {}
+                    beam_probe = []
+                    for ln, mi in zip(lanes0, lanes0_mi):
+                        if ln.method == 'dummy' or cnt.get(mi, 0) >= qspec.focus:
+                            continue
+                        cnt[mi] = cnt.get(mi, 0) + 1
+                        beam_probe.append(ln)
+                else:
+                    beam_probe = [ln for ln in lanes0 if ln.method != 'dummy']
+                for ent in _beam_specs(beam_probe, qspec, adder_size, carry_size):
+                    if ent not in warmed:
+                        warmed.add(ent)
+                        _prewarm_beam_entry(ent)
+                fork_probe = [ln for ln in beam_probe for _ in range(max(1, int(qspec.beam)))]
+                for got in _ladder_specs(fork_probe, adder_size, carry_size, mesh, prefix_depth=qspec.depth):
+                    key = (got[0], got[1])
+                    if key not in warmed:
+                        warmed.add(key)
+                        _prewarm_class(*got)
+                if _device_resident_enabled():
+                    for hop in _transition_specs(fork_probe, adder_size, carry_size, mesh, prefix_depth=qspec.depth):
                         tkey = ('transition', *hop)
                         if tkey not in warmed:
                             warmed.add(tkey)
@@ -2724,11 +3570,12 @@ def _solve_jax_many_impl(
     slot_ids = [0] * len(jobs)
     fork_meta: list = [None] * len(jobs)
     two_phase = spec is not None and spec.forks and spec.focus > 0
+    #: root-carry park for the two-phase device beam: the base batch's first
+    #: rung stashes its uploaded roots here so the fork phase fans out of
+    #: the resident carry instead of re-uploading (None = not applicable)
+    _park: dict | None = {} if (two_phase and _device_beam_ok()) else None
     if spec is not None and spec.forks and not two_phase:
-        from .search.beam import expand_beam_lanes
-
-        with telemetry.span('cmvm.search.expand', n_lanes=len(lanes0), beam=spec.beam, depth=spec.depth):
-            forks = expand_beam_lanes(lanes0, spec, adder_size, carry_size)
+        forks, _ = _expand_forks(lanes0, spec, adder_size, carry_size)
         for slot, (ji, fln, meta) in enumerate(forks, start=1):
             lanes0.append(fln)
             exp_refs.append(ji)
@@ -2753,8 +3600,32 @@ def _solve_jax_many_impl(
                 _prewarm_class(*got)
 
         _prewarm_submit(_warm_stage1)
+    if _prewarm_enabled() and spec is not None and spec.forks and _device_beam_ok():
+        # the fork phase's device classes (fork step, prune, fan-out
+        # gathers) and the fork lanes' full_rec CSE rungs compile in the
+        # background while the base batch occupies the device. Fresh probe
+        # objects: _prepare_lane mutates, and the live lanes are being
+        # prepared concurrently by the solve itself.
+        cnt_mi: dict[int, int] = {}
+        beam_probe: list[_Lane] = []
+        for (mi, dc, mp, r), ln in zip(jobs, lanes0):
+            if ln.method == 'dummy':
+                continue
+            if two_phase and spec.focus > 0 and cnt_mi.get(mi, 0) >= spec.focus:
+                continue
+            cnt_mi[mi] = cnt_mi.get(mi, 0) + 1
+            beam_probe.append(_Lane(ln.kernel, ln.qintervals, ln.latencies, ln.method, perm=ln.perm))
+
+        def _warm_beam(probe=beam_probe, qspec=spec):
+            for ent in _beam_specs(probe, qspec, adder_size, carry_size):
+                _prewarm_beam_entry(ent)
+            fork_probe = [ln for ln in probe for _ in range(max(1, int(qspec.beam)))]
+            for got in _ladder_specs(fork_probe, adder_size, carry_size, mesh, prefix_depth=qspec.depth):
+                _prewarm_class(*got)
+
+        _prewarm_submit(_warm_beam)
     with telemetry.span('cmvm.jax.stage0', n_lanes=len(lanes0)):
-        sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True)
+        sols0 = solve_single_lanes(lanes0, adder_size, carry_size, mesh=mesh, raw=True, park_roots=_park)
 
     # stage-1 lanes fed by stage-0 outputs (shifted qints: api.stage_feed);
     # every beam fork carries its own stage-1 solve, since its stage-0
@@ -2771,8 +3642,6 @@ def _solve_jax_many_impl(
         # matrix's spec.focus cheapest base trajectories are known — fork
         # only those (beam slots where the base sweep says they matter) and
         # run the forks as one second pair of device batches
-        from .search.beam import expand_beam_lanes
-
         base_totals_x = [float(s0.cost) + float(s1.cost) for s0, s1 in zip(sols0, sols1)]
         per_m: dict[int, list[tuple[float, int]]] = {}
         for x, (mi, _dc, _mp, _r) in enumerate(jobs):
@@ -2784,8 +3653,7 @@ def _solve_jax_many_impl(
             focus_idx.extend(x for _, x in ranked[: spec.focus])
         focus_idx.sort()
         sub = [lanes0[x] for x in focus_idx]
-        with telemetry.span('cmvm.search.expand', n_lanes=len(sub), beam=spec.beam, depth=spec.depth):
-            forks = expand_beam_lanes(sub, spec, adder_size, carry_size)
+        forks, ecarry = _expand_forks(sub, spec, adder_size, carry_size, park=_park)
         if forks:
             fork_lanes: list[_Lane] = []
             for slot, (si, fln, meta) in enumerate(forks, start=1):
@@ -2795,7 +3663,7 @@ def _solve_jax_many_impl(
                 slot_ids.append(slot)
                 fork_meta.append(meta)
             with telemetry.span('cmvm.jax.stage0', n_lanes=len(fork_lanes)):
-                sols0_f = solve_single_lanes(fork_lanes, adder_size, carry_size, mesh=mesh, raw=True)
+                sols0_f = solve_single_lanes(fork_lanes, adder_size, carry_size, mesh=mesh, raw=True, entry_carry=ecarry)
             lanes1_f: list[_Lane] = []
             for ji, s0f in zip(exp_refs[len(jobs) :], sols0_f):
                 _mi, dcf, mpf, _rf = jobs[ji]

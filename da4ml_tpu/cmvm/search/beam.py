@@ -116,6 +116,39 @@ def _expand_one(lane, spec: SearchSpec, ranker, adder_size: int, carry_size: int
     return out
 
 
+def replay_fork_prefix(lane, steps: list[tuple], depth: int, adder_size: int, carry_size: int):
+    """Reconstruct a device-forked trajectory's ``LanePrefix`` + trace meta
+    from its fetched decision records.
+
+    ``steps`` is ``[((id0, id1, sub, shift), rung, seen, rank), ...]`` in
+    lane slot space. Each decision replays through the exact host state
+    machinery (``create_state``/``update_state``, f64 metadata), and the
+    trace features are re-derived from the pre-commit state with the same
+    ``heuristics._score`` conventions the host beam records — so the
+    resulting prefix and meta are byte-identical to what
+    :func:`expand_beam_lanes` would have produced for the same decisions.
+    The device fetches only the decisions; this replay is the O(decisions)
+    host-side completion of the fork.
+    """
+    from ..heuristics import _score
+    from ..state import Pair
+
+    mat = np.ascontiguousarray(lane.kernel if lane.perm is None else lane.kernel[lane.perm], dtype=np.float64)
+    ni = mat.shape[0]
+    qints = [lane.qintervals[lane.slot(i)] for i in range(ni)]
+    lats = [float(lane.latencies[lane.slot(i)]) for i in range(ni)]
+    st = create_state(mat, qints, lats)
+    meta: list[dict] = []
+    for (id0, id1, sub, shift), t, seen, rank in steps:
+        pair = Pair(int(id0), int(id1), bool(sub), int(shift))
+        c = st.freq_stat.get(pair, 0)
+        _sc, n_ov, dlat = _score(st, pair, c, lane.method)
+        feats = candidate_features(c, n_ov, dlat, depth - t, 1.0 / (1.0 + seen))
+        meta.append({'features': [float(v) for v in feats], 'chosen': rank == 0, 'step': t})
+        update_state(st, pair, adder_size, carry_size)
+    return _prefix_from_state(st, ni), meta
+
+
 def expand_beam_lanes(lanes, spec: SearchSpec, adder_size: int, carry_size: int) -> list[tuple]:
     """Beam-expand every eligible stage-0 lane of a device batch.
 

@@ -109,6 +109,16 @@ class LearnedRanker:
         Xn = (X - self.mean) / np.where(self.std > 0, self.std, 1.0)
         return Xn @ self.weights + self.bias
 
+    def folded(self) -> 'tuple[np.ndarray, float]':
+        """The probe with its normalization folded in: ``(w, b)`` such that
+        ``predict(X) == X @ w + b`` — the form the device prune kernel
+        scores as one einsum (jax_search ``_build_prune_fn``; the device
+        evaluates it in f32, so tie-region fork choices may diverge from
+        the host's f64 — the documented LearnedRanker contract)."""
+        std = np.where(self.std > 0, self.std, 1.0)
+        w = self.weights / std
+        return w, float(self.bias) - float(self.mean @ w)
+
     def scores(self, children: 'list[_Child]') -> np.ndarray:
         if not children:
             return np.zeros(0)

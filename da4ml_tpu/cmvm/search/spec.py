@@ -120,10 +120,11 @@ class SearchSpec:
 
 
 #: the quality= presets; 'fast' is the byte-identical default path.
-#: 'search' is the bounded-wall mode (focused two-phase forking keeps it a
-#: small multiple of the greedy wall on a CPU mesh — the CI quality gate
-#: enforces <= 4x); 'max' forks every axis everywhere and is for hardware
-#: with real idle capacity.
+#: 'search' is the bounded-wall mode: focused two-phase forking plus the
+#: device-resident fork/score/prune loop (docs/cmvm.md#device-resident-beam)
+#: keep it a small multiple of the greedy wall — ~1.3x measured on the CPU
+#: mesh, CI quality gate enforces <= 2.5x; 'max' forks every axis
+#: everywhere and is for hardware with real idle capacity.
 QUALITY_PRESETS: dict[str, SearchSpec] = {
     'fast': SearchSpec(),
     'search': SearchSpec(beam=5, depth=1, focus=3, include_host=True),

@@ -248,6 +248,159 @@ def test_expand_beam_lanes_prefix_contract(rng):
 
 
 # ---------------------------------------------------------------------------
+# device-resident beam: fork/score/prune on device vs the host-beam oracle
+# ---------------------------------------------------------------------------
+
+
+def _fork_key(fln):
+    return fln.prefix.key
+
+
+def test_device_beam_fork_parity_fuzz(rng):
+    """The device fork phase (_device_beam_expand) is fork-for-fork
+    byte-identical to the host beam under CostRanker: same source lanes,
+    same frontier order, same prefixes (rec/E/qmeta/lat) and trace meta —
+    across methods, beam/depth shapes, grid-edge dims, restart perms, and
+    heterogeneous qintervals."""
+    from da4ml_tpu.cmvm.jax_search import _Lane, _device_beam_expand
+    from da4ml_tpu.cmvm.search.beam import expand_beam_lanes
+
+    def lane(kern, method, perm=None, qints=None, lats=None):
+        n = kern.shape[0]
+        return _Lane(
+            kern,
+            qints or [QInterval(-128.0, 127.0, 1.0)] * n,
+            lats or [0.0] * n,
+            method,
+            perm=perm,
+        )
+
+    het_q = [QInterval(-(2.0**e), 2.0**e - 2.0**-2, 2.0**-2) for e in range(2, 8)]
+    lanes = [
+        lane(random_kernel(rng, 6, 4), 'wmc'),
+        lane(random_kernel(rng, 7, 3, 5), 'mc'),
+        lane(random_kernel(rng, 9, 4, 5), 'wmc-dc'),
+        lane(random_kernel(rng, 12, 5, 12), 'wmc'),  # pow2-edge dims
+        lane(random_kernel(rng, 6, 4), 'wmc', perm=rng.permutation(6)),
+        lane(random_kernel(rng, 6, 3), 'mc-pdc', qints=list(het_q), lats=[float(i % 3) for i in range(6)]),
+    ]
+    lanes.append(lanes[0])  # duplicate: must share its expansion
+    for beam, depth in ((3, 2), (5, 1), (2, 3)):
+        spec = SearchSpec(beam=beam, depth=depth)
+        host = expand_beam_lanes(
+            [_Lane(l.kernel, l.qintervals, l.latencies, l.method, perm=l.perm) for l in lanes], spec, -1, -1
+        )
+        dev, ecarry = _device_beam_expand(lanes, spec, -1, -1)
+        assert len(host) == len(dev), (beam, depth, len(host), len(dev))
+        assert set(ecarry) == set(range(len(dev)))
+        for (hi, hl, hm), (di, dl, dm) in zip(host, dev):
+            assert hi == di
+            assert hl.prefix.key == dl.prefix.key
+            assert hl.prefix.qmeta.tobytes() == dl.prefix.qmeta.tobytes()
+            assert hl.prefix.lat.tobytes() == dl.prefix.lat.tobytes()
+            assert hm == dm
+
+
+def test_device_beam_full_solve_parity_focus_modes(rng):
+    """quality= solves are byte-identical between the resident beam and the
+    host-beam path across focus modes (single-phase focus=0, two-phase
+    focus>0) and beam/depth shapes."""
+    import os
+
+    kernels = [random_kernel(rng, 10, 4), random_kernel(rng, 8, 3, 12)]
+    for quality in ('search', {'beam': 3, 'depth': 2, 'focus': 0}, {'beam': 4, 'depth': 1, 'focus': 2}):
+        resident = solve_jax_many(kernels, quality=quality)
+        os.environ['DA4ML_JAX_DEVICE_RESIDENT'] = '0'
+        try:
+            hostbeam = solve_jax_many(kernels, quality=quality)
+        finally:
+            os.environ.pop('DA4ML_JAX_DEVICE_RESIDENT', None)
+        for a, b in zip(resident, hostbeam):
+            assert_pipelines_identical(a, b)
+
+
+def test_device_beam_learned_ranker_never_worse(rng, tmp_path):
+    """Under a LearnedRanker the device prune scores in f32 (the host beam
+    in f64), so fork choices may diverge in ties — the contract is
+    exactness plus never-worse-than-greedy, and determinism across runs."""
+    from da4ml_tpu.cmvm.search.ranker import FEATURE_NAMES
+    from da4ml_tpu.cmvm.search.train import train_ranker
+
+    prng = np.random.default_rng(5)
+    X = prng.normal(size=(64, len(FEATURE_NAMES)))
+    y = X @ np.asarray([1.0, -0.5, 0.2, 0.0, 0.3]) + 0.1 * prng.normal(size=64)
+    path = tmp_path / 'ranker.json'
+    train_ranker(X, y).save(path)
+    spec = SearchSpec(beam=3, depth=2, ranker=str(path))
+    kernels = [random_kernel(rng, 7, 4) for _ in range(3)]
+    greedy = solve_jax_many(kernels)
+    a = solve_jax_many(kernels, quality=spec)
+    b = solve_jax_many(kernels, quality=spec)
+    for k, g, x, y2 in zip(kernels, greedy, a, b):
+        np.testing.assert_array_equal(np.asarray(x.kernel, np.float64), k)
+        assert x.cost <= g.cost
+        assert_pipelines_identical(x, y2)
+
+
+def test_device_beam_telemetry_and_traffic(rng):
+    """The resident beam reports the search.device_* counter family and a
+    fraction of the host-beam path's host<->device traffic; the host-beam
+    path reports host-seeded prefix lanes instead."""
+    import os
+
+    from da4ml_tpu import telemetry
+    from da4ml_tpu.telemetry.metrics import metrics_snapshot
+
+    kernels = [random_kernel(rng, 10, 4), random_kernel(rng, 9, 3)]
+    telemetry.enable()
+    try:
+        resident = solve_jax_many(kernels, quality='search')
+        s_res = metrics_snapshot()
+        telemetry.reset()
+        telemetry.enable()
+        os.environ['DA4ML_JAX_DEVICE_RESIDENT'] = '0'
+        try:
+            legacy = solve_jax_many(kernels, quality='search')
+        finally:
+            os.environ.pop('DA4ML_JAX_DEVICE_RESIDENT', None)
+        s_leg = metrics_snapshot()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    for a, b in zip(resident, legacy):
+        assert_pipelines_identical(a, b)
+    assert s_res.get('search.device_forks', {}).get('value', 0) > 0
+    assert 'search.device_prunes' in s_res
+    assert s_res.get('sched.entry_carry_groups', {}).get('value', 0) > 0
+    assert s_res.get('search.host_seeded_lanes', {}).get('value', 0) == 0
+    assert s_leg.get('search.device_forks', {}).get('value', 0) == 0
+    assert s_leg.get('search.host_seeded_lanes', {}).get('value', 0) > 0
+    # decisions-only fork fetch: >= 3x below the host-beam path (the CI
+    # quality gate enforces the same floor on the committed corpus)
+    assert s_res['sched.fetch_bytes']['value'] * 3 <= s_leg['sched.fetch_bytes']['value']
+    assert s_res['sched.upload_bytes']['value'] < s_leg['sched.upload_bytes']['value']
+
+
+def test_device_beam_prewarm_enumeration(rng, monkeypatch):
+    """prewarm_for_kernels(quality=...) enumerates the fork-phase classes
+    (fork step, frontier prune, widened-sel fan-out transitions) plus the
+    fork lanes' full_rec CSE ladder."""
+    import da4ml_tpu.cmvm.jax_search as js
+
+    kernels = [random_kernel(rng, 6, 4), random_kernel(rng, 8, 3)]
+    forks, prunes, trans, classes = [], [], [], []
+    monkeypatch.setattr(js, '_prewarm_fork', lambda fs, b: forks.append((fs, b)))
+    monkeypatch.setattr(js, '_prewarm_prune', lambda C, K, kind, G: prunes.append((C, K, kind, G)))
+    monkeypatch.setattr(js, '_prewarm_transition', lambda s, b1, b2: trans.append((s, b1, b2)))
+    monkeypatch.setattr(js, '_prewarm_class', lambda spec, bucket: classes.append(spec))
+    n = js.prewarm_for_kernels([kernels], full_ladder=True, inline=True, quality='search')
+    assert n > 0
+    assert forks and prunes and trans
+    assert all(fs.beam == 5 for fs, _ in forks)
+    assert any(spec.full_rec for spec in classes), 'fork-lane CSE ladder classes must be enumerated'
+
+
+# ---------------------------------------------------------------------------
 # ranker / training
 # ---------------------------------------------------------------------------
 

@@ -188,12 +188,60 @@ def test_device_resident_resume_traffic_and_metrics(rng, monkeypatch):
 
 def test_device_resident_prefix_fork_parity(rng, monkeypatch):
     """Beam-fork (LanePrefix) lanes — heterogeneous cur0, full-capacity op
-    records — ride the resident ladder bit-exactly."""
+    records — ride the resident ladder bit-exactly. Resident mode runs the
+    whole fork generation on device (fork/score/prune in the ladder);
+    legacy mode is the host beam + host-state rung loop."""
     kernels = [random_kernel(rng, 12, 8, 4), random_kernel(rng, 9, 6, 3)]
     quality = {'beam': 2, 'depth': 1, 'focus': 1}
     resident, legacy = _solve_pair(kernels, monkeypatch, quality=quality)
     for a, b in zip(resident, legacy):
         assert_pipelines_identical(a, b)
+
+
+def test_device_beam_mesh_parity(rng, monkeypatch):
+    """quality= solves under 4- and 8-device sub-meshes of the virtual cpu
+    mesh: the device beam (fork phase unsharded, CSE lanes sharded) matches
+    the host-beam path and the unsharded solve bit-exactly."""
+    import jax
+    from jax.sharding import Mesh
+
+    kernels = [random_kernel(rng, 10, 6, 4), random_kernel(rng, 8, 6, 3)]
+    quality = {'beam': 3, 'depth': 1, 'focus': 2}
+    base, legacy0 = _solve_pair(kernels, monkeypatch, quality=quality)
+    for a, b in zip(base, legacy0):
+        assert_pipelines_identical(a, b)
+    for nd in (4, 8):
+        mesh = Mesh(np.asarray(jax.devices('cpu')[:nd]), ('batch',))
+        resident, legacy = _solve_pair(kernels, monkeypatch, quality=quality, mesh=mesh)
+        for a, b, c in zip(resident, legacy, base):
+            assert_pipelines_identical(a, b)
+            assert_pipelines_identical(a, c)
+
+
+def test_device_beam_deadline_abort(rng, monkeypatch):
+    """An expired cooperative deadline aborts a quality= solve mid-ladder in
+    both beam modes (SolveTimeout, no hang, no stuck carry) — and the next
+    solve in the process is unaffected."""
+    import time
+
+    from da4ml_tpu.reliability import deadline as dl
+    from da4ml_tpu.reliability.errors import SolveTimeout
+
+    kernels = [random_kernel(rng, 12, 8, 4)]
+    for env in (None, '0'):
+        if env is None:
+            monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+        else:
+            monkeypatch.setenv('DA4ML_JAX_DEVICE_RESIDENT', env)
+        dl._local.deadline = time.monotonic() - 1.0
+        try:
+            with pytest.raises(SolveTimeout):
+                solve_jax_many(kernels, quality='search')
+        finally:
+            dl._local.deadline = None
+    monkeypatch.delenv('DA4ML_JAX_DEVICE_RESIDENT', raising=False)
+    (sol,) = solve_jax_many(kernels, quality='search')
+    np.testing.assert_array_equal(np.asarray(sol.kernel, np.float64), kernels[0])
 
 
 def test_device_resident_deadline_abort(rng, monkeypatch):
