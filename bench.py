@@ -676,6 +676,72 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
             'burst_resolved_all': overload['resolved_all'],
             'drained_clean': drained,
         }
+    if name == 'store':
+        # solution-store probe (docs/store.md): cold-fill a fresh store,
+        # replay the corpus warm (hit path = lookup + verify-on-read, must
+        # be byte-identical and far under cold-solve latency), then race an
+        # in-process herd on one fresh key to prove single-flight dedup
+        import tempfile
+        import threading
+
+        from da4ml_tpu.cmvm import solve as _solve
+        from da4ml_tpu.store import store_at
+        from da4ml_tpu.telemetry.metrics import metrics_snapshot
+
+        def _counter(name_: str) -> int:
+            return int(metrics_snapshot().get(name_, {}).get('value', 0))
+
+        rng = np.random.default_rng(11000)
+        n = 8 if limited else 24
+        kernels = [_rand_kernel(rng, int(rng.integers(4, 13)), int(rng.integers(4, 13)), 4) for _ in range(n)]
+        with tempfile.TemporaryDirectory() as td:
+            store = store_at(os.path.join(td, 'store'))
+            cold, cold_ms = [], []
+            for k in kernels:
+                t0 = time.perf_counter()
+                cold.append(_solve(k, backend=host_backend, store=store))
+                cold_ms.append((time.perf_counter() - t0) * 1e3)
+            hits0 = _counter('store.hits')
+            warm, warm_ms = [], []
+            for k in kernels:
+                t0 = time.perf_counter()
+                warm.append(_solve(k, backend=host_backend, store=store))
+                warm_ms.append((time.perf_counter() - t0) * 1e3)
+            hit_ratio = (_counter('store.hits') - hits0) / n
+            bit_exact = all(
+                json.dumps(a.to_dict(), sort_keys=True) == json.dumps(b.to_dict(), sort_keys=True)
+                for a, b in zip(cold, warm)
+            )
+            # 6 threads race one fresh key: single-flight must collapse the
+            # herd to one search (one publish), the rest answer from disk
+            herd_kernel = _rand_kernel(rng, 10, 10, 4)
+            n_threads = 6
+            barrier = threading.Barrier(n_threads)
+
+            def _race():
+                barrier.wait()
+                _solve(herd_kernel, backend=host_backend, store=store)
+
+            pubs0 = _counter('store.publishes')
+            threads = [threading.Thread(target=_race) for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            herd_searches = _counter('store.publishes') - pubs0
+        cold_p50 = float(np.percentile(cold_ms, 50))
+        hit_p50 = float(np.percentile(warm_ms, 50))
+        return {
+            'n_kernels': n,
+            'cold_p50_ms': round(cold_p50, 3),
+            'hit_p50_ms': round(hit_p50, 3),
+            'warm_speedup': round(cold_p50 / hit_p50, 2) if hit_p50 > 0 else None,
+            'hit_ratio': round(hit_ratio, 4),
+            'bit_exact': bit_exact,
+            'herd_threads': n_threads,
+            'herd_searches': herd_searches,
+            'singleflight_dedup': n_threads - herd_searches,
+        }
     if name == 'select_modes':
         # selection-mode microbench: top4 (XLA O(S*P) score cache) vs the
         # full-rescan xla path vs the single-kernel fused Pallas loop
@@ -707,7 +773,7 @@ _CONFIG_SECTIONS = (
     '4_qconv3x3_im2col',
     '5_full_model_trace',
 )
-_MICRO_SECTIONS = ('quality_sweep', 'quality_beam', 'select_modes', 'dais_inference', 'campaign', 'serve')
+_MICRO_SECTIONS = ('quality_sweep', 'quality_beam', 'select_modes', 'dais_inference', 'campaign', 'serve', 'store')
 
 
 def _run_section_child(name: str, n1: int, timeout: float, env: dict | None = None) -> dict:

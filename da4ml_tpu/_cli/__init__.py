@@ -28,7 +28,10 @@ src/da4ml/_cli/__init__.py:8-27):
   (docs/distributed.md);
 - ``serve`` — resilient HTTP inference front-end: deadline-aware dynamic
   batching, admission control/shedding, per-model breakers with graceful
-  degradation, plus its own chaos drill (docs/serving.md).
+  degradation, plus its own chaos drill (docs/serving.md);
+- ``cache`` — operate a global content-addressed solution store: stats,
+  re-verification, lease-guarded LRU gc, and the zipf-traffic + bit-flip
+  chaos drill (docs/store.md).
 """
 
 from __future__ import annotations
@@ -101,6 +104,12 @@ def main(argv: list[str] | None = None) -> int:
     p_serve = sub.add_parser('serve', help='Serve models over HTTP with dynamic batching and admission control')
     add_serve_args(p_serve)
     p_serve.set_defaults(func=serve_main)
+
+    from .cache import add_cache_args, cache_main
+
+    p_cache = sub.add_parser('cache', help='Operate a global solution store (stats / verify / gc / chaos)')
+    add_cache_args(p_cache)
+    p_cache.set_defaults(func=cache_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

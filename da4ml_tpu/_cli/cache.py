@@ -1,0 +1,103 @@
+"""``da4ml-tpu cache`` — operate a global solution store (docs/store.md).
+
+Four actions over a store directory (``--store`` or ``DA4ML_SOLUTION_STORE``):
+
+- ``stats``  — occupancy, hit/miss accounting, breaker states;
+- ``verify`` — re-run the DAIS verifier over every entry; bad entries are
+  quarantined to ``corrupt/`` exactly as a read would;
+- ``gc``     — lease-guarded LRU eviction under ``--max-bytes`` /
+  ``--max-age`` (never unlinks a key a solver holds right now);
+- ``chaos``  — the zipf-traffic + bit-flip drill (CI job ``store-chaos``);
+  exit 0/1 on its gate.
+
+Sizes accept ``K``/``M``/``G`` suffixes (``--max-bytes 512M``); ages accept
+``s``/``m``/``h``/``d`` (``--max-age 7d``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def parse_size(text: str) -> int:
+    """'512M' → bytes (K/M/G/T suffixes, case-insensitive)."""
+    t = text.strip().upper()
+    mult = {'K': 1 << 10, 'M': 1 << 20, 'G': 1 << 30, 'T': 1 << 40}.get(t[-1:] or '', None)
+    try:
+        return int(float(t[:-1]) * mult) if mult else int(float(t))
+    except ValueError:
+        raise argparse.ArgumentTypeError(f'not a size: {text!r} (expected e.g. 512M, 2G, 1048576)') from None
+
+
+def parse_age(text: str) -> float:
+    """'7d' → seconds (s/m/h/d suffixes; bare numbers are seconds)."""
+    t = text.strip().lower()
+    mult = {'s': 1.0, 'm': 60.0, 'h': 3600.0, 'd': 86400.0}.get(t[-1:] or '', None)
+    try:
+        return float(t[:-1]) * mult if mult else float(t)
+    except ValueError:
+        raise argparse.ArgumentTypeError(f'not an age: {text!r} (expected e.g. 7d, 12h, 600)') from None
+
+
+def add_cache_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument('action', choices=('stats', 'verify', 'gc', 'chaos'), help='What to do with the store')
+    parser.add_argument('--store', default=None, help='Store directory (default: DA4ML_SOLUTION_STORE)')
+    parser.add_argument('--max-bytes', type=parse_size, default=None, help='gc: evict LRU entries down to this size')
+    parser.add_argument('--max-age', type=parse_age, default=None, help='gc: evict entries older than this (e.g. 7d)')
+    parser.add_argument('--workers', type=int, default=3, help='chaos: worker subprocesses')
+    parser.add_argument('--requests', type=int, default=None, help='chaos: total requests (default 300)')
+    parser.add_argument('--kernels', type=int, default=None, help='chaos: corpus size (default 48)')
+    parser.add_argument('--backend', default='pure-python', help='chaos: solver backend')
+    parser.add_argument('--json', action='store_true', dest='as_json', help='Print the full report as JSON')
+    parser.add_argument('--out', type=Path, default=None, help='Also write the report JSON to a file')
+
+
+def cache_main(args: argparse.Namespace) -> int:
+    from ..telemetry import get_logger
+
+    log = get_logger('cli.cache')
+
+    if args.action == 'chaos':
+        from ..store.chaos import N_KERNELS, N_REQUESTS, store_chaos_drill
+
+        report = store_chaos_drill(
+            workers=max(2, args.workers),
+            base_dir=args.store,
+            backend=args.backend,
+            n_kernels=args.kernels if args.kernels is not None else N_KERNELS,
+            n_requests=args.requests if args.requests is not None else N_REQUESTS,
+        )
+        if args.out is not None:
+            args.out.write_text(json.dumps(report, indent=2, default=str))
+        print(json.dumps(report if args.as_json else {'ok': report['ok'], **report['checks']}, indent=2, default=str))
+        return 0 if report['ok'] else 1
+
+    from ..store.solution_store import resolve_store
+
+    store = resolve_store(args.store)
+    if store is None:
+        log.warning('no store: pass --store DIR or set DA4ML_SOLUTION_STORE')
+        return 2
+
+    if args.action == 'stats':
+        print(json.dumps(store.stats(), indent=2))
+        return 0
+    if args.action == 'verify':
+        report = store.verify_all()
+        print(json.dumps(report, indent=2))
+        return 0 if report['quarantined'] == 0 else 1
+    # gc
+    report = store.gc(max_bytes=args.max_bytes, max_age_s=args.max_age)
+    if args.out is not None:
+        args.out.write_text(json.dumps(report, indent=2))
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == '__main__':  # pragma: no cover - convenience entry
+    ap = argparse.ArgumentParser(prog='da4ml-tpu cache')
+    add_cache_args(ap)
+    sys.exit(cache_main(ap.parse_args()))

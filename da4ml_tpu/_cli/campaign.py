@@ -53,6 +53,9 @@ def add_campaign_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument('--out', type=Path, default=None, help='Write the campaign report JSON to a file')
     parser.add_argument('--json', action='store_true', help='Print the full report as JSON (default: summary line)')
     parser.add_argument('--status', metavar='DIR', default=None, help='Print live status of a campaign directory')
+    parser.add_argument(
+        '--store', metavar='DIR', default=None, help='Publish results into this solution store (docs/store.md)'
+    )
     parser.add_argument('--chaos', action='store_true', help='Run the SIGKILL chaos drill instead of a campaign')
     parser.add_argument('--seed', type=int, default=1000, help='Seed for synthetic quality:N corpora')
 
@@ -149,6 +152,7 @@ def campaign_main(args: argparse.Namespace) -> int:
             deadline_per_solve=args.deadline,
             timeout_s=args.timeout,
             trace=args.trace,
+            store=args.store,
         )
     except C.CampaignError as exc:
         log.warning(f'campaign failed: {exc}')

@@ -45,6 +45,18 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument('--breaker-threshold', type=int, default=3, help='Consecutive failures that open the breaker')
     parser.add_argument('--breaker-reset-s', type=float, default=5.0, help='Breaker cooldown before a half-open probe')
     parser.add_argument('--no-prewarm', action='store_true', help='Skip the canonical-grid warmup on load')
+    parser.add_argument(
+        '--solve-store',
+        default=None,
+        metavar='DIR',
+        help='Mount POST /v1/solve over this solution store dir (default: DA4ML_SOLUTION_STORE if set)',
+    )
+    parser.add_argument('--solve-backend', default='auto', help='/v1/solve solver backend (default auto)')
+    parser.add_argument('--solve-workers', type=int, default=1, help='/v1/solve worker threads')
+    parser.add_argument('--solve-queue-rows', type=int, default=256, help='/v1/solve admission ceiling (kernel rows)')
+    parser.add_argument(
+        '--solve-deadline-ms', type=float, default=30000.0, help='/v1/solve default deadline (0 = unbounded)'
+    )
     parser.add_argument('--duration', type=float, default=0.0, help='Serve for N seconds then drain (0 = until signal)')
     parser.add_argument('--chaos', action='store_true', help='Run the breaker-trip + reload chaos drill and exit')
     parser.add_argument('--drill-duration', type=float, default=6.0, help='--chaos: load duration in seconds')
@@ -93,21 +105,39 @@ def serve_main(args: argparse.Namespace) -> int:
             args.out.write_text(json.dumps(report, indent=1))
         return 0 if report['ok'] else 1
 
-    if not args.models:
-        log.warning('no models given (pass name=path.json); nothing to serve')
+    import os
+
+    solve_store = args.solve_store if args.solve_store is not None else os.environ.get('DA4ML_SOLUTION_STORE')
+    if not args.models and not solve_store:
+        log.warning('no models given (pass name=path.json) and no --solve-store; nothing to serve')
         return 2
 
     engine = ServeEngine(config)
     for name, path in _parse_models(args.models):
         engine.load_model(name, path)
 
+    solve_service = None
+    if solve_store:
+        from ..store.service import SolveService
+
+        solve_service = SolveService(
+            store=solve_store,
+            backend=args.solve_backend,
+            queue_cap_rows=args.solve_queue_rows,
+            workers=args.solve_workers,
+            default_deadline_s=args.solve_deadline_ms / 1e3 if args.solve_deadline_ms > 0 else None,
+        )
+
     from ..serve.http import ServeServer
 
-    server = ServeServer(engine, port=args.port, host=args.host)
+    server = ServeServer(engine, port=args.port, host=args.host, solve_service=solve_service)
+    endpoints = ['/v1/infer', '/v1/models', '/metrics', '/healthz', '/statusz']
+    if solve_service is not None:
+        endpoints.insert(1, '/v1/solve')
     ready = {
         'serving': server.url,
         'models': [m['name'] for m in engine.models()['models']],
-        'endpoints': ['/v1/infer', '/v1/models', '/metrics', '/healthz', '/statusz'],
+        'endpoints': endpoints,
     }
     log.info(json.dumps(ready))
     sys.stdout.flush()
@@ -129,6 +159,8 @@ def serve_main(args: argparse.Namespace) -> int:
         # graceful drain: stop admitting, serve everything accepted, then
         # close — the zero-lost-accepted-requests exit contract
         drained = engine.drain(timeout=30.0)
+        if solve_service is not None:
+            solve_service.close()
         server.close()
         log.info(json.dumps({'drained': drained, 'exit': 0 if drained else 1}))
     return 0 if drained else 1

@@ -343,6 +343,7 @@ def solve(
     fallback=None,
     report=None,
     checkpoint=None,
+    store=None,
 ) -> Pipeline:
     """Full CMVM solve with optional sweep over all decompose depths.
 
@@ -381,6 +382,16 @@ def solve(
     result keyed by kernel + options. ``DA4ML_SOLVE_FALLBACK=0`` restores
     the raise-on-failure behavior globally.
 
+    ``store`` consults the *global* content-addressed solution store
+    (docs/store.md) before any search: None (default) uses the
+    ``DA4ML_SOLUTION_STORE`` directory when set, a path or
+    :class:`~da4ml_tpu.store.SolutionStore` pins one explicitly, and
+    ``False`` disables the store even with the env var set. A verified hit
+    is byte-identical to the cold solve; cold misses are single-flighted
+    across processes sharing the directory and published on success. An
+    unreachable store degrades to the local solve path with a one-time
+    warning — it never fails the call.
+
     With ``DA4ML_VERIFY=1`` every solve result additionally runs the full
     static-analysis verifier (docs/analysis.md) before being returned and
     raises :class:`~da4ml_tpu.analysis.VerificationError` on any error —
@@ -403,6 +414,7 @@ def solve(
             kernel, method0, method1, hard_dc, decompose_dc, qintervals, latencies, adder_size,
             carry_size, search_all_decompose_dc, backend, n_workers, method0_candidates, n_restarts,
             mesh, quality=quality, deadline=deadline, fallback=fallback, report=report, checkpoint=checkpoint,
+            store=store,
         )  # fmt: skip
         if _metrics:
             telemetry.counter('solve.calls').inc()
@@ -436,9 +448,59 @@ def _solve_entry(
     fallback,
     report,
     checkpoint,
+    store=None,
 ) -> Pipeline:
     """Orchestration decision + dispatch — the body of :func:`solve`."""
     from ..reliability.orchestrator import fallback_enabled_default, solve_orchestrated
+
+    # Global solution store (docs/store.md): a verified hit skips the search
+    # entirely; a miss runs the whole solve below (single-flighted across
+    # processes) and publishes the result. The env check keeps the store
+    # package un-imported on the default path.
+    if store is not False and (store is not None or os.environ.get('DA4ML_SOLUTION_STORE')):
+        from ..store.solution_store import resolve_store, store_key
+
+        _store = resolve_store(store)
+        if _store is not None:
+            _kw = dict(
+                method0=method0, method1=method1, hard_dc=hard_dc, decompose_dc=decompose_dc,
+                qintervals=qintervals, latencies=latencies, adder_size=adder_size, carry_size=carry_size,
+                search_all_decompose_dc=search_all_decompose_dc, method0_candidates=method0_candidates,
+                n_restarts=n_restarts, quality=quality,
+            )  # fmt: skip
+            from ..reliability.orchestrator import canonical_backend
+
+            _t0 = time.monotonic()
+            _canon = canonical_backend(backend)
+            _used: dict = {}
+
+            def _cold() -> Pipeline:
+                rem = None if deadline is None else max(deadline - (time.monotonic() - _t0), 0.01)
+                # learn which backend actually answered: the fallback chain
+                # may degrade, and a degraded result must not be published
+                # under this (requested-backend) key
+                rep = report
+                if rep is None and (fallback not in (None, False) or fallback_enabled_default() or rem is not None):
+                    from ..reliability.report import SolveReport
+
+                    rep = SolveReport()
+                result = _solve_entry(
+                    kernel, method0, method1, hard_dc, decompose_dc, qintervals, latencies, adder_size,
+                    carry_size, search_all_decompose_dc, backend, n_workers, method0_candidates, n_restarts,
+                    mesh, quality=quality, deadline=rem, fallback=fallback, report=rep,
+                    checkpoint=checkpoint, store=False,
+                )  # fmt: skip
+                if rep is not None:
+                    _used['backend'] = rep.backend_used
+                return result
+
+            return _store.solve_through(
+                store_key(kernel, backend, _kw),
+                _cold,
+                meta={'backend': _canon},
+                deadline_s=deadline,
+                publish_ok=lambda: _used.get('backend') in (None, _canon),
+            )
 
     want_orchestration = (
         deadline is not None

@@ -311,19 +311,25 @@ def worker_loop(
     deadline_per_solve: float | None = None,
     max_kernels: int | None = None,
     max_failures: int = DEFAULT_MAX_FAILURES,
+    store=None,
 ) -> dict:
     """Drive one worker until the campaign is complete; returns a summary
     ``{'owner', 'solved', 'stolen', 'duration_s', ...}``.
 
     Safe to run in any number of processes against the same directory.
     ``max_kernels`` bounds this worker's own contribution (tests; draining
-    a worker before maintenance).
+    a worker before maintenance). ``store`` (or ``DA4ML_SOLUTION_STORE``)
+    names a global solution store (docs/store.md) to publish finished
+    solves into, so campaign output warms every future ``solve()``.
     """
     global _ACTIVE_DIR
-    from ..reliability.orchestrator import solve_orchestrated
+    from ..reliability.orchestrator import canonical_backend, solve_orchestrated
+    from ..store.solution_store import resolve_store, store_key
 
     d = _dirs(campaign_dir)
     manifest = load_manifest(campaign_dir)
+    solution_store = resolve_store(store)
+    store_backend = canonical_backend(manifest['backend'])
     keys: list[str] = list(manifest['keys'])
     owner = owner or default_owner('w')
     grace = grace_s if grace_s is not None else max(DEFAULT_GRACE_S, ttl_s / 3)
@@ -369,10 +375,11 @@ def worker_loop(
                 # thread), the exact state a SIGKILL must recover from
                 fault_check('campaign.solve')
                 t_k = time.monotonic()
+                kern = _load_kernel(campaign_dir, lease.key)
                 with telemetry.span('campaign.kernel', key=lease.key, owner=owner):
                     try:
                         pipe = solve_orchestrated(
-                            _load_kernel(campaign_dir, lease.key),
+                            kern,
                             dict(manifest['solver_options']),
                             backend=manifest['backend'],
                             fallback=manifest.get('fallback'),
@@ -396,6 +403,16 @@ def worker_loop(
                 }
                 atomic_write_bytes(d['results'] / f'{lease.key}.json', json.dumps(doc).encode())
                 solved.append(lease.key)
+                # publish into the shared solution store so future solve()
+                # calls anywhere on the fleet start warm — only results the
+                # manifest's own backend produced (a fallback-degraded
+                # answer must not poison the requested-backend key)
+                if solution_store is not None and report.backend_used in (None, store_backend):
+                    solution_store.publish(
+                        store_key(kern, manifest['backend'], dict(manifest['solver_options'])),
+                        pipe,
+                        meta={'backend': store_backend, 'campaign': str(d['root']), 'owner': owner},
+                    )
                 # kill-after-durable-result drill point (mirrors
                 # checkpoint.post_save): the result above survives this
                 fault_check('campaign.post_result')
@@ -488,6 +505,7 @@ def _spawn_worker(
     deadline_per_solve: float | None,
     env: dict | None = None,
     trace: bool = False,
+    store: str | None = None,
 ) -> subprocess.Popen:
     cmd = [
         sys.executable,
@@ -504,6 +522,8 @@ def _spawn_worker(
     ]
     if deadline_per_solve is not None:
         cmd += ['--deadline', str(deadline_per_solve)]
+    if store is not None:
+        cmd += ['--store', str(store)]
     env = _repo_pythonpath(dict(os.environ if env is None else env))
     # children never inherit the parent's trace file or metrics port: N
     # workers appending one trace (or binding one port) corrupts both.
@@ -539,9 +559,14 @@ def run_campaign(
     deadline_per_solve: float | None = None,
     timeout_s: float = 3600.0,
     trace: bool = False,
+    store: str | os.PathLike | None = None,
 ) -> tuple[list[dict], dict]:
     """Solve a corpus with ``workers`` local processes; returns
     ``(result docs in corpus order, campaign report)``.
+
+    ``store`` names a global solution-store directory (docs/store.md) every
+    worker publishes finished solves into; with no argument, workers still
+    pick one up from ``DA4ML_SOLUTION_STORE`` in their environment.
 
     ``workers <= 1`` runs in-process (the single-process reference the chaos
     drill compares against). A worker crash mid-campaign is absorbed: its
@@ -559,13 +584,21 @@ def run_campaign(
     with telemetry.span('campaign.run', n_kernels=len(load_manifest(campaign_dir)['keys']), workers=workers):
         if workers <= 1:
             summary = worker_loop(
-                campaign_dir, ttl_s=ttl_s, poll_s=poll_s, deadline_per_solve=deadline_per_solve
+                campaign_dir, ttl_s=ttl_s, poll_s=poll_s, deadline_per_solve=deadline_per_solve, store=store
             )
             report['worker_summaries'] = [summary]
         else:
             _ACTIVE_DIR = str(campaign_dir)
             procs = [
-                _spawn_worker(campaign_dir, f'{default_owner()}:w{i}', ttl_s, poll_s, deadline_per_solve, trace=trace)
+                _spawn_worker(
+                    campaign_dir,
+                    f'{default_owner()}:w{i}',
+                    ttl_s,
+                    poll_s,
+                    deadline_per_solve,
+                    trace=trace,
+                    store=None if store is None else str(store),
+                )
                 for i in range(workers)
             ]
             summaries, failures = [], []
@@ -731,6 +764,7 @@ def _worker_main(argv: list[str]) -> int:
     ap.add_argument('--poll', type=float, default=0.5)
     ap.add_argument('--deadline', type=float, default=None)
     ap.add_argument('--max-kernels', type=int, default=None)
+    ap.add_argument('--store', default=None, metavar='DIR')
     args = ap.parse_args(argv)
     summary = worker_loop(
         args.worker,
@@ -739,6 +773,7 @@ def _worker_main(argv: list[str]) -> int:
         poll_s=args.poll,
         deadline_per_solve=args.deadline,
         max_kernels=args.max_kernels,
+        store=args.store,
     )
     print(json.dumps(summary), flush=True)
     return 0 if summary['complete'] else 3

@@ -92,16 +92,27 @@ def exclusive_create(path: str | os.PathLike, payload: bytes) -> bool:
     return True
 
 
-def kernel_key(kernel, opts: dict | None = None) -> str:
-    """Content hash of a kernel matrix + the solver options that shape its
-    solution. Two campaigns agree on a key iff the solve would be identical."""
+def kernel_digest(kernel, opts: dict | None = None) -> str:
+    """Full (untruncated) content hash of a kernel matrix + the solver
+    options that shape its solution. Two callers agree on a digest iff the
+    solve would be identical. This is the key form of the global solution
+    store (docs/store.md): at fleet scale, truncation is a collision budget
+    nobody should spend."""
     k = np.ascontiguousarray(kernel, dtype=np.float64)
     h = hashlib.sha256()
     h.update(str(k.shape).encode())
     h.update(k.tobytes())
     if opts:
         h.update(json.dumps(opts, sort_keys=True, default=str).encode())
-    return h.hexdigest()[:32]
+    return h.hexdigest()
+
+
+def kernel_key(kernel, opts: dict | None = None) -> str:
+    """32-char prefix of :func:`kernel_digest` — the legacy key form kept
+    only for campaign-local checkpoint/result *filenames* (short dirs, and
+    pre-existing campaign directories keep resuming). New shared/global
+    state must key on the full digest."""
+    return kernel_digest(kernel, opts)[:32]
 
 
 class CheckpointStore:

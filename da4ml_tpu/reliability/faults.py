@@ -27,8 +27,13 @@ Instrumented sites (kept in docs/reliability.md): ``cmvm.solve``,
 ``checkpoint.post_save``, ``lease.claim``, ``campaign.solve`` (a planned
 ``sleep`` here parks a campaign worker mid-solve with its lease held — the
 chaos drill's SIGKILL target), ``campaign.post_result`` (kill-after-durable
--result resume drills), and ``ir.mutate.<corruption>`` (mode ``corrupt``;
-arms one entry of the IR verifier's mutation catalog, analysis/mutation.py).
+-result resume drills), ``store.read`` / ``store.write`` (solution-store
+I/O; error modes = unreachable/unwritable store, mode ``corrupt`` = torn
+read/torn entry on disk), ``store.verify`` (mode ``corrupt``; a semantic
+in-memory mutation only the DAIS verifier catches — the store's
+deterministic bit-flip drill), and ``ir.mutate.<corruption>`` (mode
+``corrupt``; arms one entry of the IR verifier's mutation catalog,
+analysis/mutation.py).
 """
 
 from __future__ import annotations

@@ -12,6 +12,10 @@ both planes (docs/serving.md#endpoints):
   ``Retry-After``, 504 deadline expired);
 - ``POST /v1/models/<name>/reload`` — hot-swap the model's executor;
 - ``GET  /v1/models``               — registry + executor-cache document;
+- ``POST /v1/solve``                — (when a :class:`~..store.SolveService`
+  is mounted) ``{"kernel", "quality"?, "deadline_ms"?, "pipeline"?}`` →
+  solved DAIS program through the global solution store (docs/store.md);
+  same shed taxonomy, plus 503 + ``Retry-After`` for negative-cached keys;
 - ``GET  /metrics`` / ``/healthz`` / ``/statusz`` — the process
   observability plane, mounted in-process (serve-plane checks included
   via ``telemetry.obs.health``).
@@ -42,11 +46,12 @@ MAX_BODY_BYTES = 64 << 20
 class ServeServer:
     """HTTP wrapper around one :class:`ServeEngine`."""
 
-    def __init__(self, engine: ServeEngine, port: int = 0, host: str = '127.0.0.1'):
+    def __init__(self, engine: ServeEngine, port: int = 0, host: str = '127.0.0.1', solve_service=None):
         from ..telemetry.metrics import enable_metrics
 
         enable_metrics()  # a serve endpoint without metrics is flying blind
         self.engine = engine
+        self.solve_service = solve_service
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         srv = self
@@ -107,7 +112,8 @@ class ServeServer:
 
                         self._send_json(200, status_snapshot())
                     elif path in ('/', ''):
-                        body = b'da4ml_tpu serve: POST /v1/infer, GET /v1/models, /metrics /healthz /statusz\n'
+                        extra = b', POST /v1/solve' if srv.solve_service is not None else b''
+                        body = b'da4ml_tpu serve: POST /v1/infer' + extra + b', GET /v1/models, /metrics /healthz /statusz\n'
                         self._send(200, body, 'text/plain; charset=utf-8')
                     else:
                         self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
@@ -128,6 +134,20 @@ class ServeServer:
                         finally:
                             with srv._inflight_lock:
                                 srv._inflight -= 1
+                    elif path == '/v1/solve':
+                        if srv.solve_service is None:
+                            self._send_json(
+                                404,
+                                {'error': {'type': 'NotFound', 'message': 'no solve service mounted', 'http_status': 404}},
+                            )
+                            return
+                        with srv._inflight_lock:
+                            srv._inflight += 1
+                        try:
+                            self._solve()
+                        finally:
+                            with srv._inflight_lock:
+                                srv._inflight -= 1
                     elif path.startswith('/v1/models/') and path.endswith('/reload'):
                         name = path[len('/v1/models/') : -len('/reload')]
                         version = srv.engine.reload(name)
@@ -143,7 +163,7 @@ class ServeServer:
                     except Exception:
                         pass
 
-            def _infer(self):
+            def _read_body(self) -> dict:
                 try:
                     length = int(self.headers.get('Content-Length', '0') or 0)
                 except ValueError:
@@ -154,7 +174,13 @@ class ServeServer:
                     body = json.loads(self.rfile.read(length))
                 except ValueError as e:
                     raise InvalidInputError(f'request body is not valid JSON: {e}') from e
-                if not isinstance(body, dict) or 'inputs' not in body:
+                if not isinstance(body, dict):
+                    raise InvalidInputError('request body must be a JSON object')
+                return body
+
+            def _infer(self):
+                body = self._read_body()
+                if 'inputs' not in body:
                     raise InvalidInputError("request body must be a JSON object with an 'inputs' field")
                 name = body.get('model', 'default')
                 deadline_ms = body.get('deadline_ms')
@@ -171,6 +197,28 @@ class ServeServer:
                         'latency_ms': round(req.wait_s() * 1e3, 3),
                     },
                 )
+
+            def _solve(self):
+                body = self._read_body()
+                if 'kernel' not in body:
+                    raise InvalidInputError("request body must be a JSON object with a 'kernel' field")
+                deadline_ms = body.get('deadline_ms')
+                deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
+                req = srv.solve_service.submit(body['kernel'], quality=body.get('quality'), deadline_s=deadline_s)
+                doc = req.result(None if req.deadline is None else max(req.deadline - req.t_enq, 0.0) + 30.0)
+                out = {
+                    'key': doc['key'],
+                    'source': doc['source'],
+                    'cost': doc['cost'],
+                    'backend': doc['backend'],
+                    'served_by': req.served_by,
+                    'solve_ms': doc['solve_ms'],
+                    'latency_ms': round(req.wait_s() * 1e3, 3),
+                }
+                # the program can be large; ship it only when asked for
+                if body.get('pipeline', True):
+                    out['pipeline'] = doc['pipeline']
+                self._send_json(200, out)
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

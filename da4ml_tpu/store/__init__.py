@@ -1,0 +1,40 @@
+"""Global content-addressed solution store (docs/store.md).
+
+``SolutionStore`` maps full kernel digest + canonical solver options to a
+solved DAIS program with verify-on-read, single-flighted cold misses,
+negative caching, and breaker-guarded degradation. ``cmvm.api.solve``
+consults it via ``store=`` / ``DA4ML_SOLUTION_STORE``; campaigns publish
+into it; the serve plane exposes it as ``POST /v1/solve``.
+"""
+
+from .service import SolveService
+from .solution_store import (
+    SolutionStore,
+    StoreEntryCorrupt,
+    StoreHit,
+    StoreNegativeEntry,
+    canonical_solve_opts,
+    default_store,
+    reset_store_registry,
+    resolve_store,
+    store_at,
+    store_health,
+    store_key,
+    store_status,
+)
+
+__all__ = [
+    'SolutionStore',
+    'SolveService',
+    'StoreEntryCorrupt',
+    'StoreHit',
+    'StoreNegativeEntry',
+    'canonical_solve_opts',
+    'default_store',
+    'reset_store_registry',
+    'resolve_store',
+    'store_at',
+    'store_health',
+    'store_key',
+    'store_status',
+]

@@ -1,0 +1,294 @@
+"""Solution-store chaos drill: zipf fleet traffic + a mid-run bit flip.
+
+The CI gate (job ``store-chaos``, ``da4ml-tpu cache chaos``) for the store's
+whole robustness contract at once:
+
+1. a deterministic corpus of kernels and a zipf-weighted request stream
+   (real fleets re-solve the same hot layers over and over) is split across
+   ``workers`` subprocesses sharing one store directory;
+2. every worker's slice starts with the same *sentinel* kernel no other
+   request draws, so all workers race it cold simultaneously — the
+   single-flight gate: exactly one may actually search it;
+3. the parent corrupts the hottest key's entry on disk mid-run (truncated,
+   exactly what a torn write or bit rot produces) — verify-on-read must
+   quarantine it and re-solve transparently;
+4. every response is digest-compared against single-process cold
+   references computed with the store disabled.
+
+Passes iff the corpus completed, every response is byte-identical to its
+reference, the fleet hit rate is >= ``min_hit_rate``, the sentinel herd
+collapsed to one search, at least one entry was quarantined, and the hit
+path stayed bounded by lookup+verify (p99 against the cold p50).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+#: request-stream shape: steep zipf over a small corpus so the drawn
+#: distinct-key count (the unavoidable cold misses) stays far under 10% of
+#: the requests — the >=0.9 hit-rate gate then has real headroom
+N_KERNELS = 48
+N_REQUESTS = 300
+ZIPF_A = 2.2
+DRILL_SEED = 20260804
+
+
+def _drill_corpus(n: int = N_KERNELS, dim: int = 6, bits: int = 3) -> list[np.ndarray]:
+    rng = np.random.default_rng(DRILL_SEED)
+    return [
+        (rng.integers(0, 2**bits, (dim, dim)) * rng.choice([-1.0, 1.0], (dim, dim))).astype(np.float64)
+        for _ in range(n)
+    ]
+
+
+def _request_indices(n_kernels: int = N_KERNELS, n_requests: int = N_REQUESTS) -> list[int]:
+    """Zipf-weighted request stream over kernel ranks (deterministic)."""
+    rng = np.random.default_rng(DRILL_SEED)
+    w = 1.0 / np.arange(1, n_kernels + 1) ** ZIPF_A
+    w /= w.sum()
+    return [int(i) for i in rng.choice(n_kernels, size=n_requests, p=w)]
+
+
+def _pipe_digest(pipe_doc: dict) -> str:
+    return hashlib.sha256(json.dumps(pipe_doc, sort_keys=True).encode()).hexdigest()
+
+
+def _percentile(values: list[float], q: float) -> float:
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q)) if values else 0.0
+
+
+# ----------------------------------------------------------------- worker
+
+
+def _worker_main(argv: list[str]) -> int:
+    """``python -m da4ml_tpu.store.chaos --worker ...`` — replay one slice
+    of the request stream through ``solve_through`` and print one JSON line
+    of per-request records + this process's store counters."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog='da4ml_tpu.store.chaos')
+    ap.add_argument('--worker', action='store_true', required=True)
+    ap.add_argument('--store', required=True)
+    ap.add_argument('--backend', default='pure-python')
+    ap.add_argument('--indices', required=True, help='comma-separated corpus indices to request, in order')
+    args = ap.parse_args(argv)
+
+    from ..cmvm.api import solve
+    from ..telemetry.metrics import enable_metrics, metrics_snapshot
+    from .solution_store import store_at, store_key
+
+    enable_metrics()
+    corpus = _drill_corpus()
+    store = store_at(args.store)
+    records = []
+    for idx in (int(i) for i in args.indices.split(',')):
+        kernel = corpus[idx]
+        key = store_key(kernel, args.backend)
+        info: dict = {}
+
+        def cold(kernel=kernel):
+            return solve(kernel, backend=args.backend, store=False)
+
+        t0 = time.perf_counter()
+        pipe = store.solve_through(key, cold, meta={'backend': args.backend}, info=info)
+        records.append(
+            {
+                'idx': idx,
+                'digest': _pipe_digest(pipe.to_dict()),
+                'source': info.get('source'),
+                'waited': bool(info.get('singleflight_wait')),
+                'ms': round((time.perf_counter() - t0) * 1e3, 3),
+            }
+        )
+    snap = metrics_snapshot()
+
+    def _c(name: str) -> int:
+        m = snap.get(name)
+        return int(m.get('value', 0)) if m else 0
+
+    print(
+        json.dumps(
+            {
+                'records': records,
+                'counters': {
+                    n: _c(f'store.{n}')
+                    for n in ('hits', 'misses', 'singleflight_waits', 'corrupt_quarantined', 'negative_hits')
+                },
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+# ------------------------------------------------------------------ drill
+
+
+def _spawn(store_dir: str, backend: str, indices: list[int]) -> subprocess.Popen:
+    from ..parallel.campaign import _repo_pythonpath
+
+    env = _repo_pythonpath(dict(os.environ))
+    env.pop('DA4ML_METRICS_PORT', None)
+    env.pop('DA4ML_TRACE', None)
+    env.pop('DA4ML_FAULT_INJECT', None)  # injected faults would break the herd gate
+    cmd = [
+        sys.executable,
+        '-m',
+        'da4ml_tpu.store.chaos',
+        '--worker',
+        '--store',
+        store_dir,
+        '--backend',
+        backend,
+        '--indices',
+        ','.join(str(i) for i in indices),
+    ]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def store_chaos_drill(
+    workers: int = 3,
+    base_dir: str | os.PathLike | None = None,
+    backend: str = 'pure-python',
+    n_kernels: int = N_KERNELS,
+    n_requests: int = N_REQUESTS,
+    min_hit_rate: float = 0.9,
+    timeout_s: float = 600.0,
+) -> dict:
+    """Run the store chaos drill; returns a report with ``ok`` + ``checks``."""
+    import tempfile
+
+    from ..cmvm.api import solve
+    from .solution_store import store_at, store_key
+
+    base = Path(base_dir) if base_dir is not None else Path(tempfile.mkdtemp(prefix='da4ml-store-chaos-'))
+    store_dir = base / 'store'
+    store_dir.mkdir(parents=True, exist_ok=True)
+    corpus = _drill_corpus(n=n_kernels)
+    indices = _request_indices(n_kernels=n_kernels, n_requests=n_requests)
+    drawn = set(indices)
+    # the sentinel: a kernel NO regular request draws, prepended to every
+    # worker's slice so all workers race it cold at t=0
+    sentinel = next(i for i in range(n_kernels - 1, -1, -1) if i not in drawn)
+    slices = [[sentinel] + indices[i::workers] for i in range(workers)]
+
+    report: dict = {
+        'base_dir': str(base),
+        'workers': workers,
+        'n_kernels': n_kernels,
+        'n_requests': n_requests,
+        'distinct_keys': len(drawn) + 1,
+        'sentinel': sentinel,
+        'backend': backend,
+    }
+
+    # (1) cold references, store disabled — the byte-identity ground truth
+    t0 = time.perf_counter()
+    cold_ms: list[float] = []
+    reference: dict[int, str] = {}
+    for idx in sorted(drawn | {sentinel}):
+        t_k = time.perf_counter()
+        reference[idx] = _pipe_digest(solve(corpus[idx], backend=backend, store=False).to_dict())
+        cold_ms.append((time.perf_counter() - t_k) * 1e3)
+    report['reference_wall_s'] = round(time.perf_counter() - t0, 3)
+    report['cold_p50_ms'] = round(_percentile(cold_ms, 50), 3)
+
+    # (2) the fleet
+    procs = [_spawn(str(store_dir), backend, sl) for sl in slices]
+
+    # (3) mid-run bit flip: truncate the hottest key's entry once it lands
+    # (the most-drawn index — guaranteed to be read again after the flip)
+    hot_idx = max(drawn, key=indices.count)
+    hot_key = store_key(corpus[hot_idx], backend)
+    hot_path = store_dir / 'solutions' / hot_key[:2] / f'{hot_key}.json'
+    flipped = False
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and not flipped:
+        if hot_path.exists():
+            try:
+                raw = hot_path.read_bytes()
+                hot_path.write_bytes(raw[: max(1, len(raw) // 2)])
+                flipped = True
+            except OSError:
+                pass
+        if any(p.poll() is not None for p in procs) and not flipped:
+            break  # a worker already finished; flip window closed
+        if not flipped:
+            time.sleep(0.02)
+    report['bit_flipped'] = flipped
+
+    worker_docs, failures = [], []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            failures.append({'pid': p.pid, 'rc': 'timeout', 'stderr': (err or '')[-300:]})
+            continue
+        doc = None
+        for line in reversed((out or '').strip().splitlines()):
+            if line.startswith('{'):
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                break
+        if p.returncode == 0 and doc is not None:
+            worker_docs.append(doc)
+        else:
+            failures.append({'pid': p.pid, 'rc': p.returncode, 'stderr': (err or '').strip()[-300:]})
+    if failures:
+        report['worker_failures'] = failures
+
+    records = [r for doc in worker_docs for r in doc['records']]
+    hits = sum(doc['counters']['hits'] for doc in worker_docs)
+    misses = sum(doc['counters']['misses'] for doc in worker_docs)
+    quarantined = sum(doc['counters']['corrupt_quarantined'] for doc in worker_docs)
+    hit_rate = hits / (hits + misses) if hits + misses else 0.0
+    mismatches = [r for r in records if r['digest'] != reference.get(r['idx'])]
+    sentinel_solves = sum(1 for doc in worker_docs for r in doc['records'][:1] if r['source'] == 'solve')
+    pure_hit_ms = [r['ms'] for r in records if r['source'] == 'store' and not r['waited']]
+    hit_p99 = _percentile(pure_hit_ms, 99)
+
+    occupancy = store_at(str(store_dir)).occupancy()
+    report.update(
+        {
+            'n_records': len(records),
+            'hits': hits,
+            'misses': misses,
+            'hit_rate': round(hit_rate, 4),
+            'quarantined': quarantined,
+            'sentinel_cold_solves': sentinel_solves,
+            'singleflight_waits': sum(doc['counters']['singleflight_waits'] for doc in worker_docs),
+            'hit_p50_ms': round(_percentile(pure_hit_ms, 50), 3),
+            'hit_p99_ms': round(hit_p99, 3),
+            'mismatches': [r['idx'] for r in mismatches][:8],
+            'occupancy': occupancy,
+        }
+    )
+    expected_records = sum(len(sl) for sl in slices)
+    report['checks'] = {
+        'corpus_complete': not failures and len(records) == expected_records,
+        'byte_identical_to_reference': not mismatches and len(records) == expected_records,
+        'hit_rate_ok': hit_rate >= min_hit_rate,
+        'herd_collapsed': sentinel_solves == 1,
+        'corruption_quarantined': flipped and quarantined >= 1 and occupancy['corrupt'] >= 1,
+        # generous lookup+verify bound: a warm hit must not look like a search
+        'hit_latency_bounded': bool(pure_hit_ms) and hit_p99 <= report['cold_p50_ms'] * 5 + 50.0,
+    }
+    report['ok'] = all(report['checks'].values())
+    return report
+
+
+if __name__ == '__main__':
+    sys.exit(_worker_main(sys.argv[1:]))

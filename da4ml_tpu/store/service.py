@@ -1,0 +1,188 @@
+"""The solver-as-a-service plane behind ``POST /v1/solve``.
+
+Reuses the serve plane's admission machinery (``serve.batching``): each
+solve request enters the same bounded row-counted queue under the
+``deadline-edf`` shed policy, so an overloaded solver sheds with 429 +
+``Retry-After`` and expired requests are dropped with 504 *before* any
+search runs. Service order is earliest-deadline-first.
+
+The hit path is bounded by lookup + verify — a warm store answers in
+milliseconds regardless of how expensive the original search was. Cold
+misses run the real solve through the store's single-flight, so a
+thundering herd of identical kernels produces exactly one search no matter
+how many service workers (or hosts) share the store directory.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry
+from ..reliability.errors import InvalidInputError, SolveTimeout
+from ..serve.batching import AdmissionQueue, DeadlineExpired, Draining, InferRequest, ServeRejected
+from .solution_store import StoreNegativeEntry, resolve_store, store_key
+
+#: hard per-request kernel size ceiling (entries): parse-side bound so one
+#: fat request cannot monopolize the solver plane
+MAX_KERNEL_ENTRIES = 1 << 20
+
+
+class SolveUnavailable(ServeRejected):
+    """The key is negative-cached: a recent solve failed terminally on
+    every backend (HTTP 503 + Retry-After from the marker's TTL)."""
+
+    http_status = 503
+
+
+class SolveRequest(InferRequest):
+    """One admitted solve request: the kernel rides in ``x`` (row count =
+    kernel rows, the axis the search cost scales with), plus the quality
+    knob."""
+
+    __slots__ = ('quality',)
+
+    def __init__(self, kernel: np.ndarray, deadline_s: float | None, quality=None):
+        super().__init__(kernel, deadline_s)
+        self.quality = quality
+
+
+class SolveService:
+    """EDF-admitted solve workers over one (optional) solution store."""
+
+    def __init__(
+        self,
+        store=None,
+        backend: str = 'auto',
+        queue_cap_rows: int = 256,
+        workers: int = 1,
+        default_deadline_s: float | None = 30.0,
+        shed_policy: str = 'deadline-edf',
+        solver_options: dict | None = None,
+    ):
+        self.store = resolve_store(store)
+        self.backend = backend
+        self.solver_options = dict(solver_options or {})
+        self.default_deadline_s = default_deadline_s
+        self.queue = AdmissionQueue(queue_cap_rows, policy=shed_policy)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._worker_loop, name=f'da4ml-solve-svc-{i}', daemon=True)
+            for i in range(max(1, workers))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, kernel, quality=None, deadline_s: float | None = None) -> SolveRequest:
+        """Validate + admit one solve request; raises the serve taxonomy
+        (400/429/503) at admission time, 504 at dispatch time."""
+        if self._stop.is_set():
+            raise Draining('solve service is draining')
+        try:
+            k = np.asarray(kernel, dtype=np.float64)
+        except (ValueError, TypeError) as e:
+            raise InvalidInputError(f'kernel is not a numeric matrix: {e}') from e
+        if k.ndim != 2 or k.shape[0] == 0 or k.shape[1] == 0:
+            raise InvalidInputError(f'kernel must be a non-empty 2D matrix, got shape {k.shape}')
+        if k.size > MAX_KERNEL_ENTRIES:
+            raise InvalidInputError(f'kernel of {k.size} entries exceeds the {MAX_KERNEL_ENTRIES} ceiling')
+        if not np.all(np.isfinite(k)):
+            raise InvalidInputError('kernel contains non-finite (NaN/inf) values')
+        req = SolveRequest(k, deadline_s if deadline_s is not None else self.default_deadline_s, quality)
+        try:
+            self.queue.push(req)
+        except ServeRejected:
+            telemetry.counter('serve.solve_shed').inc()
+            raise
+        telemetry.counter('serve.solve_requests').inc()
+        return req
+
+    # -- service -------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            # max_rows=1: take one request per round (the first is always
+            # taken) so multiple service workers solve distinct keys in
+            # parallel while the queue keeps EDF order
+            batch = self.queue.take_batch(max_rows=1, window_s=0.0, stop=self._stop)
+            if not batch:
+                if self._stop.is_set():
+                    return
+                continue
+            for req in batch:
+                if req.expired():
+                    telemetry.counter('serve.solve_expired').inc()
+                    req.set_error(DeadlineExpired(f'solve request {req.id} expired before dispatch'))
+                    continue
+                try:
+                    req.set_result(self._solve_one(req), served_by=f'solve[{self.backend}]')
+                except BaseException as e:  # noqa: BLE001 - resolved into the request
+                    req.set_error(e)
+
+    def _solve_one(self, req: SolveRequest) -> dict:
+        from ..cmvm.api import solve
+        from ..reliability.orchestrator import canonical_backend
+        from ..reliability.report import SolveReport
+
+        t0 = time.perf_counter()
+        remaining = None if req.deadline is None else max(req.deadline - time.monotonic(), 0.01)
+        kw = dict(self.solver_options)
+        if req.quality is not None:
+            kw['quality'] = req.quality
+        key = store_key(req.x, self.backend, kw)
+        canon = canonical_backend(self.backend)
+        info: dict = {}
+        rep = SolveReport()
+
+        def cold():
+            # store=False: solve_through IS the store path; the cold branch
+            # must not recurse into another lookup
+            return solve(req.x, backend=self.backend, store=False, deadline=remaining, report=rep, **kw)
+
+        try:
+            if self.store is not None:
+                pipe = self.store.solve_through(
+                    key,
+                    cold,
+                    meta={'backend': canon},
+                    deadline_s=remaining,
+                    info=info,
+                    # a chain-degraded answer must not be published under
+                    # this requested-backend key (determinism is per-backend)
+                    publish_ok=lambda: rep.backend_used in (None, canon),
+                )
+            else:
+                pipe = cold()
+                info['source'] = 'solve'
+        except StoreNegativeEntry as e:
+            raise SolveUnavailable(str(e), retry_after_s=e.retry_after_s) from e
+        except SolveTimeout as e:
+            telemetry.counter('serve.solve_expired').inc()
+            raise DeadlineExpired(f'solve request {req.id}: {e}') from e
+        source = info.get('source', 'solve')
+        telemetry.counter(f'serve.solve_{"hits" if source == "store" else "misses"}').inc()
+        return {
+            'key': key,
+            'source': source,
+            'cost': float(pipe.cost),
+            'backend': info.get('backend') or self.backend,
+            'solve_ms': round((time.perf_counter() - t0) * 1e3, 3),
+            'pipeline': pipe.to_dict(),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, grace_s: float = 10.0) -> None:
+        """Drain: stop admitting, serve everything accepted, then stop the
+        workers (same contract as the serve engine)."""
+        self._stop.set()
+        deadline = time.monotonic() + grace_s
+        while self.queue.depth_requests() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        self.queue.flush(lambda: Draining('solve service stopped'))
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))

@@ -1,0 +1,786 @@
+"""Global content-addressed solution store: solve once, serve everywhere.
+
+At fleet scale most CMVM kernels are repeats — the same quantized layers
+solved again and again — yet checkpoints (``reliability.checkpoint``) are
+campaign-local. This module is the shared tier: a directory (local disk,
+NFS, GCS-fuse) mapping the *full* kernel digest + canonical solver options
+(:func:`store_key`) to a solved DAIS program, layered on the PR-1/7
+atomic-write + lease primitives. The TVM split between an ahead-of-time
+optimizer and a lightweight runtime (arxiv 1802.04799) is the precedent;
+the bit-exactness contract of the paper (arxiv 2507.04535) sets the rule
+that makes a shared cache safe: **never trust a cached byte the verifier
+has not re-validated**.
+
+Layout (one store = one directory)::
+
+    <root>/solutions/<digest[:2]>/<digest>.json   entry docs (atomic writes)
+    <root>/corrupt/<digest>.<ms>.json             quarantined bad entries
+    <root>/negative/<digest>.json                 TTL'd failed-solve markers
+    <root>/leases/<digest>.lease                  single-flight claims (.lease)
+
+Robustness model (docs/store.md):
+
+- **verify-on-read** — every entry is parsed, schema-checked, and run
+  through the ``analysis`` verifier before use; any failure (bit flip,
+  truncation, stale schema) quarantines the file to ``corrupt/`` and the
+  caller transparently re-solves. A corrupted store can cost wall clock,
+  never a wrong program.
+- **single-flight** — concurrent cold misses on one key collapse to one
+  search through a short-TTL lease (``reliability.lease``); waiters poll
+  with deadline-aware backoff and fall through to a local solve if the
+  winner dies (the steal machinery covers the crash case) or the deadline
+  nears.
+- **negative caching** — a solve that failed terminally writes a TTL'd
+  marker so a poisonous kernel cannot DoS the fleet with repeated
+  searches; the marker expires and the key becomes retryable.
+- **graceful degradation** — an unreachable or read-only store degrades to
+  the plain local-solve path behind a ``store.read``/``store.write``
+  breaker pair with one-time warnings; it never fails a solve.
+
+Fault sites (``DA4ML_FAULT_INJECT``, docs/reliability.md): ``store.read``
+(error modes = unreachable store; mode ``corrupt`` = torn read),
+``store.write`` (error modes = unwritable store; ``corrupt`` = torn entry
+on disk), ``store.verify`` (``corrupt`` = semantic in-memory mutation that
+only the verifier catches — the deterministic bit-flip drill).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Callable, NamedTuple
+
+from .. import telemetry
+from ..ir.comb import Pipeline
+from ..reliability.breaker import breaker_for
+from ..reliability.checkpoint import atomic_write_bytes, fsync_dir, kernel_digest
+from ..reliability.errors import BackendUnavailable, ReliabilityError, SolveTimeout, classify
+from ..reliability.faults import fault_active, fault_check
+from ..reliability.lease import DEFAULT_GRACE_S, claim_lease, default_owner, release_lease, renew_lease
+
+_VERSION = 1
+
+_ENV_VAR = 'DA4ML_SOLUTION_STORE'
+
+#: failed-solve markers expire after this many seconds (DA4ML_STORE_NEGATIVE_TTL_S)
+DEFAULT_NEGATIVE_TTL_S = 300.0
+
+#: single-flight lease TTL: one search window; waiters steal after expiry + grace
+DEFAULT_LEASE_TTL_S = 15.0
+
+
+class StoreEntryCorrupt(ReliabilityError):
+    """A store entry exists but failed parse/schema/verification — it is
+    quarantined, never served."""
+
+
+class StoreNegativeEntry(BackendUnavailable):
+    """The store holds a live negative-cache marker for this key: a recent
+    solve failed terminally on every backend, so re-searching now would
+    only repeat the failure. Classified ``fallback``; retry after the
+    marker's TTL."""
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+# --------------------------------------------------------------------- keys
+
+#: ``cmvm.api.solve`` signature defaults for every option that shapes the
+#: solution — applied before hashing so a sparse options dict (campaign
+#: manifests) and an explicit-defaults call (``solve()``) agree on the key
+_SOLVE_DEFAULTS: dict = {
+    'method0': 'wmc',
+    'method1': 'auto',
+    'hard_dc': -1,
+    'decompose_dc': -2,
+    'qintervals': None,
+    'latencies': None,
+    'adder_size': -1,
+    'carry_size': -1,
+    'search_all_decompose_dc': True,
+    'method0_candidates': None,
+    'n_restarts': 1,
+    'quality': None,
+}
+
+
+def canonical_solve_opts(solve_kwargs: dict | None) -> dict:
+    """Canonical (JSON-stable) form of the solver options that shape a
+    solution: signature defaults applied, qintervals listified, the quality
+    knob reduced via :func:`~..cmvm.search.spec.quality_key` (the fast
+    default drops out entirely)."""
+    from ..reliability.orchestrator import _checkpoint_opts
+
+    kw = dict(_SOLVE_DEFAULTS)
+    for k, v in (solve_kwargs or {}).items():
+        if k in _SOLVE_DEFAULTS:
+            kw[k] = v
+    opts = _checkpoint_opts(kw)
+    if opts.get('n_restarts') in (None, 0):
+        opts['n_restarts'] = 1
+    return opts
+
+
+def store_key(kernel, backend: str = 'auto', solve_kwargs: dict | None = None) -> str:
+    """The global store key: full sha256 digest over the kernel bytes, the
+    canonical solver options, and the *canonical backend name* — solves are
+    deterministic per backend, so an entry solved on ``pure-python`` must
+    never answer a ``jax`` request (byte-identity would silently break).
+    ``backend='auto'`` resolves to the backend this host would really use,
+    exactly as ``cmvm.api.solve`` does."""
+    from ..reliability.orchestrator import canonical_backend
+
+    return kernel_digest(
+        kernel,
+        {
+            'store_version': _VERSION,
+            'backend': canonical_backend(backend),
+            'solver_options': canonical_solve_opts(solve_kwargs),
+        },
+    )
+
+
+# --------------------------------------------------------------------- store
+
+
+class StoreHit(NamedTuple):
+    """One verified store read: the program plus its entry document."""
+
+    key: str
+    pipeline: Pipeline
+    doc: dict
+
+
+class _Renewer(threading.Thread):
+    """Renews the single-flight lease at ttl/3 cadence while the winner
+    searches (daemon: dies with the process, which is exactly what lets a
+    waiter steal and take over)."""
+
+    def __init__(self, lease, interval_s: float):
+        super().__init__(name=f'da4ml-store-renew-{lease.key[:8]}', daemon=True)
+        self.lease = lease
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                if not renew_lease(self.lease):
+                    return
+            except OSError:  # store went unreachable mid-solve; publish will cope
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class SolutionStore:
+    """One content-addressed solution store directory.
+
+    ``readonly=True`` (or ``DA4ML_STORE_RO=1``) serves hits but never
+    writes — no publishes, no negative markers, no single-flight
+    coordination (a reader must not create lease files on, say, a
+    snapshotted release artifact)."""
+
+    def __init__(
+        self,
+        root: str | os.PathLike,
+        negative_ttl_s: float | None = None,
+        lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+        readonly: bool | None = None,
+    ):
+        self.root = Path(root)
+        if negative_ttl_s is None:
+            try:
+                negative_ttl_s = float(os.environ.get('DA4ML_STORE_NEGATIVE_TTL_S', '') or DEFAULT_NEGATIVE_TTL_S)
+            except ValueError:
+                negative_ttl_s = DEFAULT_NEGATIVE_TTL_S
+        self.negative_ttl_s = negative_ttl_s
+        self.lease_ttl_s = lease_ttl_s
+        if readonly is None:
+            readonly = os.environ.get('DA4ML_STORE_RO', '') in ('1', 'true', 'on')
+        self.readonly = readonly
+        self.solutions_dir = self.root / 'solutions'
+        self.corrupt_dir = self.root / 'corrupt'
+        self.negative_dir = self.root / 'negative'
+        self.leases_dir = self.root / 'leases'
+
+    # -- paths ---------------------------------------------------------------
+
+    def _entry_path(self, key: str) -> Path:
+        return self.solutions_dir / key[:2] / f'{key}.json'
+
+    def _negative_path(self, key: str) -> Path:
+        return self.negative_dir / f'{key}.json'
+
+    # -- breakers ------------------------------------------------------------
+
+    @staticmethod
+    def _read_breaker():
+        return breaker_for('store.read')
+
+    @staticmethod
+    def _write_breaker():
+        return breaker_for('store.write')
+
+    def degraded(self) -> bool:
+        """True while either store breaker is open — callers skip the store
+        entirely (the one-time warning already fired)."""
+        return self._read_breaker().state == 'open' or self._write_breaker().state == 'open'
+
+    # -- read path -----------------------------------------------------------
+
+    def _quarantine(self, key: str, path: Path, reason: str) -> None:
+        """Move a bad entry to the ``corrupt/`` sidecar so it is never read
+        again; the caller re-solves. Best-effort on read-only filesystems
+        (the entry then stays, fails verification on every read, and every
+        read falls through to a local solve — slow, never wrong)."""
+        telemetry.counter('store.corrupt_quarantined').inc()
+        telemetry.instant('store.quarantine', key=key[:16], reason=reason[:200])
+        telemetry.warn_once(
+            f'store.corrupt.{key[:16]}',
+            f'solution store entry {key[:16]}… failed verification ({reason[:120]}); quarantined, re-solving',
+            logger='store',
+        )
+        dest = self.corrupt_dir / f'{key}.{int(time.time() * 1000)}.json'
+        try:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            os.replace(path, dest)
+            fsync_dir(dest.parent)
+        except OSError:
+            pass
+
+    def _read(self, key: str) -> StoreHit | None:
+        """Read + schema-check + verify one entry; quarantine on any
+        failure. No hit/miss accounting (that is :meth:`lookup`'s job — the
+        single-flight poll loop reads without skewing the hit ratio)."""
+        br = self._read_breaker()
+        if not br.allow():
+            telemetry.warn_once(
+                'store.read.breaker',
+                f'solution store {self.root} unreachable (store.read breaker open); degrading to local solves',
+                logger='store',
+            )
+            return None
+        path = self._entry_path(key)
+        try:
+            fault_check('store.read')
+            raw = path.read_bytes()
+        except FileNotFoundError:
+            br.record_success()
+            return None
+        except Exception as e:  # noqa: BLE001 - any store I/O failure degrades, never propagates
+            br.record_failure()
+            telemetry.counter('store.read_errors').inc()
+            telemetry.warn_once(
+                'store.read.error',
+                f'solution store read failed ({type(e).__name__}: {e}); degrading to local solves',
+                logger='store',
+            )
+            return None
+        br.record_success()
+        if fault_active('store.read', 'corrupt'):
+            raw = raw[: max(1, len(raw) // 2)]  # torn/truncated read drill
+        try:
+            doc = json.loads(raw)
+            if not isinstance(doc, dict) or 'pipeline' not in doc:
+                raise StoreEntryCorrupt('not a store entry document')
+            if doc.get('version') != _VERSION:
+                raise StoreEntryCorrupt(f'stale schema version {doc.get("version")!r}')
+            if doc.get('key') not in (None, key):
+                raise StoreEntryCorrupt(f'key mismatch: entry claims {str(doc.get("key"))[:16]}…')
+            if fault_active('store.verify', 'corrupt'):
+                # semantic bit-flip drill: a mutation that parses fine and
+                # only the verifier catches (out_idx past the buffer end)
+                doc['pipeline']['stages'][-1]['out_idxs'][0] = 10**6
+            pipe = Pipeline.from_dict(doc['pipeline'], verify=False)
+            from ..analysis import verify
+
+            res = verify(pipe)
+            if not res.ok:
+                raise StoreEntryCorrupt(f'verifier rejected entry: {res.errors[0]}')
+        except Exception as e:  # noqa: BLE001 - any bad byte means quarantine
+            self._quarantine(key, path, f'{type(e).__name__}: {e}')
+            return None
+        if not self.readonly:
+            try:
+                os.utime(path)  # LRU signal for gc (best-effort)
+            except OSError:
+                pass
+        return StoreHit(key=key, pipeline=pipe, doc=doc)
+
+    def lookup(self, key: str) -> StoreHit | None:
+        """One accounted store probe: verified hit or None (miss/degraded)."""
+        t0 = time.perf_counter()
+        hit = self._read(key)
+        telemetry.histogram('store.lookup_s').observe(time.perf_counter() - t0)
+        telemetry.counter('store.hits' if hit is not None else 'store.misses').inc()
+        return hit
+
+    # -- write path ----------------------------------------------------------
+
+    def publish(self, key: str, pipeline: Pipeline, meta: dict | None = None) -> bool:
+        """Write one solved entry (atomic + durable). Returns False — with a
+        one-time warning, never an exception — when the store is read-only,
+        breaker-open, or the write fails. Publishes are idempotent: a solve
+        is deterministic per backend, so concurrent publishers rewrite
+        identical bytes."""
+        if self.readonly:
+            telemetry.warn_once(
+                'store.readonly',
+                f'solution store {self.root} is read-only; solves are not published',
+                logger='store',
+            )
+            return False
+        br = self._write_breaker()
+        if not br.allow():
+            telemetry.warn_once(
+                'store.write.breaker',
+                f'solution store {self.root} unwritable (store.write breaker open); solves are not published',
+                logger='store',
+            )
+            return False
+        doc = {
+            'version': _VERSION,
+            'key': key,
+            'cost': float(pipeline.cost),
+            'created_at': round(time.time(), 3),
+            **{k: v for k, v in (meta or {}).items() if k not in ('version', 'key', 'pipeline')},
+            'pipeline': pipeline.to_dict(),
+        }
+        payload = json.dumps(doc, sort_keys=True)
+        if fault_active('store.write', 'corrupt'):
+            payload = payload[: max(1, len(payload) // 2)]  # torn write drill
+        try:
+            fault_check('store.write')
+            atomic_write_bytes(self._entry_path(key), payload.encode())
+        except Exception as e:  # noqa: BLE001 - any store I/O failure degrades, never propagates
+            br.record_failure()
+            telemetry.counter('store.write_errors').inc()
+            telemetry.warn_once(
+                'store.write.error',
+                f'solution store publish failed ({type(e).__name__}: {e}); continuing without the store',
+                logger='store',
+            )
+            return False
+        br.record_success()
+        telemetry.counter('store.publishes').inc()
+        try:  # a successful solve clears any stale negative marker
+            self._negative_path(key).unlink()
+        except OSError:
+            pass
+        return True
+
+    # -- negative cache ------------------------------------------------------
+
+    def negative_lookup(self, key: str) -> dict | None:
+        """A live (unexpired) failed-solve marker, or None. Expired markers
+        are opportunistically removed."""
+        try:
+            doc = json.loads(self._negative_path(key).read_text())
+            expires_at = float(doc['expires_at'])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        if time.time() >= expires_at:
+            if not self.readonly:
+                try:
+                    self._negative_path(key).unlink()
+                except OSError:
+                    pass
+            return None
+        telemetry.counter('store.negative_hits').inc()
+        return doc
+
+    def publish_negative(self, key: str, error: BaseException | str, ttl_s: float | None = None) -> bool:
+        """Record a terminal solve failure so the fleet stops re-searching
+        this key until the TTL passes."""
+        if self.readonly or not self._write_breaker().allow():
+            return False
+        ttl = self.negative_ttl_s if ttl_s is None else ttl_s
+        doc = {
+            'version': _VERSION,
+            'key': key,
+            'error': (f'{type(error).__name__}: {error}' if isinstance(error, BaseException) else str(error))[:300],
+            'created_at': round(time.time(), 3),
+            'expires_at': round(time.time() + ttl, 3),
+        }
+        try:
+            atomic_write_bytes(self._negative_path(key), json.dumps(doc, sort_keys=True).encode())
+        except OSError:
+            self._write_breaker().record_failure()
+            return False
+        self._write_breaker().record_success()
+        telemetry.counter('store.negative_publishes').inc()
+        return True
+
+    # -- single-flight solve -------------------------------------------------
+
+    def solve_through(
+        self,
+        key: str,
+        cold_solve: Callable[[], Pipeline],
+        meta: dict | None = None,
+        deadline_s: float | None = None,
+        info: dict | None = None,
+        publish_ok: Callable[[], bool] | None = None,
+    ) -> Pipeline:
+        """The store-mediated solve: verified hit, else single-flighted cold
+        solve + publish.
+
+        ``cold_solve`` runs the real search (it must NOT consult the store
+        again). ``info`` (optional dict) receives ``source`` (``'store'`` /
+        ``'solve'``) and ``singleflight_wait`` for callers that report
+        provenance. ``publish_ok`` (evaluated after a successful cold solve)
+        vetoes the publish — the orchestrator's fallback chain may answer
+        from a *different* backend than the key encodes, and determinism is
+        per-backend, so such a result must not be published under this key.
+        Raises :class:`StoreNegativeEntry` on a live negative marker;
+        everything else degrades to ``cold_solve()``."""
+        if info is None:
+            info = {}
+        hit = self.lookup(key)
+        if hit is not None:
+            info.update(source='store', backend=hit.doc.get('backend'), cost=hit.doc.get('cost'))
+            return hit.pipeline
+        neg = self.negative_lookup(key)
+        if neg is not None:
+            remaining = max(float(neg.get('expires_at', 0.0)) - time.time(), 0.0)
+            raise StoreNegativeEntry(
+                f'solve of {key[:16]}… recently failed on every backend ({neg.get("error")}); '
+                f'negative-cache marker expires in {remaining:.0f}s',
+                retry_after_s=remaining,
+            )
+        if self.readonly or self.degraded():
+            # no coordination possible/worthwhile: plain local solve
+            result = cold_solve()
+            info['source'] = 'solve'
+            if publish_ok is None or publish_ok():
+                self.publish(key, result, meta=meta)
+            return result
+
+        deadline_t = time.monotonic() + deadline_s if deadline_s is not None and deadline_s > 0 else None
+        grace = max(DEFAULT_GRACE_S, self.lease_ttl_s / 3)
+        backoff = 0.05
+        waited = False
+        while True:
+            lease = None
+            try:
+                # per-THREAD owner: the default (host:pid) owner would let
+                # every thread of one process adopt the same live lease and
+                # the in-process herd would not collapse
+                lease = claim_lease(
+                    self.leases_dir,
+                    key,
+                    owner=f'{default_owner()}:t{threading.get_ident()}',
+                    ttl_s=self.lease_ttl_s,
+                    grace_s=grace,
+                )
+            except OSError:
+                break  # store went unreachable between lookup and claim
+            if lease is not None:
+                return self._solve_as_winner(key, lease, cold_solve, meta, info, publish_ok)
+            # waiter: someone else is searching this key right now
+            if not waited:
+                waited = True
+                info['singleflight_wait'] = True
+                telemetry.counter('store.singleflight_waits').inc()
+            if deadline_t is not None and time.monotonic() + backoff >= deadline_t - 0.05:
+                telemetry.counter('store.singleflight_fallthroughs').inc()
+                break  # deadline-aware fall-through: solve locally, now
+            time.sleep(backoff)
+            backoff = min(backoff * 1.6, 0.4)
+            hit = self._read(key)
+            if hit is not None:
+                telemetry.counter('store.hits').inc()
+                info.update(source='store', backend=hit.doc.get('backend'), cost=hit.doc.get('cost'))
+                return hit.pipeline
+            neg = self.negative_lookup(key)
+            if neg is not None:
+                raise StoreNegativeEntry(
+                    f'solve of {key[:16]}… failed on every backend ({neg.get("error")})',
+                    retry_after_s=max(float(neg.get('expires_at', 0.0)) - time.time(), 0.0),
+                )
+            # loop: the winner's lease may have expired (it died) — the next
+            # claim_lease steals it and this caller becomes the winner
+        result = cold_solve()
+        info['source'] = 'solve'
+        if publish_ok is None or publish_ok():
+            self.publish(key, result, meta=meta)
+        return result
+
+    def _solve_as_winner(self, key, lease, cold_solve, meta, info, publish_ok=None) -> Pipeline:
+        renewer = _Renewer(lease, interval_s=self.lease_ttl_s / 3.0)
+        renewer.start()
+        try:
+            hit = self._read(key)  # published between our miss and the claim?
+            if hit is not None:
+                telemetry.counter('store.hits').inc()
+                info.update(source='store', backend=hit.doc.get('backend'), cost=hit.doc.get('cost'))
+                return hit.pipeline
+            try:
+                result = cold_solve()
+            except BaseException as exc:
+                # terminal failures become negative markers; a blown
+                # deadline does not (another caller with more budget may
+                # still succeed)
+                if not isinstance(exc, SolveTimeout) and classify(exc) in ('fatal', 'fallback'):
+                    self.publish_negative(key, exc)
+                raise
+            info['source'] = 'solve'
+            if publish_ok is None or publish_ok():
+                self.publish(key, result, meta=meta)
+            return result
+        finally:
+            renewer.stop()
+            try:
+                release_lease(lease)
+            except OSError:
+                pass
+
+    # -- maintenance ---------------------------------------------------------
+
+    def _entries(self) -> list[tuple[Path, os.stat_result]]:
+        out = []
+        try:
+            shards = sorted(os.scandir(self.solutions_dir), key=lambda e: e.name)
+        except OSError:
+            return out
+        for shard in shards:
+            if not shard.is_dir():
+                continue
+            try:
+                for e in os.scandir(shard.path):
+                    if e.name.endswith('.json') and not e.name.startswith('.'):
+                        try:
+                            out.append((Path(e.path), e.stat()))
+                        except OSError:
+                            continue
+            except OSError:
+                continue
+        return out
+
+    def occupancy(self) -> dict:
+        """Entry/byte counts (the /statusz store panel; scrape-safe)."""
+        entries = self._entries()
+
+        def _count(d: Path) -> int:
+            try:
+                return sum(1 for e in os.scandir(d) if e.name.endswith('.json'))
+            except OSError:
+                return 0
+
+        return {
+            'root': str(self.root),
+            'entries': len(entries),
+            'bytes': int(sum(st.st_size for _, st in entries)),
+            'negative': _count(self.negative_dir),
+            'corrupt': _count(self.corrupt_dir),
+            'readonly': self.readonly,
+        }
+
+    def stats(self) -> dict:
+        """Occupancy + this process's hit/miss accounting (cache CLI)."""
+        from ..telemetry.metrics import metrics_snapshot
+
+        snap = metrics_snapshot()
+
+        def _c(name: str) -> float:
+            m = snap.get(name)
+            return float(m.get('value', 0.0)) if m else 0.0
+
+        hits, misses = _c('store.hits'), _c('store.misses')
+        out = self.occupancy()
+        out.update(
+            {
+                'hits': int(hits),
+                'misses': int(misses),
+                'hit_ratio': round(hits / (hits + misses), 4) if hits + misses else None,
+                'negative_hits': int(_c('store.negative_hits')),
+                'corrupt_quarantined': int(_c('store.corrupt_quarantined')),
+                'singleflight_waits': int(_c('store.singleflight_waits')),
+                'breakers': {
+                    'store.read': self._read_breaker().state,
+                    'store.write': self._write_breaker().state,
+                },
+            }
+        )
+        return out
+
+    def verify_all(self) -> dict:
+        """Re-verify every entry (``da4ml-tpu cache verify``); bad entries
+        are quarantined exactly as a read would."""
+        checked = ok = 0
+        for path, _ in self._entries():
+            checked += 1
+            if self._read(path.name[: -len('.json')]) is not None:
+                ok += 1
+        return {'checked': checked, 'ok': ok, 'quarantined': checked - ok}
+
+    def gc(self, max_bytes: int | None = None, max_age_s: float | None = None) -> dict:
+        """Lease-guarded LRU eviction: drop entries older than ``max_age_s``
+        and then the least-recently-used until under ``max_bytes``. The run
+        is serialized on a ``__gc__`` lease; each victim is evicted only
+        under its own single-flight lease, so gc never unlinks an entry a
+        solver is concurrently publishing or about to serve. Expired
+        negative markers and old quarantine files are purged too."""
+        report = {'evicted': 0, 'freed_bytes': 0, 'negatives_purged': 0, 'corrupt_purged': 0, 'skipped_live': 0}
+        if self.readonly:
+            report['skipped'] = 'store is read-only'
+            return report
+        guard = claim_lease(self.leases_dir, '__gc__', ttl_s=max(self.lease_ttl_s, 30.0))
+        if guard is None:
+            report['skipped'] = 'another gc run holds the lock'
+            return report
+        now = time.time()
+        try:
+            # expired negative markers
+            try:
+                for e in os.scandir(self.negative_dir):
+                    try:
+                        doc = json.loads(Path(e.path).read_text())
+                        if now >= float(doc.get('expires_at', 0.0)):
+                            os.unlink(e.path)
+                            report['negatives_purged'] += 1
+                    except (OSError, ValueError, TypeError):
+                        continue
+            except OSError:
+                pass
+            # old quarantine sidecars age out with max_age_s
+            if max_age_s is not None:
+                try:
+                    for e in os.scandir(self.corrupt_dir):
+                        try:
+                            if now - e.stat().st_mtime > max_age_s:
+                                os.unlink(e.path)
+                                report['corrupt_purged'] += 1
+                        except OSError:
+                            continue
+                except OSError:
+                    pass
+            entries = sorted(self._entries(), key=lambda t: t[1].st_mtime)  # oldest first
+            total = sum(st.st_size for _, st in entries)
+            report['entries_before'], report['bytes_before'] = len(entries), int(total)
+            victims: list[tuple[Path, os.stat_result]] = []
+            if max_age_s is not None:
+                victims += [(p, st) for p, st in entries if now - st.st_mtime > max_age_s]
+            if max_bytes is not None and total > max_bytes:
+                over = total - sum(st.st_size for _, st in victims)
+                for p, st in entries:
+                    if over <= max_bytes:
+                        break
+                    if (p, st) not in victims:
+                        victims.append((p, st))
+                        over -= st.st_size
+            for path, st in victims:
+                key = path.name[: -len('.json')]
+                lease = claim_lease(self.leases_dir, key, ttl_s=5.0)
+                if lease is None:
+                    report['skipped_live'] += 1  # a solver holds this key right now
+                    continue
+                try:
+                    path.unlink()
+                    report['evicted'] += 1
+                    report['freed_bytes'] += int(st.st_size)
+                except OSError:
+                    pass
+                finally:
+                    release_lease(lease)
+            telemetry.counter('store.gc_evictions').inc(report['evicted'])
+        finally:
+            release_lease(guard)
+        report['entries_after'] = report['entries_before'] - report['evicted']
+        report['bytes_after'] = report['bytes_before'] - report['freed_bytes']
+        return report
+
+
+# ----------------------------------------------------------------- resolution
+
+_stores: dict[str, SolutionStore] = {}
+_stores_lock = threading.Lock()
+
+
+def store_at(path: str | os.PathLike, **kw) -> SolutionStore:
+    """Process-wide :class:`SolutionStore` per resolved directory."""
+    key = str(Path(path).expanduser().resolve())
+    with _stores_lock:
+        store = _stores.get(key)
+        if store is None:
+            _stores[key] = store = SolutionStore(key, **kw)
+        return store
+
+
+def default_store() -> SolutionStore | None:
+    """The ``DA4ML_SOLUTION_STORE`` store, or None when unset."""
+    env = os.environ.get(_ENV_VAR, '').strip()
+    return store_at(env) if env else None
+
+
+def resolve_store(store) -> SolutionStore | None:
+    """Normalize a ``store=`` argument: None → the env-configured default,
+    ``False`` → disabled (even with the env set — the cold-solve escape
+    hatch), a path → opened, a :class:`SolutionStore` → itself."""
+    if store is False:
+        return None
+    if store is None:
+        return default_store()
+    if isinstance(store, SolutionStore):
+        return store
+    return store_at(store)
+
+
+def reset_store_registry() -> None:
+    """Drop cached store handles (test isolation)."""
+    with _stores_lock:
+        _stores.clear()
+
+
+# ------------------------------------------------------------------- health
+
+
+def store_health() -> dict | None:
+    """The /healthz ``store`` check (None when no store was opened in this
+    process). Resolved via ``sys.modules`` by ``telemetry.obs.health`` so a
+    scrape never imports this module."""
+    with _stores_lock:
+        stores = list(_stores.values())
+    if not stores:
+        return None
+    breakers = {n: breaker_for(n).state for n in ('store.read', 'store.write')}
+    degraded = any(s == 'open' for s in breakers.values())
+    return {
+        'status': 'degraded' if degraded else 'ok',
+        'breakers': breakers,
+        'stores': [s.occupancy() for s in stores],
+    }
+
+
+def store_status() -> dict | None:
+    """The /statusz ``store`` panel: occupancy + hit ratio (None when no
+    store was opened in this process)."""
+    with _stores_lock:
+        stores = list(_stores.values())
+    if not stores:
+        return None
+    from ..telemetry.metrics import metrics_snapshot
+
+    snap = metrics_snapshot()
+
+    def _c(name: str) -> float:
+        m = snap.get(name)
+        return float(m.get('value', 0.0)) if m else 0.0
+
+    hits, misses = _c('store.hits'), _c('store.misses')
+    return {
+        'stores': [s.occupancy() for s in stores],
+        'hits': int(hits),
+        'misses': int(misses),
+        'negative_hits': int(_c('store.negative_hits')),
+        'corrupt_quarantined': int(_c('store.corrupt_quarantined')),
+        'singleflight_waits': int(_c('store.singleflight_waits')),
+        'hit_ratio': round(hits / (hits + misses), 4) if hits + misses else None,
+    }

@@ -7,7 +7,9 @@
 - **campaign heartbeat**: ``solve_many`` beats ``telemetry.beat('campaign')``
   per kernel; an in-progress campaign whose last beat is older than
   ``DA4ML_HEALTH_STALL_S`` (default 120 s) indicates a stalled worker;
-- **compile-cache hit ratio** (informational, never degrades health).
+- **compile-cache hit ratio** (informational, never degrades health);
+- **solution store** (when one is open in this process): an open
+  ``store.read``/``store.write`` breaker degrades health.
 
 ``/statusz`` is the wide-angle JSON: run-mode autotune decisions,
 scheduler bucket occupancy, deadline workers, active spans, device
@@ -62,6 +64,31 @@ def _serve_check() -> dict | None:
         return None
     try:
         return mod.serve_health()
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
+def _store_check() -> dict | None:
+    """Solution-store health (breaker pair + occupancy) of any store opened
+    in this process. Resolved via ``sys.modules`` — a scrape never imports
+    the store; None when no store exists in this process."""
+    mod = sys.modules.get('da4ml_tpu.store.solution_store')
+    if mod is None:
+        return None
+    try:
+        return mod.store_health()
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
+def _store_status() -> dict | None:
+    """Occupancy + hit ratio of any solution store opened in this process
+    (``/statusz``)."""
+    mod = sys.modules.get('da4ml_tpu.store.solution_store')
+    if mod is None:
+        return None
+    try:
+        return mod.store_status()
     except Exception:  # pragma: no cover - never fail a scrape
         return None
 
@@ -157,6 +184,9 @@ def health_snapshot(snap: dict | None = None) -> dict:
     serve = _serve_check()
     if serve is not None:
         checks['serve'] = serve
+    store = _store_check()
+    if store is not None:
+        checks['store'] = store
     degraded = any(c['status'] == 'degraded' for c in checks.values())
     return {
         'status': 'degraded' if degraded else 'ok',
@@ -227,6 +257,7 @@ def status_snapshot() -> dict:
         'runtime': run,
         'serve': serve,
         'serve_metrics': serve_metrics,
+        'store': _store_status(),
         'deadline_workers': deadline_workers,
         'devices': _device_inventory(),
     }

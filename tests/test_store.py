@@ -1,0 +1,591 @@
+"""Global solution store suite (docs/store.md).
+
+Fast, CPU-only (``pure-python`` backend throughout, so solves are
+deterministic without device warmup): key canonicalization, cold→warm
+byte-identity in- and cross-process, verify-on-read quarantine under three
+corruption shapes, thundering-herd single-flight, winner-death recovery,
+negative-cache TTL, read-only/unreachable degradation behind the breaker
+pair, lease-guarded gc under a concurrent reader, the ``/v1/solve``
+service + HTTP plane, the campaign publish hook, and the cache CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from da4ml_tpu import telemetry
+from da4ml_tpu.cmvm.api import solve
+from da4ml_tpu.reliability.breaker import reset_all_breakers
+from da4ml_tpu.reliability.errors import BackendUnavailable, SolveTimeout
+from da4ml_tpu.reliability.faults import fault_injection
+from da4ml_tpu.reliability.lease import claim_lease
+from da4ml_tpu.store import (
+    SolutionStore,
+    SolveService,
+    StoreNegativeEntry,
+    canonical_solve_opts,
+    reset_store_registry,
+    resolve_store,
+    store_at,
+    store_key,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+BACKEND = 'pure-python'
+
+
+def _kernel(seed=0, dim=5, bits=3):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 2**bits, (dim, dim)) * rng.choice([-1.0, 1.0], (dim, dim))).astype(np.float64)
+
+
+def _blob(pipe) -> str:
+    return json.dumps(pipe.to_dict(), sort_keys=True)
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    from da4ml_tpu.telemetry.metrics import enable_metrics, reset_metrics
+
+    enable_metrics()
+    reset_metrics()
+    reset_all_breakers()
+    reset_store_registry()
+    yield
+    reset_all_breakers()
+    reset_store_registry()
+
+
+def _counter(name: str) -> float:
+    m = telemetry.metrics_snapshot().get(name)
+    return float(m.get('value', 0.0)) if m else 0.0
+
+
+# ------------------------------------------------------------------- keys
+
+
+def test_store_key_full_digest_and_canonicalization():
+    k = _kernel()
+    key = store_key(k, BACKEND)
+    assert len(key) == 64  # full sha256, no truncation
+    # sparse options (campaign manifests) and explicit signature defaults
+    # (api calls) must agree on the key
+    assert store_key(k, BACKEND, {}) == store_key(k, BACKEND, {'method0': 'wmc', 'n_restarts': 1, 'quality': 'fast'})
+    # but an option that shapes the solution changes it
+    assert store_key(k, BACKEND, {'n_restarts': 3}) != key
+    # determinism is per backend: same kernel, different backend → different key
+    assert store_key(k, 'jax') != key
+
+
+def test_canonical_solve_opts_quality_roundtrip():
+    a = canonical_solve_opts({'quality': 'search'})
+    b = canonical_solve_opts({'quality': a['quality']})  # dict form round-trips
+    assert a == b
+    assert 'quality' not in canonical_solve_opts({'quality': 'fast'})  # fast drops out
+
+
+# ------------------------------------------------------- cold→warm identity
+
+
+def test_cold_warm_byte_identity(tmp_path):
+    k = _kernel(1)
+    ref = solve(k, backend=BACKEND, store=False)
+    cold = solve(k, backend=BACKEND, store=tmp_path)
+    warm = solve(k, backend=BACKEND, store=tmp_path)
+    assert _blob(ref) == _blob(cold) == _blob(warm)
+    assert _counter('store.misses') == 1 and _counter('store.hits') == 1
+    assert store_at(tmp_path).occupancy()['entries'] == 1
+
+
+def test_warm_hit_across_processes(tmp_path):
+    k = _kernel(2)
+    ref = solve(k, backend=BACKEND, store=tmp_path)  # publishes
+    # a separate process must hit without ever running a search: its cold
+    # path raises, so returning at all proves the store answered
+    code = (
+        'import json, numpy as np\n'
+        'from da4ml_tpu.store import store_at, store_key\n'
+        f'k = np.asarray({k.tolist()!r}, dtype=np.float64)\n'
+        f'store = store_at({str(tmp_path)!r})\n'
+        'def cold():\n'
+        '    raise AssertionError("cross-process warm hit ran a search")\n'
+        f'pipe = store.solve_through(store_key(k, {BACKEND!r}), cold)\n'
+        'print(json.dumps(pipe.to_dict(), sort_keys=True))\n'
+    )
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=str(REPO_ROOT))
+    out = subprocess.run([sys.executable, '-c', code], env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert out.stdout.strip().splitlines()[-1] == _blob(ref)
+
+
+def test_env_var_wires_solve_through_the_store(tmp_path, monkeypatch):
+    monkeypatch.setenv('DA4ML_SOLUTION_STORE', str(tmp_path))
+    k = _kernel(3)
+    ref = solve(k, backend=BACKEND, store=False)  # store=False escapes even with env set
+    assert store_at(tmp_path).occupancy()['entries'] == 0
+    warm_path = solve(k, backend=BACKEND)
+    assert store_at(tmp_path).occupancy()['entries'] == 1
+    assert _blob(warm_path) == _blob(ref)
+
+
+# ------------------------------------------------------- verify-on-read
+
+
+def test_truncated_entry_quarantined_and_resolved(tmp_path):
+    k = _kernel(4)
+    ref = solve(k, backend=BACKEND, store=tmp_path)
+    store = store_at(tmp_path)
+    key = store_key(k, BACKEND)
+    path = store._entry_path(key)
+    path.write_bytes(path.read_bytes()[:40])  # torn write / bit rot
+    again = solve(k, backend=BACKEND, store=tmp_path)  # transparently re-solves
+    assert _blob(again) == _blob(ref)
+    assert store.occupancy()['corrupt'] == 1
+    assert _counter('store.corrupt_quarantined') == 1
+    assert json.loads(path.read_bytes())['key'] == key  # republished clean
+
+
+def test_semantic_bitflip_caught_by_verifier(tmp_path):
+    k = _kernel(5)
+    ref = solve(k, backend=BACKEND, store=tmp_path)
+    store = store_at(tmp_path)
+    # store.verify=corrupt mutates the parsed doc in-memory: it parses and
+    # schema-checks fine; ONLY the DAIS verifier can reject it
+    with fault_injection('store.verify=corrupt:1'):
+        again = solve(k, backend=BACKEND, store=tmp_path)
+    assert _blob(again) == _blob(ref)
+    assert store.occupancy()['corrupt'] == 1
+
+
+def test_wrong_key_entry_quarantined(tmp_path):
+    k, other = _kernel(6), _kernel(7)
+    solve(other, backend=BACKEND, store=tmp_path)
+    store = store_at(tmp_path)
+    key = store_key(k, BACKEND)
+    # an entry claiming a different key (misplaced file) must never serve
+    src = store._entry_path(store_key(other, BACKEND))
+    dst = store._entry_path(key)
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_bytes(src.read_bytes())
+    assert store.lookup(key) is None
+    assert store.occupancy()['corrupt'] == 1
+
+
+# ------------------------------------------------------- single-flight
+
+
+def test_thundering_herd_single_search(tmp_path):
+    store = SolutionStore(tmp_path, lease_ttl_s=10.0)
+    k = _kernel(8)
+    key = store_key(k, BACKEND)
+    searches = []
+    lock = threading.Lock()
+
+    def cold():
+        with lock:
+            searches.append(threading.get_ident())
+        time.sleep(0.2)  # hold the herd long enough that everyone collides
+        return solve(k, backend=BACKEND, store=False)
+
+    results: list = [None] * 6
+    barrier = threading.Barrier(6)
+
+    def worker(i):
+        barrier.wait()
+        results[i] = _blob(store.solve_through(key, cold))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert len(searches) == 1  # the herd collapsed to one search
+    assert len(set(results)) == 1 and results[0] is not None
+    assert _counter('store.singleflight_waits') >= 1
+
+
+def test_winner_death_recovered_by_steal(tmp_path):
+    store = SolutionStore(tmp_path, lease_ttl_s=0.4)
+    k = _kernel(9)
+    key = store_key(k, BACKEND)
+    # a "winner" that died mid-solve: a claimed lease nobody ever renews
+    dead = claim_lease(store.leases_dir, key, owner='dead-winner', ttl_s=0.4)
+    assert dead is not None
+    t0 = time.monotonic()
+    pipe = store.solve_through(key, lambda: solve(k, backend=BACKEND, store=False))
+    assert _blob(pipe) == _blob(solve(k, backend=BACKEND, store=False))
+    assert time.monotonic() - t0 > 0.3  # actually waited for the corpse's ttl
+    assert store.occupancy()['entries'] == 1
+
+
+def test_deadline_fallthrough_solves_locally(tmp_path):
+    store = SolutionStore(tmp_path, lease_ttl_s=30.0)
+    k = _kernel(10)
+    key = store_key(k, BACKEND)
+    blocker = claim_lease(store.leases_dir, key, owner='slow-winner', ttl_s=30.0)
+    assert blocker is not None
+    pipe = store.solve_through(key, lambda: solve(k, backend=BACKEND, store=False), deadline_s=0.5)
+    assert pipe is not None
+    assert _counter('store.singleflight_fallthroughs') == 1
+
+
+# ------------------------------------------------------- negative cache
+
+
+def test_negative_cache_blocks_then_expires(tmp_path):
+    store = SolutionStore(tmp_path, negative_ttl_s=0.5)
+    key = store_key(_kernel(11), BACKEND)
+    calls = []
+
+    def poisoned():
+        calls.append(1)
+        raise ValueError('kernel is cursed')  # classify → fatal
+
+    with pytest.raises(ValueError):
+        store.solve_through(key, poisoned)
+    # the failure is negative-cached: no re-search, classified fallback
+    with pytest.raises(StoreNegativeEntry) as ei:
+        store.solve_through(key, poisoned)
+    assert len(calls) == 1 and ei.value.retry_after_s <= 0.5
+    assert isinstance(ei.value, BackendUnavailable)
+    assert _counter('store.negative_hits') == 1
+    time.sleep(0.6)  # marker expires → the key is retryable again
+    with pytest.raises(ValueError):
+        store.solve_through(key, poisoned)
+    assert len(calls) == 2
+
+
+def test_deadline_timeout_is_not_negative_cached(tmp_path):
+    store = SolutionStore(tmp_path)
+    key = store_key(_kernel(12), BACKEND)
+
+    def starved():
+        raise SolveTimeout('deadline blown')
+
+    with pytest.raises(SolveTimeout):
+        store.solve_through(key, starved)
+    assert store.occupancy()['negative'] == 0  # a caller with more budget may succeed
+
+
+# ------------------------------------------------------- degradation
+
+
+def test_unreachable_store_degrades_to_local_solve(tmp_path):
+    k = _kernel(13)
+    ref = solve(k, backend=BACKEND, store=False)
+    with fault_injection('store.read=unavailable'):
+        for _ in range(4):  # breaker opens at 3 failures; solves never fail
+            assert _blob(solve(k, backend=BACKEND, store=tmp_path)) == _blob(ref)
+    from da4ml_tpu.store import store_health
+
+    health = store_health()
+    assert health['status'] == 'degraded' and health['breakers']['store.read'] == 'open'
+    # /healthz carries the store check and flips to degraded
+    from da4ml_tpu.telemetry.obs.health import health_snapshot, status_snapshot
+
+    doc = health_snapshot()
+    assert doc['status'] == 'degraded' and doc['checks']['store']['status'] == 'degraded'
+    assert status_snapshot()['store'] is not None
+    assert _counter('store.read_errors') >= 3
+
+
+def test_unwritable_store_serves_hits_but_never_fails(tmp_path):
+    k = _kernel(14)
+    ref = solve(k, backend=BACKEND, store=tmp_path)  # publish while healthy
+    with fault_injection('store.write=error'):
+        warm = solve(k, backend=BACKEND, store=tmp_path)  # hit path untouched
+        assert _blob(warm) == _blob(ref)
+        k2 = _kernel(15)
+        cold = solve(k2, backend=BACKEND, store=tmp_path)  # publish fails silently
+        assert _blob(cold) == _blob(solve(k2, backend=BACKEND, store=False))
+    assert store_at(tmp_path).occupancy()['entries'] == 1
+    assert _counter('store.write_errors') >= 1
+
+
+def test_readonly_store_serves_hits_without_writing(tmp_path):
+    k = _kernel(16)
+    ref = solve(k, backend=BACKEND, store=tmp_path)
+    reset_store_registry()
+    ro = SolutionStore(tmp_path, readonly=True)
+    hit = ro.lookup(store_key(k, BACKEND))
+    assert hit is not None and _blob(hit.pipeline) == _blob(ref)
+    k2 = _kernel(17)
+    pipe = ro.solve_through(store_key(k2, BACKEND), lambda: solve(k2, backend=BACKEND, store=False))
+    assert pipe is not None
+    assert ro.occupancy()['entries'] == 1  # nothing new written
+    assert not ro.leases_dir.exists() or not list(ro.leases_dir.iterdir())  # no lease litter
+
+
+def test_degraded_backend_result_not_published(tmp_path, monkeypatch):
+    monkeypatch.delenv('DA4ML_SOLVE_FALLBACK', raising=False)
+    k = _kernel(18)
+    # request native-threads, but it is faulted away: the orchestrator
+    # degrades to pure-python — publishing THAT under the native key would
+    # silently break per-backend byte-identity
+    with fault_injection('cmvm.native=unavailable'):
+        pipe = solve(k, backend='native-threads', store=tmp_path)
+    assert pipe is not None
+    assert store_at(tmp_path).occupancy()['entries'] == 0
+
+
+# ------------------------------------------------------------------- gc
+
+
+def test_gc_age_and_size_eviction_with_lease_guard(tmp_path):
+    store = SolutionStore(tmp_path)
+    kernels = [_kernel(20 + i) for i in range(4)]
+    for k in kernels:
+        solve(k, backend=BACKEND, store=store)
+    keys = [store_key(k, BACKEND) for k in kernels]
+    old = time.time() - 3600
+    for key in keys[:2]:
+        os.utime(store._entry_path(key), (old, old))
+    live = claim_lease(store.leases_dir, keys[0], owner='solver', ttl_s=30.0)  # a solver holds key 0
+    report = store.gc(max_age_s=600)
+    assert report['evicted'] == 1 and report['skipped_live'] == 1  # key 1 evicted, key 0 protected
+    assert store._entry_path(keys[0]).exists() and not store._entry_path(keys[1]).exists()
+    from da4ml_tpu.reliability.lease import release_lease
+
+    release_lease(live)
+    # size-based LRU: shrink to one entry's worth of bytes
+    sizes = [store._entry_path(k).stat().st_size for k in (keys[0], keys[2], keys[3])]
+    report = store.gc(max_bytes=max(sizes) + 1)
+    assert store.occupancy()['entries'] == 1
+    assert _counter('store.gc_evictions') >= 2
+
+
+def test_gc_under_concurrent_reader(tmp_path):
+    store = SolutionStore(tmp_path)
+    kernels = [_kernel(30 + i) for i in range(3)]
+    refs = {store_key(k, BACKEND): _blob(solve(k, backend=BACKEND, store=store)) for k in kernels}
+    stop = threading.Event()
+    errors: list = []
+
+    def reader():
+        while not stop.is_set():
+            for key, ref in refs.items():
+                try:
+                    hit = store.lookup(key)
+                    if hit is not None and _blob(hit.pipeline) != ref:
+                        errors.append(f'wrong bytes for {key[:8]}')
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for _ in range(5):
+            store.gc(max_bytes=0)  # evict everything not actively leased
+            for k in kernels:  # re-publish so the reader has something to hit
+                store.publish(store_key(k, BACKEND), solve(k, backend=BACKEND, store=False))
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert errors == []  # a gc'd entry is a miss, never an exception or wrong bytes
+
+
+# ------------------------------------------------------------ campaign
+
+
+def test_campaign_publishes_into_store(tmp_path):
+    from da4ml_tpu.parallel.campaign import run_campaign
+
+    kernels = [_kernel(40 + i) for i in range(3)]
+    store_dir = tmp_path / 'store'
+    results, _ = run_campaign(kernels, workers=1, campaign_dir=tmp_path / 'camp', backend=BACKEND, store=store_dir)
+    assert store_at(store_dir).occupancy()['entries'] == 3
+    # the published entries answer future solve() calls byte-identically
+    warm = solve(kernels[0], backend=BACKEND, store=store_dir)
+    assert _blob(warm) == json.dumps(results[0]['pipeline'], sort_keys=True)
+    assert _counter('store.hits') >= 1
+
+
+# ------------------------------------------------------------- service
+
+
+def test_solve_service_hit_miss_and_identity(tmp_path):
+    k = _kernel(50)
+    ref = solve(k, backend=BACKEND, store=False)
+    svc = SolveService(store=tmp_path, backend=BACKEND, workers=2, default_deadline_s=60.0)
+    try:
+        r1 = svc.submit(k).result(timeout=60)
+        r2 = svc.submit(k).result(timeout=60)
+    finally:
+        svc.close()
+    assert r1['source'] == 'solve' and r2['source'] == 'store'
+    assert json.dumps(r1['pipeline'], sort_keys=True) == json.dumps(r2['pipeline'], sort_keys=True) == _blob(ref)
+    assert r1['key'] == r2['key'] == store_key(k, BACKEND)
+    assert _counter('serve.solve_hits') == 1 and _counter('serve.solve_misses') == 1
+
+
+def test_solve_service_validates_and_sheds(tmp_path):
+    from da4ml_tpu.reliability.errors import InvalidInputError
+    from da4ml_tpu.serve.batching import DeadlineExpired, QueueFull
+
+    svc = SolveService(store=tmp_path, backend=BACKEND, workers=1, queue_cap_rows=16)
+    try:
+        with pytest.raises(InvalidInputError):
+            svc.submit([[1.0, float('nan')]])
+        with pytest.raises(InvalidInputError):
+            svc.submit(np.zeros((0, 4)))
+        with pytest.raises(QueueFull) as ei:
+            svc.submit(np.ones((17, 4)))  # larger than the whole queue → 429
+        assert ei.value.http_status == 429
+        assert _counter('serve.solve_shed') >= 1
+        # a request whose deadline passes before dispatch → 504: park the
+        # single worker on a fault-slowed solve, then queue a request whose
+        # deadline cannot survive the wait
+        with fault_injection('cmvm.solve=sleep:1:1'):
+            first = svc.submit(_kernel(51), deadline_s=60.0)
+            time.sleep(0.1)  # the worker has taken `first` and is parked
+            doomed = svc.submit(_kernel(52), deadline_s=0.05)
+            first.result(timeout=60)
+            with pytest.raises(DeadlineExpired):
+                doomed.result(timeout=60)
+        assert _counter('serve.solve_expired') >= 1
+    finally:
+        svc.close()
+
+
+def test_negative_cached_key_maps_to_503(tmp_path):
+    store = SolutionStore(tmp_path)
+    k = _kernel(53)
+    store.publish_negative(store_key(k, BACKEND), 'solver exploded', ttl_s=60.0)
+    svc = SolveService(store=store, backend=BACKEND, workers=1)
+    try:
+        from da4ml_tpu.store.service import SolveUnavailable
+
+        with pytest.raises(SolveUnavailable) as ei:
+            svc.submit(k).result(timeout=60)
+        assert ei.value.http_status == 503 and ei.value.retry_after_s > 0
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------- HTTP
+
+
+def _post(url, doc, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), headers={'Content-Type': 'application/json'}, method='POST'
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_v1_solve_over_http(tmp_path):
+    from da4ml_tpu.serve.engine import ServeConfig, ServeEngine
+    from da4ml_tpu.serve.http import ServeServer
+
+    k = _kernel(54)
+    ref = solve(k, backend=BACKEND, store=False)
+    engine = ServeEngine(ServeConfig(prewarm=False))
+    svc = SolveService(store=tmp_path, backend=BACKEND, workers=1, default_deadline_s=60.0)
+    server = ServeServer(engine, solve_service=svc)
+    try:
+        code, doc = _post(f'{server.url}/v1/solve', {'kernel': k.tolist()})
+        assert code == 200 and doc['source'] == 'solve'
+        assert json.dumps(doc['pipeline'], sort_keys=True) == _blob(ref)
+        code, doc = _post(f'{server.url}/v1/solve', {'kernel': k.tolist()})
+        assert code == 200 and doc['source'] == 'store'
+        # pipeline=false trims the payload to provenance only
+        code, doc = _post(f'{server.url}/v1/solve', {'kernel': k.tolist(), 'pipeline': False})
+        assert code == 200 and 'pipeline' not in doc and doc['source'] == 'store'
+        # taxonomy over the wire: bad kernel → 400 with a structured doc
+        code, doc = _post(f'{server.url}/v1/solve', {'kernel': [[1.0, None]]})
+        assert code == 400 and doc['error']['type'] == 'InvalidInputError'
+        code, doc = _post(f'{server.url}/v1/solve', {})
+        assert code == 400
+        # oversize kernel → 429 + Retry-After semantics via QueueFull
+        code, doc = _post(f'{server.url}/v1/solve', {'kernel': np.ones((512, 4)).tolist()})
+        assert code == 429
+        # root endpoint advertises the solve plane
+        with urllib.request.urlopen(f'{server.url}/', timeout=10) as resp:
+            assert '/v1/solve' in resp.read().decode()
+    finally:
+        server.close()
+        svc.close()
+        engine.close()
+
+
+def test_v1_solve_404_without_service():
+    from da4ml_tpu.serve.engine import ServeConfig, ServeEngine
+    from da4ml_tpu.serve.http import ServeServer
+
+    engine = ServeEngine(ServeConfig(prewarm=False))
+    server = ServeServer(engine)
+    try:
+        code, doc = _post(f'{server.url}/v1/solve', {'kernel': [[1.0]]})
+        assert code == 404
+    finally:
+        server.close()
+        engine.close()
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_cache_cli_stats_verify_gc(tmp_path, capsys):
+    from da4ml_tpu._cli import main
+
+    k = _kernel(55)
+    solve(k, backend=BACKEND, store=tmp_path)
+    assert main(['cache', 'stats', '--store', str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['entries'] == 1 and doc['breakers']['store.read'] == 'closed'
+
+    assert main(['cache', 'verify', '--store', str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == {'checked': 1, 'ok': 1, 'quarantined': 0}
+
+    # corrupt the entry: verify exits 1 and quarantines it
+    path = store_at(tmp_path)._entry_path(store_key(k, BACKEND))
+    path.write_bytes(b'{"garbage"')
+    assert main(['cache', 'verify', '--store', str(tmp_path)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['quarantined'] == 1
+
+    solve(k, backend=BACKEND, store=tmp_path)  # repopulate
+    assert main(['cache', 'gc', '--store', str(tmp_path), '--max-bytes', '0']) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['evicted'] == 1
+    assert store_at(tmp_path).occupancy()['entries'] == 0
+
+
+def test_cache_cli_size_and_age_parsers():
+    from da4ml_tpu._cli.cache import parse_age, parse_size
+
+    assert parse_size('512M') == 512 << 20
+    assert parse_size('2G') == 2 << 30
+    assert parse_size('1024') == 1024
+    assert parse_age('7d') == 7 * 86400.0
+    assert parse_age('90') == 90.0
+    with pytest.raises(Exception):
+        parse_size('many')
+
+
+# ------------------------------------------------------------- resolve
+
+
+def test_resolve_store_semantics(tmp_path, monkeypatch):
+    assert resolve_store(False) is None
+    monkeypatch.delenv('DA4ML_SOLUTION_STORE', raising=False)
+    assert resolve_store(None) is None
+    monkeypatch.setenv('DA4ML_SOLUTION_STORE', str(tmp_path))
+    assert resolve_store(None) is not None
+    assert resolve_store(False) is None  # False beats the env var
+    st = SolutionStore(tmp_path)
+    assert resolve_store(st) is st
+    assert resolve_store(tmp_path) is resolve_store(str(tmp_path))  # registry-cached
