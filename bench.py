@@ -320,6 +320,13 @@ def _run_inference_micro(limited: bool):
     t0 = time.perf_counter()
     out_h = _hostloop(data)
     hostloop_t = time.perf_counter() - t0
+
+    # fused-IR: the stages merged into ONE level-packed DAIS program
+    # (docs/runtime.md#ir-fusion) — no boundary pack/shift/unpack at all
+    run_pipeline(chain, data, fused='ir')
+    t0 = time.perf_counter()
+    out_ir = run_pipeline(chain, data, fused='ir')
+    fused_ir_t = time.perf_counter() - t0
     return {
         'n_samples': n_samples,
         'device_rate': round(n_samples / dev_t, 1),
@@ -333,13 +340,99 @@ def _run_inference_micro(limited: bool):
         'large_program': large,
         'pipeline_stages': len(pipe.stages),
         'pipeline_fused_rate': round(n_samples / fused_t, 1),
+        'pipeline_fused_ir_rate': round(n_samples / fused_ir_t, 1),
         'pipeline_chained_rate': round(n_samples / chain_t, 1),
         'pipeline_hostloop_rate': round(n_samples / hostloop_t, 1),
         'pipeline_fused_vs_chained': round(chain_t / fused_t, 3),
+        'pipeline_fused_ir_vs_chained': round(chain_t / fused_ir_t, 3),
         'pipeline_bit_exact': bool(
             np.array_equal(out_f, out_host) and np.array_equal(out_c, out_host) and np.array_equal(out_h, out_host)
         ),
+        'pipeline_fused_ir_bit_exact': bool(np.array_equal(out_ir, out_host)),
+        'fusion_workloads': _run_fusion_workloads(limited),
     }
+
+
+def _run_fusion_workloads(limited: bool) -> dict:
+    """ROADMAP workload coverage for the fusion pass: a depthwise+pointwise
+    separable conv stack and a softmax-free (relu-attention) transformer
+    block, each traced with the existing tracer ops, split into a pipeline
+    and run fused-IR vs chained vs per-stage hostloop (bit-exact gated)."""
+    from da4ml_tpu.ir.fuse import fuse_pipeline
+    from da4ml_tpu.runtime.jax_backend import run_binary, run_pipeline
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+    from da4ml_tpu.trace.ops import conv2d, depthwise_conv2d, einsum, relu
+    from da4ml_tpu.trace.ops.quantization import quantize
+
+    rng = np.random.default_rng(23)
+    n_samples = 8192 if limited else 65536
+
+    def conv_stack():
+        # same separable stack as tests/test_fuse.py so the stage split is known-good
+        shape = (5, 5, 2)
+        inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, 6))
+        x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+        h = relu(depthwise_conv2d(x, rng.integers(-3, 4, (3, 3, 2, 1)).astype(np.float64)), i=3, f=0)
+        h = relu(conv2d(h, rng.integers(-3, 4, (1, 1, 2, 3)).astype(np.float64)), i=3, f=0)
+        h = relu(depthwise_conv2d(h, rng.integers(-2, 3, (2, 2, 3, 1)).astype(np.float64)), i=3, f=0)
+        out = conv2d(h, rng.integers(-3, 4, (1, 1, 3, 2)).astype(np.float64))
+        return to_pipeline(comb_trace(inp, out), 6, retiming=False), int(np.prod(shape))
+
+    def transformer_block():
+        T, D, F = (4, 4, 8) if limited else (8, 8, 16)
+        shape = (T, D)
+        inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, 8))
+        x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+        wq, wk, wv = (rng.integers(-2, 3, (D, D)).astype(np.float64) for _ in range(3))
+        q = quantize(einsum('td,df->tf', x, wq), 1, 3, 0)
+        k = quantize(einsum('td,df->tf', x, wk), 1, 3, 0)
+        v = quantize(einsum('td,df->tf', x, wv), 1, 3, 0)
+        scores = relu(einsum('td,sd->ts', q, k), i=3, f=0)  # relu-attention, no softmax
+        h = quantize(x + quantize(einsum('ts,sd->td', scores, v), 1, 3, 0), 1, 3, 0)
+        w1 = rng.integers(-2, 3, (D, F)).astype(np.float64)
+        w2 = rng.integers(-2, 3, (F, D)).astype(np.float64)
+        ffn = quantize(einsum('tf,fd->td', relu(einsum('td,df->tf', h, w1), i=3, f=0), w2), 1, 3, 0)
+        return to_pipeline(comb_trace(inp, quantize(h + ffn, 1, 3, 0)), 8, retiming=False), T * D
+
+    entries = {}
+    for wname, build in (('conv_stack', conv_stack), ('transformer_block', transformer_block)):
+        pipe, n_in = build()
+        chain = [s.to_binary() for s in pipe.stages]
+        data = rng.integers(-4, 4, (n_samples, n_in)).astype(np.float64)
+        golden = pipe.predict(data, backend='numpy')
+        _, rep = fuse_pipeline(pipe, report=True)
+
+        def hostloop(d):
+            out = d
+            for b in chain:
+                out = run_binary(b, out)
+            return out
+
+        timed = {}
+        outs = {}
+        for key, fn in (
+            ('fused_ir', lambda: run_pipeline(chain, data, fused='ir')),
+            ('chained', lambda: run_pipeline(chain, data, fused=False)),
+            ('hostloop', lambda: hostloop(data)),
+        ):
+            fn()  # first call pays the compile
+            t0 = time.perf_counter()
+            outs[key] = fn()
+            timed[key] = time.perf_counter() - t0
+        entries[wname] = {
+            'stages': len(pipe.stages),
+            'n_in': n_in,
+            'n_samples': n_samples,
+            'seam_ops': rep.seam_ops,
+            'depth_chained': rep.depth_before,
+            'depth_fused': rep.depth_after,
+            'fused_ir_rate': round(n_samples / timed['fused_ir'], 1),
+            'chained_rate': round(n_samples / timed['chained'], 1),
+            'hostloop_rate': round(n_samples / timed['hostloop'], 1),
+            'fused_ir_vs_chained': round(timed['chained'] / timed['fused_ir'], 3),
+            'bit_exact': bool(all(np.array_equal(outs[k], golden) for k in outs)),
+        }
+    return entries
 
 
 def _run_large_program_probe(limited: bool) -> dict:

@@ -31,7 +31,10 @@ src/da4ml/_cli/__init__.py:8-27):
   degradation, plus its own chaos drill (docs/serving.md);
 - ``cache`` — operate a global content-addressed solution store: stats,
   re-verification, lease-guarded LRU gc, and the zipf-traffic + bit-flip
-  chaos drill (docs/store.md).
+  chaos drill (docs/store.md);
+- ``export`` — fuse a saved model into ONE DAIS program and write the
+  self-contained, digest-stamped serving artifact ``ServeEngine`` hot-loads
+  without retracing (docs/runtime.md#ir-fusion).
 """
 
 from __future__ import annotations
@@ -104,6 +107,12 @@ def main(argv: list[str] | None = None) -> int:
     p_serve = sub.add_parser('serve', help='Serve models over HTTP with dynamic batching and admission control')
     add_serve_args(p_serve)
     p_serve.set_defaults(func=serve_main)
+
+    from .export import add_export_args, export_main
+
+    p_export = sub.add_parser('export', help='Write a fused, digest-stamped serving artifact (hot-loadable)')
+    add_export_args(p_export)
+    p_export.set_defaults(func=export_main)
 
     from .cache import add_cache_args, cache_main
 

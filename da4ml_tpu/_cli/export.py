@@ -1,0 +1,54 @@
+"""``da4ml-tpu export`` — write a self-contained serving artifact.
+
+Fuses a saved model's stages into ONE level-packed DAIS program
+(docs/runtime.md#ir-fusion) and writes the artifact directory the serve
+plane hot-loads without retracing: fused DAIS JSON, a best-effort
+``jax.export`` StableHLO serialization of the whole computation, and a
+digest-carrying ``meta.json`` that ``ServeEngine.reload()`` verifies before
+swapping executors (docs/serving.md#export-artifacts).
+"""
+
+from __future__ import annotations
+
+
+def add_export_args(parser) -> None:
+    parser.add_argument('model', help='Saved CombLogic/Pipeline .json (or an existing artifact dir to re-fuse)')
+    parser.add_argument('outdir', help='Artifact directory to write (created if missing)')
+    parser.add_argument('--name', default='model', help='Model name recorded in meta.json (default: model)')
+    parser.add_argument(
+        '--no-stablehlo',
+        dest='stablehlo',
+        action='store_false',
+        default=True,
+        help='Skip the jax.export StableHLO serialization (fused DAIS JSON only)',
+    )
+    parser.add_argument(
+        '--check',
+        action='store_true',
+        help='After writing, reload the artifact and run a zero batch through it (round-trip self-check)',
+    )
+    parser.add_argument('--verbose', '-v', action='store_true')
+
+
+def export_main(args) -> int:
+    from ..serve.export import export_model, load_artifact
+
+    meta = export_model(args.model, args.outdir, name=args.name, stablehlo=args.stablehlo)
+    print(
+        f'export: {args.outdir} <- {args.model} '
+        f'({meta["source_stages"]} stage(s) -> {meta["fused_ops"]} fused ops, '
+        f'{meta["n_in"]}->{meta["n_out"]}, digest {meta["digest"][:12]}...)'
+    )
+    if args.verbose and meta.get('stablehlo') is None:
+        print(f'  stablehlo: skipped ({meta.get("stablehlo_error")})')
+    if args.check:
+        import numpy as np
+
+        from ..ir.dais_binary import decode
+        from ..runtime.jax_backend import DaisExecutor
+
+        binary, meta2 = load_artifact(args.outdir)
+        ex = DaisExecutor(decode(binary))
+        ex(np.zeros((4, max(meta2['n_in'], 1)), dtype=np.float64))
+        print(f'  check: artifact reloads clean ({meta2["fused_ops"]} ops, digest verified)')
+    return 0

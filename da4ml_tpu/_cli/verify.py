@@ -93,6 +93,27 @@ def _schedule_stats(program) -> list[dict]:
     return per
 
 
+def _fused_stats(program) -> dict | None:
+    """Level-schedule stats of the IR-fused whole-model program
+    (docs/runtime.md#ir-fusion), for multi-stage Pipelines: what the
+    ``run_pipeline(fused='ir')`` runtime actually executes."""
+    if len(getattr(program, 'stages', ())) < 2:
+        return None
+    from ..ir.fuse import fuse_pipeline
+    from ..ir.schedule import levelize_comb
+
+    fused, rep = fuse_pipeline(program, report=True)
+    s = levelize_comb(fused)
+    return {
+        'n_ops': len(fused.ops),
+        'seam_ops': rep.seam_ops,
+        'depth': s.depth,
+        'depth_chained': rep.depth_before,
+        'width_max': s.width_max,
+        'width_mean': round(s.width_mean, 1),
+    }
+
+
 def _fuzz_main(args: argparse.Namespace) -> int:
     """Corpus mode: differential conformance + transfer-soundness fuzz."""
     from ..analysis.conformance import CONFORMANCE_MODES, run_conformance_corpus
@@ -167,6 +188,19 @@ def verify_main(args: argparse.Namespace) -> int:
             entry['schedule'] = _schedule_stats(program)
         except Exception:  # stats are informational; never fail the verify
             pass
+        try:
+            fused_stats = _fused_stats(program)
+            if fused_stats is not None:
+                entry['schedule_fused'] = fused_stats
+        except Exception:
+            fused_stats = None
+        if fused_stats is not None:
+            # the fused whole-model program must pass the same verifier
+            # passes as the staged one (incl. --conformance when requested)
+            fres = verify(program.fuse(), passes=passes, target=f'{raw_path}#fused')
+            entry['fused'] = fres.to_dict()
+            if not fres.ok or (args.strict and fres.warnings):
+                rc = max(rc, 1)
         results.append(entry)
         if not result.ok or (args.strict and result.warnings):
             rc = max(rc, 1)
@@ -174,6 +208,16 @@ def verify_main(args: argparse.Namespace) -> int:
             print(result.format_text(show_warnings=not args.no_warnings))
             for i, s in enumerate(entry.get('schedule', [])):
                 print(f'  stage {i}: {s["n_ops"]} ops, schedule depth {s["depth"]}, mean level width {s["width_mean"]}')
+            if fused_stats is not None:
+                f = fused_stats
+                fd = entry['fused']
+                suffix = '' if fd['ok'] else ' [VERIFY FAILED]'
+                if fd['ok'] and fd['n_warnings']:
+                    suffix = f' [{fd["n_warnings"]} warning(s)]'
+                print(
+                    f'  fused: {f["n_ops"]} ops ({f["seam_ops"]} seam), schedule depth {f["depth"]} '
+                    f'(chained {f["depth_chained"]}), mean level width {f["width_mean"]}' + suffix
+                )
 
     if args.as_json:
         print(json.dumps(results if len(results) > 1 else results[0], indent=2))

@@ -378,16 +378,31 @@ class Pipeline(NamedTuple):
         with open(path) as f:
             return cls.from_dict(json.load(f), verify=verify)
 
-    def predict(self, data, backend: str = 'auto', n_threads: int = 0, mesh=None):
+    def fuse(self, report: bool = False):
+        """Merge every stage into ONE well-formed :class:`CombLogic`.
+
+        Inter-stage rescaling becomes explicit seam ops, so the level
+        scheduler packs formerly-separate stages' ops into shared
+        (level, family) groups. Bit-exact with the staged execution; with
+        ``report=True`` also returns the :class:`~.fuse.FusionReport`.
+        See docs/runtime.md#ir-fusion.
+        """
+        from .fuse import fuse_pipeline
+
+        return fuse_pipeline(self, report=report)
+
+    def predict(self, data, backend: str = 'auto', n_threads: int = 0, mesh=None, fused: bool | str = True):
         data = np.asarray(data, dtype=np.float64)
         if mesh is not None and backend not in ('jax', 'auto'):
             raise ValueError(f"mesh sharding requires backend='jax', got {backend!r}")
         if backend == 'jax' or mesh is not None:
             # fused device path: all stages + exact inter-stage re-scaling
-            # compile to ONE XLA program — no host round-trip per boundary
+            # compile to ONE XLA program — no host round-trip per boundary.
+            # fused='ir' instead merges the stages at the IR level first
+            # (one level-packed DAIS program, docs/runtime.md#ir-fusion).
             from ..runtime.jax_backend import run_pipeline
 
-            return run_pipeline([s.to_binary() for s in self.stages], data, mesh=mesh)
+            return run_pipeline([s.to_binary() for s in self.stages], data, mesh=mesh, fused=fused)
         out = data
         for stage in self.stages:
             out = stage.predict(out, backend=backend, n_threads=n_threads)

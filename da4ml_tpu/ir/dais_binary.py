@@ -59,6 +59,41 @@ class DaisProgram(NamedTuple):
             raise ValueError('Causality violation on mux condition index')
 
 
+def encode(prog: DaisProgram, version: int = 0) -> NDArray[np.int32]:
+    """Serialize a decoded program back to the flat int32 DAIS v1 stream.
+
+    Exact inverse of :func:`decode` (``encode(decode(b))`` is byte-identical
+    to ``b`` up to the ignored firmware-version word): synthesized and fused
+    programs become shippable binaries without a traced CombLogic in hand.
+    """
+    parts = [
+        np.asarray([DAIS_SPEC_VERSION, version, prog.n_in, prog.n_out, prog.n_ops, len(prog.tables)]),
+        prog.inp_shifts,
+        prog.out_idxs,
+        prog.out_shifts,
+        prog.out_negs,
+        np.stack(
+            [
+                prog.opcode,
+                prog.id0,
+                prog.id1,
+                prog.data_lo,
+                prog.data_hi,
+                prog.signed,
+                prog.integers,
+                prog.fractionals,
+            ],
+            axis=1,
+        ).reshape(-1)
+        if prog.n_ops
+        else np.empty(0, np.int32),
+    ]
+    if prog.tables:
+        parts.append(np.asarray([len(t) for t in prog.tables]))
+        parts.extend(prog.tables)
+    return np.concatenate([np.asarray(p, dtype=np.int32) for p in parts], dtype=np.int32)
+
+
 def decode(binary: NDArray[np.int32]) -> DaisProgram:
     binary = np.asarray(binary, dtype=np.int32)
     if binary.size < 6:

@@ -39,6 +39,20 @@ _stale = [f for f in FAMILIES if f not in {spec.synth_family for spec in OP_TABL
 if _stale:
     raise RuntimeError(f'ir.synth families without an opcode-table row: {_stale}')
 
+# fusion coverage audit (same spirit): every opcode this generator can emit
+# must be one ir.fuse knows how to rebase across a stage boundary, or the
+# multi-stage corpus would fuzz pipelines the fuse pass rejects at runtime.
+from .fuse import FUSABLE_OPCODES as _FUSABLE  # noqa: E402  (audit needs FAMILIES above)
+
+_unfusable = [
+    spec.key for spec in OP_TABLE if spec.synth_family in FAMILIES and not set(spec.opcodes) <= _FUSABLE
+]
+if _unfusable:
+    raise RuntimeError(
+        f'ir.synth families whose opcodes ir.fuse cannot carry across a stage '
+        f'boundary: {_unfusable}; teach fuse_pipeline the new operand structure'
+    )
+
 
 def opcode_counts(progs) -> dict[int, int]:
     """Per-opcode op counts over a corpus of :class:`DaisProgram` — the
@@ -259,6 +273,46 @@ def random_program(
         fractionals=fr.astype(np.int32),
         tables=tuple(tables),
     )
+
+
+def random_pipeline(
+    rng: np.random.Generator,
+    n_stages: int = 3,
+    n_ops: int = 120,
+    families: tuple[str, ...] = FAMILIES,
+    n_levels: int | None = None,
+) -> tuple[DaisProgram, ...]:
+    """Generate a random well-formed multi-stage pipeline (stage chain).
+
+    Each stage is a :func:`random_program` with mixed lane counts and
+    fractionals; consecutive stages agree on lane count so the chain is a
+    valid :func:`~..runtime.jax_backend.run_pipeline` /
+    :func:`~.fuse.fuse_binaries` input. Mid-pipeline stages honor the
+    chained-boundary contract the runtime's ``PipelineExecutor`` encodes
+    (a stage boundary is a pure arithmetic shift of live output lanes):
+    no output negation and no dead ``-1`` lanes except on the final stage.
+    Stages stay narrow (``wide=False``) so inter-stage codes are exact in
+    float64 on every backend.
+    """
+    assert n_stages >= 1
+    widths = [int(rng.integers(3, 7)) for _ in range(n_stages + 1)]
+    stages: list[DaisProgram] = []
+    for s in range(n_stages):
+        prog = random_program(
+            rng,
+            n_ops=n_ops,
+            n_in=widths[s],
+            n_out=widths[s + 1],
+            families=families,
+            wide=False,
+            n_levels=n_levels,
+        )
+        if s < n_stages - 1:
+            out_idxs = prog.out_idxs.copy()
+            out_idxs[out_idxs < 0] = int(prog.n_in)  # first non-input op: always present
+            prog = prog._replace(out_idxs=out_idxs, out_negs=np.zeros_like(prog.out_negs))
+        stages.append(prog)
+    return tuple(stages)
 
 
 def random_inputs(rng: np.random.Generator, prog: DaisProgram, n_samples: int) -> np.ndarray:

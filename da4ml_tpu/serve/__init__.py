@@ -34,6 +34,7 @@ from .batching import (
     ServeRejected,
 )
 from .engine import ServeConfig, ServeEngine, serve_health, serve_status
+from .export import export_model, load_artifact
 
 __all__ = [
     'ServeConfig',
@@ -41,6 +42,8 @@ __all__ = [
     'ServeServer',
     'serve_health',
     'serve_status',
+    'export_model',
+    'load_artifact',
     'AdmissionQueue',
     'InferRequest',
     'ServeRejected',

@@ -116,7 +116,8 @@ class _ModelState:
 def _as_binaries(source) -> tuple[list[NDArray[np.int32]], str | None]:
     """Normalize a model source into its per-stage DAIS binaries.
 
-    Accepts a saved CombLogic/Pipeline ``.json`` path, a live
+    Accepts a saved CombLogic/Pipeline ``.json`` path, an export artifact
+    directory (``da4ml-tpu export``, digest-checked on load), a live
     ``CombLogic``/``Pipeline``, or raw binaries (one int32 array or a
     list of them).
     """
@@ -124,6 +125,11 @@ def _as_binaries(source) -> tuple[list[NDArray[np.int32]], str | None]:
 
     if isinstance(source, (str, Path)):
         path = Path(source)
+        if path.is_dir():
+            from .export import load_artifact
+
+            binary, _meta = load_artifact(path)  # raises ValueError on digest mismatch
+            return [binary], str(path)
         import json
 
         data = json.loads(path.read_text())
@@ -204,10 +210,25 @@ class ServeEngine:
                 f'({state.n_in}->{prog0.n_in} in, {state.n_out}->{progL.n_out} out); load a new model name instead'
             )
         new_version = state.version + 1
-        executor = self._build_executor(binaries)
-        warm = set()
-        if self.config.prewarm:
-            warm = self._warm_executor(executor, state.n_in)
+        # same-program reload (e.g. re-pointing at an export artifact of the
+        # live model): the warm executor is reused as-is — zero new XLA
+        # compiles, the canonical grid stays warm
+        executor = None
+        same = len(binaries) == len(state.binaries) and all(
+            np.array_equal(a, b) for a, b in zip(binaries, state.binaries)
+        )
+        if same:
+            with self._exec_lock:
+                entry = self._executors.get(name)
+                if entry is not None and entry[0] == state.version:
+                    executor = entry[1]
+        if executor is not None:
+            warm = set(state.warm_rows)
+        else:
+            executor = self._build_executor(binaries)
+            warm = set()
+            if self.config.prewarm:
+                warm = self._warm_executor(executor, state.n_in)
         with state.lock:
             state.binaries = binaries
             state.version = new_version

@@ -1,0 +1,156 @@
+"""Self-contained serving artifacts: one fused DAIS program per model.
+
+``export_model`` completes the TVM-style compile/serve split for deployment:
+the per-stage programs of a traced model are merged by :mod:`..ir.fuse` into
+ONE level-packed DAIS program, and the artifact directory carries everything
+a serving replica needs to hot-load it without retracing:
+
+- ``fused.json`` — the fused DAIS v1 binary (int32 words, JSON-encoded) plus
+  the interface summary, loadable with no tracer in the image;
+- ``fused.stablehlo`` — best-effort ``jax.export`` serialization of the fused
+  integer kernel with a symbolic batch dimension (the whole model as a single
+  portable XLA computation); absent when the installed jax cannot export;
+- ``meta.json`` — format/version/interface plus the SHA-256 **digest** of the
+  fused program. ``ServeEngine.reload()`` recomputes the digest on load and
+  refuses a tampered or half-written artifact (same refusal contract as an
+  interface-changing live reload). ``meta.json`` is written last, so a
+  partially-written directory is never loadable.
+
+See docs/runtime.md#ir-fusion for the artifact format and docs/serving.md for
+the hot-load path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+from numpy.typing import NDArray
+
+from .. import telemetry
+
+ARTIFACT_FORMAT = 'da4ml-tpu-artifact'
+ARTIFACT_VERSION = 1
+
+_logger = telemetry.get_logger('serve.export')
+
+
+def program_digest(binary: NDArray[np.int32]) -> str:
+    """SHA-256 of the fused DAIS binary (canonical little-endian int32)."""
+    return hashlib.sha256(np.ascontiguousarray(binary, dtype='<i4').tobytes()).hexdigest()
+
+
+def is_artifact(path) -> bool:
+    """True when ``path`` is an export artifact directory."""
+    return Path(path).is_dir() and (Path(path) / 'meta.json').is_file()
+
+
+def _export_stablehlo(fused: NDArray[np.int32], outdir: Path) -> tuple[str | None, str | None]:
+    """Serialize the fused integer kernel via ``jax.export`` (symbolic batch).
+
+    Best-effort: the fused DAIS JSON alone is a complete artifact, so any
+    export failure is recorded in the metadata instead of failing the write.
+    """
+    try:
+        import jax
+        from jax import export as jax_export
+
+        from ..ir.dais_binary import decode
+        from ..runtime.jax_backend import DaisExecutor
+
+        ex = DaisExecutor(decode(fused))
+        (batch,) = jax_export.symbolic_shape('batch')
+        spec = jax.ShapeDtypeStruct((batch, max(ex.prog.n_in, 1)), ex.dtype)
+        with ex._x64():
+            blob = jax_export.export(jax.jit(ex._raw))(spec).serialize()
+        path = outdir / 'fused.stablehlo'
+        path.write_bytes(blob)
+        return path.name, None
+    except Exception as e:  # noqa: BLE001 — record, don't fail the export
+        _logger.warning('stablehlo export skipped: %s', e)
+        return None, f'{type(e).__name__}: {e}'
+
+
+def export_model(source, outdir, name: str = 'model', stablehlo: bool = True) -> dict:
+    """Write a self-contained serving artifact for ``source`` into ``outdir``.
+
+    ``source`` is anything ``ServeEngine`` accepts (saved ``.json`` path,
+    live CombLogic/Pipeline, raw binaries). Returns the metadata dict.
+    """
+    from ..ir.dais_binary import decode
+    from ..ir.fuse import fuse_binaries
+    from .engine import _as_binaries
+
+    binaries, _ = _as_binaries(source)
+    outdir = Path(outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+    with telemetry.span('serve.export', stages=len(binaries)):
+        fused = fuse_binaries(binaries)
+        prog = decode(fused)
+        digest = program_digest(fused)
+        (outdir / 'fused.json').write_text(
+            json.dumps(
+                {
+                    'format': 'dais-v1',
+                    'n_in': int(prog.n_in),
+                    'n_out': int(prog.n_out),
+                    'binary': np.asarray(fused, dtype=np.int32).tolist(),
+                },
+                separators=(',', ':'),
+            )
+        )
+        hlo_name, hlo_error = _export_stablehlo(fused, outdir) if stablehlo else (None, 'disabled')
+        meta = {
+            'format': ARTIFACT_FORMAT,
+            'version': ARTIFACT_VERSION,
+            'name': name,
+            'n_in': int(prog.n_in),
+            'n_out': int(prog.n_out),
+            'source_stages': len(binaries),
+            'fused_ops': int(prog.n_ops),
+            'digest': digest,
+            'stablehlo': hlo_name,
+            'stablehlo_error': hlo_error,
+            'created_unix': int(time.time()),
+        }
+        # meta.json last: its presence marks the artifact complete
+        (outdir / 'meta.json').write_text(json.dumps(meta, indent=1, sort_keys=True))
+    telemetry.counter('serve.exports').inc()
+    return meta
+
+
+def load_artifact(path) -> tuple[NDArray[np.int32], dict]:
+    """Load (and digest-check) an export artifact directory.
+
+    Raises ``ValueError`` when the metadata digest does not match the fused
+    program — a tampered, truncated, or mixed-up artifact must never reach a
+    serving executor.
+    """
+    path = Path(path)
+    meta = json.loads((path / 'meta.json').read_text())
+    if meta.get('format') != ARTIFACT_FORMAT:
+        raise ValueError(f'{path}: not a {ARTIFACT_FORMAT} directory (format={meta.get("format")!r})')
+    if int(meta.get('version', -1)) > ARTIFACT_VERSION:
+        raise ValueError(f'{path}: artifact version {meta.get("version")} is newer than supported {ARTIFACT_VERSION}')
+    doc = json.loads((path / 'fused.json').read_text())
+    binary = np.asarray(doc['binary'], dtype=np.int32)
+    digest = program_digest(binary)
+    if digest != meta.get('digest'):
+        raise ValueError(
+            f'{path}: artifact digest mismatch (meta {str(meta.get("digest"))[:12]}… != '
+            f'program {digest[:12]}…); refusing to serve a tampered or half-written artifact'
+        )
+    return binary, meta
+
+
+__all__ = [
+    'ARTIFACT_FORMAT',
+    'ARTIFACT_VERSION',
+    'export_model',
+    'is_artifact',
+    'load_artifact',
+    'program_digest',
+]

@@ -184,7 +184,7 @@ _RATE_SUFFIX = ('_rate', '_per_s', '_throughput')
 def classify_metric(name: str) -> str:
     """'exact' | 'cost' | 'rate' | 'info' from the dotted metric name."""
     last = name.rsplit('.', 1)[-1]
-    if last in _EXACT_LAST:
+    if last in _EXACT_LAST or last.endswith('_bit_exact'):
         return 'exact'
     if 'cost' in last:
         return 'cost'

@@ -1,0 +1,326 @@
+"""IR-level pipeline fusion (ir/fuse.py): bit-exactness of the fused
+whole-model program vs the chained runtime oracle and the numpy staged
+reference, across traced workloads and the synth pipeline fuzz corpus;
+export artifact round-trip + digest refusal (docs/runtime.md#ir-fusion)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.ir.dais_binary import decode, encode
+from da4ml_tpu.ir.fuse import FUSABLE_OPCODES, fuse_binaries, fuse_pipeline
+from da4ml_tpu.ir.synth import FAMILIES, random_inputs, random_pipeline
+from da4ml_tpu.runtime import jax_backend as jb
+from da4ml_tpu.runtime.numpy_backend import run_program
+from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+
+N = 8
+
+
+def _mlp_pipeline(seed=3, cutoff=4):
+    rng = np.random.default_rng(seed)
+    inp = FixedVariableArrayInput(N, hwconf=HWConfig(1, -1, cutoff))
+    q = inp.quantize(np.ones(N), np.full(N, 3), np.full(N, 2))
+    w1 = rng.integers(-8, 8, (N, 6)).astype(np.float64)
+    w2 = rng.integers(-8, 8, (6, 4)).astype(np.float64)
+    out = ((q @ w1).relu()) @ w2
+    pipe = to_pipeline(comb_trace(inp, out), cutoff, retiming=False)
+    assert len(pipe.stages) >= 2
+    data = rng.uniform(-8, 8, (128, N))
+    return pipe, data
+
+
+def _run_staged_numpy(stages, data):
+    out = np.asarray(data, dtype=np.float64)
+    for p in stages:
+        out = run_program(p, out)
+    return out
+
+
+# -- op-level fusion ---------------------------------------------------------
+
+
+def test_fuse_traced_pipeline_exact():
+    pipe, data = _mlp_pipeline()
+    fused, rep = pipe.fuse(report=True)
+    assert rep.stages == len(pipe.stages)
+    assert rep.ops_after <= rep.ops_before + rep.seam_ops
+    # cross-stage level packing: fused critical path never exceeds the sum
+    # of per-stage depths, and interleaving should strictly shorten it here
+    assert rep.depth_after < rep.depth_before
+    golden = pipe.predict(data, backend='numpy')
+    np.testing.assert_array_equal(fused.predict(data, backend='numpy'), golden)
+
+
+def test_fused_program_verifies_clean():
+    from da4ml_tpu.analysis import verify
+
+    pipe, _ = _mlp_pipeline()
+    res = verify(pipe.fuse())
+    assert res.ok, res.errors
+    assert not res.warnings, res.warnings  # seam ops must stay latency-monotone
+
+
+def test_fuse_reports_telemetry():
+    """fuse.* counters/gauges + run.mode.fused_ir ride the metrics registry."""
+    from da4ml_tpu.telemetry.metrics import disable_metrics, enable_metrics, metrics_snapshot, reset_metrics
+
+    pipe, data = _mlp_pipeline()
+    bins = [s.to_binary() for s in pipe.stages]
+    _, rep = pipe.fuse(report=True)  # the deterministic expected payload
+    enable_metrics()
+    try:
+        reset_metrics()
+        jb._fused_ir_cache.clear()  # force a fused-executor build
+        jb.run_pipeline(bins, data[:8], fused='ir')
+        snap = metrics_snapshot()
+        assert snap['fuse.stages']['value'] == rep.stages
+        assert snap['fuse.seam_ops']['value'] == rep.seam_ops
+        assert snap['fuse.depth_before']['value'] == rep.depth_before
+        assert snap['fuse.depth_after']['value'] == rep.depth_after
+        assert snap['run.mode.fused_ir']['value'] >= 1
+    finally:
+        disable_metrics()
+        reset_metrics()
+
+
+def test_fuse_single_stage_is_identity():
+    rng = np.random.default_rng(0)
+    inp = FixedVariableArrayInput(4, hwconf=HWConfig(1, -1, -1))
+    q = inp.quantize(np.ones(4), np.full(4, 3), np.full(4, 1))
+    out = q @ rng.integers(-4, 4, (4, 3)).astype(np.float64)
+    comb = comb_trace(inp, out)
+    fused = fuse_binaries([comb.to_binary()])
+    np.testing.assert_array_equal(fused, comb.to_binary())
+
+
+def test_fuse_empty_pipeline_rejected():
+    from da4ml_tpu.ir.comb import Pipeline
+
+    with pytest.raises(ValueError, match='empty'):
+        fuse_pipeline(Pipeline(()))
+
+
+# -- binary-level fusion + the runtime path ----------------------------------
+
+
+def test_fuse_binaries_matches_op_level():
+    pipe, _ = _mlp_pipeline()
+    via_binaries = fuse_binaries([s.to_binary() for s in pipe.stages])
+    np.testing.assert_array_equal(via_binaries, pipe.fuse().to_binary())
+
+
+def test_run_pipeline_fused_ir_exact_and_cached():
+    pipe, data = _mlp_pipeline()
+    bins = [s.to_binary() for s in pipe.stages]
+    golden = pipe.predict(data, backend='numpy')
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused='ir'), golden)
+    ex = jb.fused_executor_for_binaries(bins)
+    assert jb.fused_executor_for_binaries(bins) is ex  # warm: no refuse/refit
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused='ir'), golden)
+
+
+@pytest.mark.parametrize('seed', range(8))
+def test_synth_pipeline_fuzz_parity(seed):
+    """Fused-IR vs chained-XLA vs per-stage-device vs numpy staged: all four
+    executions of a random well-formed stage chain must agree bit for bit."""
+    rng = np.random.default_rng(seed)
+    stages = random_pipeline(rng, n_stages=int(rng.integers(2, 5)), n_ops=int(rng.integers(40, 140)))
+    bins = [encode(p) for p in stages]
+    data = random_inputs(rng, stages[0], 64)
+    golden = _run_staged_numpy(stages, data)
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused=True), golden)
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused=False), golden)
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused='ir'), golden)
+
+
+def test_synth_pipeline_all_families_fuse():
+    """Every generator family fuses: a full-family chain round-trips through
+    fuse_binaries and stays bit-exact on the numpy interpreter."""
+    rng = np.random.default_rng(7)
+    stages = random_pipeline(rng, n_stages=3, n_ops=200, families=FAMILIES)
+    fused = decode(fuse_binaries([encode(p) for p in stages]))
+    assert set(fused.opcode.tolist()) <= FUSABLE_OPCODES
+    data = random_inputs(rng, stages[0], 32)
+    np.testing.assert_array_equal(run_program(fused, data), _run_staged_numpy(stages, data))
+
+
+def test_encode_is_decode_inverse():
+    rng = np.random.default_rng(11)
+    (prog,) = random_pipeline(rng, n_stages=1, n_ops=150)
+    b = encode(prog)
+    np.testing.assert_array_equal(encode(decode(b)), b)
+
+
+# -- new traced workloads ----------------------------------------------------
+
+
+def _conv_stack_pipeline(cutoff=6):
+    """Depthwise + pointwise (separable) conv stack, two blocks deep."""
+    from da4ml_tpu.trace.ops import conv2d, depthwise_conv2d, relu
+
+    rng = np.random.default_rng(5)
+    shape = (5, 5, 2)
+    inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, cutoff))
+    x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+    h = depthwise_conv2d(x, rng.integers(-3, 4, (3, 3, 2, 1)).astype(np.float64))
+    h = relu(h, i=3, f=0)
+    h = conv2d(h, rng.integers(-3, 4, (1, 1, 2, 3)).astype(np.float64))
+    h = relu(h, i=3, f=0)
+    h = depthwise_conv2d(h, rng.integers(-2, 3, (2, 2, 3, 1)).astype(np.float64))
+    h = relu(h, i=3, f=0)
+    out = conv2d(h, rng.integers(-3, 4, (1, 1, 3, 2)).astype(np.float64))
+    pipe = to_pipeline(comb_trace(inp, out), cutoff, retiming=False)
+    data = rng.integers(-4, 4, (64, int(np.prod(shape)))).astype(np.float64)
+    return pipe, data
+
+
+def _transformer_block_pipeline(cutoff=8):
+    """Softmax-free transformer block: relu-attention + residual + FFN,
+    traced entirely with existing tracer ops (einsum/relu/quantize)."""
+    from da4ml_tpu.trace.ops import einsum, relu
+    from da4ml_tpu.trace.ops.quantization import quantize
+
+    rng = np.random.default_rng(9)
+    T, D, F = 4, 4, 8
+    shape = (T, D)
+    inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, cutoff))
+    x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+    wq = rng.integers(-2, 3, (D, D)).astype(np.float64)
+    wk = rng.integers(-2, 3, (D, D)).astype(np.float64)
+    wv = rng.integers(-2, 3, (D, D)).astype(np.float64)
+    q = quantize(einsum('td,df->tf', x, wq), 1, 3, 0)
+    k = quantize(einsum('td,df->tf', x, wk), 1, 3, 0)
+    v = quantize(einsum('td,df->tf', x, wv), 1, 3, 0)
+    scores = relu(einsum('td,sd->ts', q, k), i=3, f=0)  # relu-attention, no softmax
+    ctx = quantize(einsum('ts,sd->td', scores, v), 1, 3, 0)
+    h = quantize(x + ctx, 1, 3, 0)  # residual
+    w1 = rng.integers(-2, 3, (D, F)).astype(np.float64)
+    w2 = rng.integers(-2, 3, (F, D)).astype(np.float64)
+    ffn = quantize(einsum('tf,fd->td', relu(einsum('td,df->tf', h, w1), i=3, f=0), w2), 1, 3, 0)
+    out = quantize(h + ffn, 1, 3, 0)
+    pipe = to_pipeline(comb_trace(inp, out), cutoff, retiming=False)
+    data = rng.integers(-4, 4, (64, T * D)).astype(np.float64)
+    return pipe, data
+
+
+@pytest.mark.parametrize('build', [_conv_stack_pipeline, _transformer_block_pipeline])
+def test_workload_fused_parity(build):
+    pipe, data = build()
+    assert len(pipe.stages) >= 2
+    golden = pipe.predict(data, backend='numpy')
+    fused = pipe.fuse()
+    np.testing.assert_array_equal(fused.predict(data, backend='numpy'), golden)
+    bins = [s.to_binary() for s in pipe.stages]
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused=True), golden)
+    np.testing.assert_array_equal(jb.run_pipeline(bins, data, fused='ir'), golden)
+
+
+@pytest.mark.parametrize('build', [_conv_stack_pipeline, _transformer_block_pipeline])
+def test_workload_fused_verifies_clean(build):
+    from da4ml_tpu.analysis import verify
+
+    pipe, _ = build()
+    res = verify(pipe.fuse())
+    assert res.ok, res.errors
+    assert not res.warnings, res.warnings
+
+
+# -- export artifacts + serve hot-load ---------------------------------------
+
+
+def test_export_artifact_roundtrip(tmp_path):
+    from da4ml_tpu.serve.export import export_model, load_artifact, program_digest
+
+    pipe, data = _mlp_pipeline()
+    art = tmp_path / 'artifact'
+    meta = export_model(pipe, art, name='probe')
+    assert meta['source_stages'] == len(pipe.stages)
+    binary, meta2 = load_artifact(art)
+    assert meta2['digest'] == program_digest(binary) == meta['digest']
+    np.testing.assert_array_equal(binary, fuse_binaries([s.to_binary() for s in pipe.stages]))
+    # meta.json written last: a dir with fused.json only is not an artifact
+    from da4ml_tpu.serve.export import is_artifact
+
+    assert is_artifact(art)
+    (art / 'meta.json').unlink()
+    assert not is_artifact(art)
+
+
+def test_export_cli_check(tmp_path):
+    from da4ml_tpu._cli import main
+
+    pipe, _ = _mlp_pipeline()
+    mj = tmp_path / 'pipe.json'
+    pipe.save(mj)
+    rc = main(['export', str(mj), str(tmp_path / 'art'), '--name', 'probe', '--no-stablehlo', '--check'])
+    assert rc == 0
+    meta = json.loads((tmp_path / 'art' / 'meta.json').read_text())
+    assert meta['stablehlo'] is None and meta['name'] == 'probe'
+
+
+def test_serve_hot_load_artifact_zero_new_compiles(tmp_path):
+    """A warm engine re-pointed at the export artifact of its own model must
+    keep its executor (zero new XLA compiles) and answer byte-identically."""
+    from da4ml_tpu.serve import ServeConfig, ServeEngine
+    from da4ml_tpu.serve.export import export_model
+
+    pipe, data = _mlp_pipeline()
+    data = data[:16]
+    art = tmp_path / 'artifact'
+    export_model(pipe, art, stablehlo=False)
+    golden = pipe.predict(data, backend='numpy')
+
+    eng = ServeEngine(ServeConfig(max_batch_rows=64, prewarm=True))
+    try:
+        eng.load_model('m', str(art))
+        got = np.asarray(eng.submit('m', data).result(timeout=30.0))
+        np.testing.assert_array_equal(got, golden)
+        warm_exec = eng._executors['m'][1]
+        v = eng.reload('m')  # re-reads the artifact path
+        assert v == 2
+        assert eng._executors['m'][1] is warm_exec  # same program -> executor reused
+        got2 = np.asarray(eng.submit('m', data).result(timeout=30.0))
+        np.testing.assert_array_equal(got2, golden)
+    finally:
+        eng.unload('m')
+
+
+def test_serve_refuses_digest_mismatch(tmp_path):
+    from da4ml_tpu.serve import ServeConfig, ServeEngine
+    from da4ml_tpu.serve.export import export_model, load_artifact
+
+    pipe, _ = _mlp_pipeline()
+    art = tmp_path / 'artifact'
+    export_model(pipe, art, stablehlo=False)
+    doc = json.loads((art / 'fused.json').read_text())
+    doc['binary'][7] ^= 1
+    (art / 'fused.json').write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match='digest mismatch'):
+        load_artifact(art)
+    eng = ServeEngine(ServeConfig(prewarm=False))
+    try:
+        eng.load_model('m', pipe)
+        with pytest.raises(ValueError, match='digest mismatch'):
+            eng.reload('m', str(art))
+        assert eng._state('m').version == 1  # refusal left the live model untouched
+    finally:
+        eng.unload('m')
+
+
+def test_export_stablehlo_serializes(tmp_path):
+    """jax.export serialization is available in this environment; the
+    artifact must carry it (other environments may record the error)."""
+    pytest.importorskip('jax.export')
+    from da4ml_tpu.serve.export import export_model
+
+    pipe, _ = _mlp_pipeline()
+    art = tmp_path / 'artifact'
+    meta = export_model(pipe, art)
+    if meta['stablehlo'] is None:
+        pytest.skip(f'jax.export unavailable here: {meta["stablehlo_error"]}')
+    blob = (art / meta['stablehlo']).read_bytes()
+    assert len(blob) > 0
+    assert os.path.getsize(art / 'fused.json') > 0
