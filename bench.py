@@ -835,6 +835,31 @@ def _run_section_impl(name: str, n1: int, limited: bool) -> dict:
             'herd_searches': herd_searches,
             'singleflight_dedup': n_threads - herd_searches,
         }
+    if name == 'fleet':
+        # replica-fleet probe (docs/serving.md#replica-fleets): the full
+        # chaos drill at bench scale — 4 serve subprocesses behind the
+        # hedging router, one SIGKILL + one hot reload under load, warm-
+        # from-shared proven via the tier counters. The headline pair
+        # (fleet.samples_per_s floor, fleet.p99_ms ceiling) is what
+        # ci/budgets.toml gates.
+        from da4ml_tpu.serve.chaos import fleet_chaos_drill
+
+        report = fleet_chaos_drill(replicas=4, duration_s=6.0 if limited else 10.0)
+        load = report['load']
+        return {
+            'ok': report['ok'],
+            'replicas': 4,
+            'requests': load['requests'],
+            'samples_per_s': load['samples_per_s'],
+            'p50_ms': load['p50_ms'],
+            'p99_ms': load['p99_ms'],
+            'availability': load['availability'],
+            'bit_exact': load['mismatches'] == 0,
+            'errors': load['errors'],
+            'single_stream_samples_per_s': report['phases']['baseline']['single_stream_samples_per_s'],
+            'speedup_vs_single_stream': report['speedup_vs_single_stream'],
+            'checks_failed': sorted(k for k, v in report['checks'].items() if not v),
+        }
     if name == 'select_modes':
         # selection-mode microbench: top4 (XLA O(S*P) score cache) vs the
         # full-rescan xla path vs the single-kernel fused Pallas loop
@@ -866,7 +891,7 @@ _CONFIG_SECTIONS = (
     '4_qconv3x3_im2col',
     '5_full_model_trace',
 )
-_MICRO_SECTIONS = ('quality_sweep', 'quality_beam', 'select_modes', 'dais_inference', 'campaign', 'serve', 'store')
+_MICRO_SECTIONS = ('quality_sweep', 'quality_beam', 'select_modes', 'dais_inference', 'campaign', 'serve', 'store', 'fleet')
 
 
 def _run_section_child(name: str, n1: int, timeout: float, env: dict | None = None) -> dict:

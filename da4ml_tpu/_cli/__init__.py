@@ -34,7 +34,10 @@ src/da4ml/_cli/__init__.py:8-27):
   chaos drill (docs/store.md);
 - ``export`` — fuse a saved model into ONE DAIS program and write the
   self-contained, digest-stamped serving artifact ``ServeEngine`` hot-loads
-  without retracing (docs/runtime.md#ir-fusion).
+  without retracing (docs/runtime.md#ir-fusion);
+- ``fleet`` — spawn + supervise N serve replicas over one artifact behind
+  the health-aware hedging router, with its SIGKILL+reload chaos drill
+  (docs/serving.md#replica-fleets).
 """
 
 from __future__ import annotations
@@ -119,6 +122,12 @@ def main(argv: list[str] | None = None) -> int:
     p_cache = sub.add_parser('cache', help='Operate a global solution store (stats / verify / gc / chaos)')
     add_cache_args(p_cache)
     p_cache.set_defaults(func=cache_main)
+
+    from .fleet import add_fleet_args, fleet_main
+
+    p_fleet = sub.add_parser('fleet', help='Drive a replica fleet behind the health-aware hedging router')
+    add_fleet_args(p_fleet)
+    p_fleet.set_defaults(func=fleet_main)
 
     args = parser.parse_args(argv)
     return args.func(args) or 0

@@ -1,0 +1,128 @@
+"""``da4ml-tpu fleet`` — replica-fleet serving driver.
+
+Spawns N supervised ``da4ml-tpu serve`` replicas hot-loading one export
+artifact, mounts the health-aware hedging router above them, and prints
+one JSON ready line with the router URL (docs/serving.md#replica-fleets):
+
+    da4ml-tpu export model.json artifact/
+    da4ml-tpu fleet --artifact artifact/ --replicas 4 --store /mnt/solutions
+
+``--status`` prints the live replica set of an existing registry dir;
+``--chaos`` runs the fleet chaos drill (SIGKILL + hot reload under
+sustained load, the CI ``fleet-chaos`` job) and exits 0/1 on its gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from pathlib import Path
+
+
+def add_fleet_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument('--artifact', type=Path, default=None, help='Export artifact dir every replica hot-loads')
+    parser.add_argument('--replicas', type=int, default=4, help='Number of serve replicas (default 4)')
+    parser.add_argument(
+        '--fleet-dir', type=Path, default=None, help='Fleet state dir: registry, logs, local cache tiers (default tmp)'
+    )
+    parser.add_argument(
+        '--store', type=Path, default=None, help='Shared solution store dir (replicas get per-replica local tiers)'
+    )
+    parser.add_argument('--model-name', default='default', help='Model name the replicas serve (default: default)')
+    parser.add_argument('--router-port', type=int, default=0, help='Router bind port (0 = ephemeral)')
+    parser.add_argument('--router-host', default='127.0.0.1', help='Router bind host')
+    parser.add_argument('--hedge-ms', type=float, default=75.0, help='Straggler hedge delay at the router')
+    parser.add_argument('--max-attempts', type=int, default=3, help='Max legs (primary + hedge/retries) per request')
+    parser.add_argument('--duration', type=float, default=0.0, help='Run for N seconds then stop (0 = until signal)')
+    parser.add_argument('--status', action='store_true', help='Print the live replica set of --fleet-dir and exit')
+    parser.add_argument('--chaos', action='store_true', help='Run the fleet SIGKILL+reload chaos drill and exit')
+    parser.add_argument('--drill-duration', type=float, default=10.0, help='--chaos: sustained load duration (s)')
+    parser.add_argument('--json', action='store_true', dest='as_json', help='--chaos: print the full report as JSON')
+    parser.add_argument('--out', type=Path, default=None, help='--chaos: also write the report JSON here')
+
+
+def fleet_main(args: argparse.Namespace) -> int:
+    from ..telemetry import get_logger
+
+    log = get_logger('cli.fleet')
+
+    if args.status:
+        if args.fleet_dir is None:
+            log.warning('--status requires --fleet-dir')
+            return 2
+        from ..serve.fleet import discover_replicas
+
+        live = discover_replicas(Path(args.fleet_dir) / 'registry')
+        log.info(json.dumps({'n_live': len(live), 'replicas': live}, indent=1, default=str))
+        return 0
+
+    if args.chaos:
+        from ..serve.chaos import fleet_chaos_drill
+
+        report = fleet_chaos_drill(
+            replicas=args.replicas,
+            duration_s=args.drill_duration,
+            hedge_ms=args.hedge_ms,
+            fleet_dir=args.fleet_dir,
+        )
+        log.info(json.dumps(report if args.as_json else report['checks'], indent=1, default=str))
+        if args.out is not None:
+            args.out.write_text(json.dumps(report, indent=1, default=str))
+        return 0 if report['ok'] else 1
+
+    if args.artifact is None:
+        log.warning('--artifact is required (run `da4ml-tpu export` first), or use --chaos / --status')
+        return 2
+
+    from ..serve.fleet import Fleet
+    from ..serve.router import Router, RouterServer
+
+    fleet = Fleet(
+        args.artifact,
+        replicas=args.replicas,
+        fleet_dir=args.fleet_dir,
+        model_name=args.model_name,
+        shared_store=args.store,
+    )
+    fleet.start()
+    try:
+        live = fleet.wait_ready(timeout_s=120.0)
+    except TimeoutError as e:
+        log.warning(json.dumps({'error': str(e), 'exit': 1}))
+        fleet.stop()
+        return 1
+    router = Router(registry_dir=fleet.registry_dir, hedge_ms=args.hedge_ms, max_attempts=args.max_attempts)
+    router.refresh()
+    server = RouterServer(router, port=args.router_port, host=args.router_host)
+    ready = {
+        'routing': server.url,
+        'replicas': [{'replica_id': d['replica_id'], 'url': d['url']} for d in live],
+        'fleet_dir': str(fleet.fleet_dir),
+        'endpoints': ['/v1/infer', '/v1/solve', '/v1/replicas', '/metrics', '/healthz', '/statusz'],
+    }
+    log.info(json.dumps(ready))
+    sys.stdout.flush()
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    prev_term = signal.signal(signal.SIGTERM, _graceful)
+    prev_int = signal.signal(signal.SIGINT, _graceful)
+    import time
+
+    deadline = time.monotonic() + args.duration if args.duration > 0 else None
+    try:
+        while not stop.is_set() and (deadline is None or time.monotonic() < deadline):
+            stop.wait(0.2)
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+        server.close()
+        fleet.stop()
+        log.info(json.dumps({'stopped': True, 'exit': 0}))
+    return 0

@@ -57,6 +57,16 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         '--solve-deadline-ms', type=float, default=30000.0, help='/v1/solve default deadline (0 = unbounded)'
     )
+    parser.add_argument(
+        '--registry',
+        type=Path,
+        default=None,
+        metavar='DIR',
+        help='Announce this replica in a fleet registry dir (lease + URL sidecar; docs/serving.md#replica-fleets)',
+    )
+    parser.add_argument(
+        '--replica-id', default=None, help='Registry slot id (default: r<pid>); requires --registry'
+    )
     parser.add_argument('--duration', type=float, default=0.0, help='Serve for N seconds then drain (0 = until signal)')
     parser.add_argument('--chaos', action='store_true', help='Run the breaker-trip + reload chaos drill and exit')
     parser.add_argument('--drill-duration', type=float, default=6.0, help='--chaos: load duration in seconds')
@@ -134,11 +144,30 @@ def serve_main(args: argparse.Namespace) -> int:
     endpoints = ['/v1/infer', '/v1/models', '/metrics', '/healthz', '/statusz']
     if solve_service is not None:
         endpoints.insert(1, '/v1/solve')
+
+    announcement = None
+    if args.registry is not None:
+        from ..serve.fleet import announce_replica
+
+        replica_id = args.replica_id or f'r{os.getpid()}'
+        announcement = announce_replica(
+            args.registry,
+            replica_id,
+            server.url,
+            meta={'models': [m['name'] for m in engine.models()['models']]},
+        )
+        if announcement is None:
+            log.warning(json.dumps({'error': f'registry slot {replica_id} is held by a live replica', 'exit': 3}))
+            server.close()
+            return 3
+
     ready = {
         'serving': server.url,
         'models': [m['name'] for m in engine.models()['models']],
         'endpoints': endpoints,
     }
+    if announcement is not None:
+        ready['replica_id'] = announcement.replica_id
     log.info(json.dumps(ready))
     sys.stdout.flush()
 
@@ -156,8 +185,11 @@ def serve_main(args: argparse.Namespace) -> int:
     finally:
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
-        # graceful drain: stop admitting, serve everything accepted, then
-        # close — the zero-lost-accepted-requests exit contract
+        # withdraw from the registry FIRST so routers stop sending new
+        # traffic, then drain what was already accepted — the
+        # zero-lost-accepted-requests exit contract
+        if announcement is not None:
+            announcement.close()
         drained = engine.drain(timeout=30.0)
         if solve_service is not None:
             solve_service.close()

@@ -5,6 +5,14 @@ a missing backend or a malformed request fails fast. Jitter is full-range
 (AWS architecture-blog style): sleep uniform in [0, base * 2**attempt],
 capped, so synchronized clients (a distributed campaign restarting after a
 coordinator blip) do not stampede.
+
+When the failure carries a server-supplied backpressure hint — a
+``retry_after_s`` attribute, the structured twin of HTTP ``Retry-After``
+(``serve.batching.ServeRejected``, ``store.StoreNegativeEntry``) — the
+hint replaces the exponential guess for that attempt: the server knows its
+drain horizon better than a doubling schedule does. The hint is capped at
+``max_delay`` and jittered *upward only* (up to +25%) — sleeping less than
+the server asked would just get the request shed again.
 """
 
 from __future__ import annotations
@@ -42,9 +50,17 @@ def retry_call(
         except BaseException as exc:  # noqa: BLE001 - classified below
             if attempt >= retries or not should_retry(exc):
                 raise
-            delay = min(max_delay, base_delay * (2.0**attempt))
-            if jitter:
-                delay *= random.random()
+            hint = getattr(exc, 'retry_after_s', None)
+            if isinstance(hint, (int, float)) and hint >= 0:
+                # server-provided horizon: honor it (capped), jitter only up
+                delay = min(max_delay, float(hint))
+                if jitter:
+                    delay = min(max_delay, delay * (1.0 + 0.25 * random.random()))
+                telemetry.counter('retry.hints_honored').inc()
+            else:
+                delay = min(max_delay, base_delay * (2.0**attempt))
+                if jitter:
+                    delay *= random.random()
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
             telemetry.counter('retry.sleeps').inc()

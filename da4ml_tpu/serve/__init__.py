@@ -14,7 +14,11 @@ envelope built from the ``reliability`` primitives:
   structured 503s),
 - optional **hedged dispatch** for straggler batches,
 - **graceful drain / hot reload** of an LRU-bounded multi-model registry
-  with canonical-grid prewarm.
+  with canonical-grid prewarm,
+- **replica fleets** (docs/serving.md#replica-fleets): :class:`Fleet`
+  supervises N serve subprocesses over one export artifact and a
+  lease-file registry; :class:`Router` fans requests over the live set
+  with health probing, per-replica breakers, and hedged retries.
 
 Architecture model: TVM's graph-runtime split (compiled executors below a
 thin request plane, PAPERS.md arXiv:1802.04799) with Clipper-style
@@ -53,18 +57,31 @@ __all__ = [
     'ModelNotFound',
     'Draining',
     'chaos_drill',
+    'fleet_chaos_drill',
+    'Fleet',
+    'Router',
+    'RouterServer',
+    'TieredStore',
 ]
+
+#: lazy attribute -> "module:name" (heavier stacks resolve on first touch so
+#: `from da4ml_tpu.serve import ServeEngine` stays light)
+_LAZY = {
+    'ServeServer': ('.http', 'ServeServer'),
+    'chaos_drill': ('.chaos', 'chaos_drill'),
+    'fleet_chaos_drill': ('.chaos', 'fleet_chaos_drill'),
+    'Fleet': ('.fleet', 'Fleet'),
+    'Router': ('.router', 'Router'),
+    'RouterServer': ('.router', 'RouterServer'),
+    'TieredStore': ('..store.tiered', 'TieredStore'),
+}
 
 
 def __getattr__(name):
-    # the HTTP server and chaos drill pull in heavier stacks; lazy-resolve
-    # so `from da4ml_tpu.serve import ServeEngine` stays light
-    if name == 'ServeServer':
-        from .http import ServeServer
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+    import importlib
 
-        return ServeServer
-    if name == 'chaos_drill':
-        from .chaos import chaos_drill
-
-        return chaos_drill
-    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+    module = importlib.import_module(target[0], __name__)
+    return getattr(module, target[1])

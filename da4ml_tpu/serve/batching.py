@@ -89,6 +89,15 @@ class Draining(ServeRejected):
     http_status = 503
 
 
+class PayloadTooLarge(ServeRejected):
+    """The request body exceeds the configured byte ceiling
+    (``DA4ML_SERVE_MAX_BODY_BYTES``) — HTTP 413, rejected before a single
+    body byte is buffered. Not retryable on another replica: every replica
+    enforces the same ceiling."""
+
+    http_status = 413
+
+
 class ModelNotFound(ServeRejected):
     """No such model in the registry (HTTP 404)."""
 

@@ -15,6 +15,16 @@ closed-loop load running against a warm server,
 
 Run via ``da4ml-tpu serve --chaos`` (the CI ``serve-chaos`` job) or
 programmatically (tests/test_serve.py).
+
+:func:`fleet_chaos_drill` is the multi-process variant behind
+``da4ml-tpu fleet --chaos`` (the CI ``fleet-chaos`` job): N replica
+subprocesses over one exported artifact and one shared solution store,
+fronted by the hedged-retry :class:`~.router.Router`. One replica is
+SIGKILLed and another hot-reloaded mid-load; the gate additionally
+requires a fleet-throughput speedup over a single-stream baseline and a
+proof (``store.tier.*`` counters scraped from the restarted replica's
+``/metrics``) that a cold replica warms from the shared cache tier
+instead of re-solving.
 """
 
 from __future__ import annotations
@@ -172,4 +182,252 @@ def chaos_drill(
             'healthz_ok_at_end': final_health == 'ok',
             'drained_clean': drained,
         },
+    }
+
+
+# ---------------------------------------------------------------------------
+# fleet drill: kill + reload across replica subprocesses, warm-from-shared
+# ---------------------------------------------------------------------------
+
+
+def _post_json(url: str, path: str, doc: dict | None = None, timeout_s: float = 60.0) -> dict:
+    body = json.dumps(doc).encode() if doc is not None else b''
+    req = urllib.request.Request(f'{url}{path}', data=body, headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.load(resp)
+
+
+def _scrape_counters(url: str, prefix: str = 'da4ml_store_tier_') -> dict[str, float]:
+    """Counter samples matching ``prefix`` from a replica's ``/metrics``."""
+    try:
+        with urllib.request.urlopen(f'{url}/metrics', timeout=5) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return {}
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith(prefix) and ' ' in line:
+            name, _, val = line.partition(' ')
+            try:
+                out[name] = float(val)
+            except ValueError:
+                pass
+    return out
+
+
+def _solve_on(url: str, kernel, timeout_s: float = 120.0) -> dict:
+    """POST one solve (program payload elided) to a specific replica."""
+    return _post_json(url, '/v1/solve', {'kernel': np.asarray(kernel).tolist(), 'pipeline': False}, timeout_s)
+
+
+def fleet_chaos_drill(
+    *,
+    replicas: int = 4,
+    duration_s: float = 10.0,
+    workers: int = 32,
+    deadline_ms: float = 1000.0,
+    hedge_ms: float = 75.0,
+    fleet_dir: str | None = None,
+    p99_budget_ms: float = 400.0,
+    speedup_floor: float = 10.0,
+) -> dict:
+    """Run the replica-fleet kill + reload drill; returns a gateable report.
+
+    Spawns ``replicas`` (floored at 4 — the drill assigns distinct roles)
+    serve subprocesses over a freshly exported artifact and one shared
+    solution store, fronts them with a hedged-retry router, and under
+    sustained closed-loop load SIGKILLs one replica (the supervisor must
+    restart it and the restart must steal the slot lease cleanly) while
+    hot-reloading another. The throughput gate compares the fleet under
+    full concurrency against a *single-stream* baseline (one synchronous
+    client against one replica — each request pays the full batch
+    coalescing window that concurrency amortizes).
+    """
+    import tempfile
+    from pathlib import Path
+
+    from .export import export_model
+    from .fleet import Fleet, discover_replicas
+    from .loadgen import http_infer_fn
+    from .router import Router, RouterServer
+
+    n = max(4, int(replicas))
+    root = Path(fleet_dir) if fleet_dir is not None else Path(tempfile.mkdtemp(prefix='da4ml-fleet-drill-'))
+    root.mkdir(parents=True, exist_ok=True)
+
+    # one artifact, one shared store — every replica hot-loads the same
+    # PR-14 export and caches solves through the same shared tier
+    model = _default_model()
+    artifact = root / 'artifact'
+    export_model(model, artifact, name='default', stablehlo=False)
+    from .engine import _as_binaries
+    from ..ir.dais_binary import decode
+
+    binaries, _src = _as_binaries(model)
+    n_in = decode(binaries[0]).n_in
+    oracle = _numpy_oracle(binaries)
+    pool = make_request_pool(oracle, n_in, rows_choices=(1, 2, 4, 8), pool=32)
+
+    # a second kernel exercises the solve path's tier machinery: solved
+    # cold on exactly one replica, served from the shared tier everywhere
+    rng = np.random.default_rng(11)
+    solve_kernel = rng.integers(-8, 8, (6, 4)).astype(np.float64)
+
+    fleet = Fleet(
+        artifact,
+        replicas=n,
+        fleet_dir=root / 'fleet',
+        model_name='default',
+        shared_store=root / 'store',
+        # host-side solves + a widened coalescing window: a single-stream
+        # client pays the full window per request while concurrent load
+        # amortizes it across the batch — the amortization the fleet
+        # exists to provide, and what the speedup gate measures
+        serve_args=['--solve-backend', 'cpu', '--max-latency-ms', '25'],
+    )
+    phases: dict[str, dict] = {}
+    events: list[str] = []
+    report_box: dict = {}
+    server = None
+    try:
+        with telemetry.span('serve.fleet_chaos_drill', replicas=n):
+            fleet.start()
+            fleet.wait_ready(timeout_s=180.0)
+            router = Router(fleet.registry_dir, hedge_ms=hedge_ms, default_deadline_ms=deadline_ms)
+            router.refresh()
+            server = RouterServer(router)
+            urls = {d['replica_id']: d['url'] for d in discover_replicas(fleet.registry_dir)}
+            rids = sorted(urls)
+
+            # phase 0: single-stream baseline — one synchronous client
+            # against one replica, the denominator of the speedup gate
+            baseline = closed_loop(
+                http_infer_fn(urls[rids[0]], 'default'),
+                pool,
+                workers=1,
+                duration_s=max(min(duration_s / 3.0, 3.0), 1.0),
+                deadline_ms=deadline_ms,
+            )
+            phases['baseline'] = {
+                'replica': rids[0],
+                'single_stream_samples_per_s': baseline.get('samples_per_s'),
+                'p50_ms': baseline.get('p50_ms'),
+            }
+
+            # phase 1: warm-from-shared — rids[0] solves cold (publishes to
+            # the shared tier), rids[1] must answer from the store with its
+            # tier counters proving a shared-tier hit, not a re-solve
+            cold = _solve_on(urls[rids[0]], solve_kernel)
+            warm = _solve_on(urls[rids[1]], solve_kernel)
+            warm_tiers = _scrape_counters(urls[rids[1]])
+            phases['warm_from_shared'] = {
+                'cold_replica': rids[0],
+                'cold_source': cold.get('source'),
+                'warm_replica': rids[1],
+                'warm_source': warm.get('source'),
+                'warm_tier_counters': warm_tiers,
+                'same_key': cold.get('key') == warm.get('key'),
+            }
+
+            # phase 2: sustained load through the router, chaos mid-load
+            router_infer = http_infer_fn(server.url, 'default')
+
+            def load_thread():
+                report_box['load'] = closed_loop(
+                    router_infer, pool, workers=workers, duration_s=duration_s, deadline_ms=deadline_ms
+                )
+
+            kill_id, reload_id = rids[2], rids[3]
+            kill_old_pid = next(d['pid'] for d in discover_replicas(fleet.registry_dir) if d['replica_id'] == kill_id)
+            lt = threading.Thread(target=load_thread, daemon=True)
+            lt.start()
+            time.sleep(max(duration_s / 3.0, 1.0))
+            killed_pid = fleet.kill_replica(kill_id)
+            events.append(f'SIGKILL {kill_id} pid={killed_pid}')
+            time.sleep(max(duration_s / 6.0, 0.5))
+            events.append(f'router healthz after kill: {_healthz_status(server.url)}')
+            reload_doc = _post_json(urls[reload_id], '/v1/models/default/reload')
+            events.append(f'hot reload {reload_id} -> version {reload_doc.get("version")}')
+            phases['reload'] = {'replica': reload_id, 'new_version': int(reload_doc.get('version', 0))}
+            lt.join(duration_s + 120.0)
+
+            # phase 3: the killed slot must come back (supervisor restart +
+            # single-winner lease steal) as a *cold* process that warms its
+            # first solve from the shared tier instead of re-solving
+            restarted = None
+            t_wait = time.monotonic() + 120.0
+            while time.monotonic() < t_wait:
+                restarted = next(
+                    (
+                        d
+                        for d in discover_replicas(fleet.registry_dir)
+                        if d['replica_id'] == kill_id and d['pid'] != kill_old_pid
+                    ),
+                    None,
+                )
+                if restarted is not None:
+                    break
+                time.sleep(0.25)
+            restart_phase: dict = {'replica': kill_id, 'restarted': restarted is not None}
+            if restarted is not None:
+                rewarm = _solve_on(restarted['url'], solve_kernel)
+                tiers = _scrape_counters(restarted['url'])
+                restart_phase.update(
+                    {
+                        'new_pid': restarted['pid'],
+                        'lease_generation': restarted['lease'].get('generation'),
+                        'rewarm_source': rewarm.get('source'),
+                        'tier_counters': tiers,
+                        'solve_ms': rewarm.get('solve_ms'),
+                    }
+                )
+            restart_phase['slot_restarts'] = next(
+                (s['restarts'] for s in fleet.status()['replicas'] if s['replica_id'] == kill_id), 0
+            )
+            phases['kill_restart'] = restart_phase
+            fleet_at_end = fleet.status()
+    finally:
+        if server is not None:
+            server.close()
+        fleet.stop()
+
+    load = report_box.get('load', {})
+    single = phases.get('baseline', {}).get('single_stream_samples_per_s') or 0.0
+    speedup = round((load.get('samples_per_s') or 0.0) / single, 2) if single else None
+    kill_restart = phases.get('kill_restart', {})
+    warm_shared = phases.get('warm_from_shared', {})
+    tier_hits = kill_restart.get('tier_counters', {})
+    checks = {
+        'bit_exact': load.get('mismatches', 1) == 0,
+        'availability_ge_99': (load.get('availability') or 0.0) >= 0.99,
+        'no_unstructured_errors': load.get('errors', 1) == 0,
+        'p99_in_budget': 0.0 < (load.get('p99_ms') or 0.0) <= p99_budget_ms,
+        'speedup_ge_floor': speedup is not None and speedup >= speedup_floor,
+        'warm_from_shared': bool(
+            warm_shared.get('warm_source') == 'store'
+            and warm_shared.get('same_key')
+            and (warm_shared.get('warm_tier_counters') or {}).get('da4ml_store_tier_shared_hits_total', 0) >= 1
+        ),
+        'killed_replica_restarted': bool(kill_restart.get('restarted')) and kill_restart.get('slot_restarts', 0) >= 1,
+        'cold_restart_warm_from_shared': bool(
+            kill_restart.get('rewarm_source') == 'store'
+            and tier_hits.get('da4ml_store_tier_shared_hits_total', 0) >= 1
+        ),
+        'reloaded_under_load': phases.get('reload', {}).get('new_version', 0) >= 2,
+        'all_replicas_announced_at_end': fleet_at_end['n_announced'] >= n,
+    }
+    return {
+        'ok': all(checks.values()),
+        'load': load,
+        'speedup_vs_single_stream': speedup,
+        'speedup_floor': speedup_floor,
+        'p99_budget_ms': p99_budget_ms,
+        'phases': phases,
+        'events': events,
+        'fleet': {
+            'n': n,
+            'restarts': sum(s['restarts'] for s in fleet_at_end['replicas']),
+            'n_announced_at_end': fleet_at_end['n_announced'],
+        },
+        'checks': checks,
     }

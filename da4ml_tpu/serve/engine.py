@@ -634,8 +634,12 @@ class ServeEngine:
                 'deadline_miss_total': s.deadline_miss_total,
                 'degraded_total': s.degraded_total,
             }
+        # draining wins over degraded: a draining server is about to exit,
+        # so routers must stop sending regardless of anything else — the
+        # explicit state is what lets them stop BEFORE the replica vanishes
+        status = 'draining' if self._draining else ('degraded' if degraded else 'ok')
         return {
-            'status': 'degraded' if degraded else 'ok',
+            'status': status,
             'draining': self._draining,
             'shed_rate_1m': round(self.shed_rate_1m(), 4),
             'queue_stall_threshold_s': stall_s,
@@ -650,7 +654,12 @@ def serve_health() -> dict | None:
     if not engines:
         return None
     docs = [e.health_doc() for e in engines]
-    status = 'degraded' if any(d['status'] == 'degraded' for d in docs) else 'ok'
+    if any(d['status'] == 'draining' for d in docs):
+        status = 'draining'
+    elif any(d['status'] == 'degraded' for d in docs):
+        status = 'degraded'
+    else:
+        status = 'ok'
     merged_models: dict = {}
     for d in docs:
         merged_models.update(d['models'])

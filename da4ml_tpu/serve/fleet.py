@@ -1,0 +1,426 @@
+"""Replica fleet driver: N serve processes, one lease-file registry.
+
+The request plane scales across processes (and hosts) the same way solve
+campaigns do (docs/distributed.md): no coordinator, only files on a shared
+directory. Each replica holds a short-TTL lease (``reliability.lease``) on
+its slot plus a sidecar document with its bound URL; routers
+(:mod:`.router`) discover the live set by listing leases — a replica that
+dies simply stops renewing and ages out of the registry within
+``ttl + grace`` seconds, no deregistration RPC required.
+
+Registry layout (one fleet = one directory)::
+
+    <registry>/leases/replica-<id>.lease   liveness claims (reliability.lease)
+    <registry>/<id>.replica.json           sidecar: url, pid, host, artifact
+
+The slot lease doubles as the restart gate: a replacement replica claims
+``replica-<id>`` through the same single-winner steal machinery campaign
+workers use, so a SIGKILLed replica's slot is adopted by exactly one
+successor even when restarts race (tests/test_fleet.py).
+
+:class:`Fleet` is the local driver behind ``da4ml-tpu fleet``: it spawns N
+``da4ml-tpu serve`` subprocesses hot-loading the same PR-14 export
+artifact, supervises them (restart with exponential backoff on crash),
+and points them all at one shared solution store with per-replica local
+cache tiers (``DA4ML_STORE_LOCAL_TIER``, :mod:`..store.tiered`) so a
+restarted replica warms from the shared tier instead of re-solving.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from pathlib import Path
+
+from .. import telemetry
+from ..reliability.checkpoint import atomic_write_bytes
+from ..reliability.lease import DEFAULT_GRACE_S, claim_lease, default_owner, list_leases, release_lease, renew_lease
+
+#: replica liveness lease TTL: short enough that routers drop a SIGKILLed
+#: replica within seconds, long enough that renew-at-ttl/3 is cheap
+DEFAULT_REPLICA_TTL_S = 5.0
+
+#: restart backoff bounds (exponential, per slot)
+RESTART_BACKOFF_S = 0.5
+RESTART_BACKOFF_CAP_S = 5.0
+
+_LEASE_PREFIX = 'replica-'
+
+
+# ------------------------------------------------------------------ registry
+
+
+class ReplicaAnnouncement:
+    """One replica's presence in the registry: the slot lease (renewed at
+    ttl/3 by a daemon thread) plus the URL sidecar. ``close()`` withdraws
+    both — routers stop routing here within one discovery cycle."""
+
+    def __init__(self, registry_dir: str | os.PathLike, replica_id: str, lease, doc: dict):
+        self.registry_dir = Path(registry_dir)
+        self.replica_id = replica_id
+        self.lease = lease
+        self.doc = doc
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._renew_loop, name=f'da4ml-replica-renew-{replica_id}', daemon=True
+        )
+        self._thread.start()
+
+    def _renew_loop(self) -> None:
+        interval = max(self.lease.ttl_s / 3.0, 0.2)
+        while not self._stop.wait(interval):
+            try:
+                if not renew_lease(self.lease):
+                    # slot stolen (we were presumed dead): stop announcing —
+                    # exactly one replica may own a slot at a time
+                    telemetry.counter('fleet.announcements_lost').inc()
+                    return
+            except OSError:
+                return
+
+    @property
+    def live(self) -> bool:
+        return self._thread.is_alive() and not self.lease.lost
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        try:
+            (self.registry_dir / f'{self.replica_id}.replica.json').unlink()
+        except OSError:
+            pass
+        try:
+            release_lease(self.lease)
+        except OSError:
+            pass
+
+
+def announce_replica(
+    registry_dir: str | os.PathLike,
+    replica_id: str,
+    url: str,
+    meta: dict | None = None,
+    ttl_s: float = DEFAULT_REPLICA_TTL_S,
+) -> ReplicaAnnouncement | None:
+    """Claim the ``replica-<id>`` slot and publish the URL sidecar; None
+    when another *live* process holds the slot (an expired holder is stolen
+    through the lease machinery — single winner)."""
+    registry = Path(registry_dir)
+    registry.mkdir(parents=True, exist_ok=True)
+    # per-announcement owner token: the default host:pid owner would let a
+    # second announcement in the same process silently adopt the first's
+    # live lease instead of being refused (slots are exclusive)
+    owner = f'{default_owner()}:{os.urandom(4).hex()}'
+    lease = claim_lease(registry / 'leases', f'{_LEASE_PREFIX}{replica_id}', owner=owner, ttl_s=ttl_s)
+    if lease is None:
+        return None
+    doc = {
+        'replica_id': replica_id,
+        'url': url,
+        'pid': os.getpid(),
+        'host': socket.gethostname(),
+        'announced_at': round(time.time(), 3),
+        **(meta or {}),
+    }
+    try:
+        atomic_write_bytes(registry / f'{replica_id}.replica.json', json.dumps(doc, sort_keys=True).encode())
+    except OSError:
+        release_lease(lease)
+        return None
+    telemetry.counter('fleet.announcements').inc()
+    return ReplicaAnnouncement(registry, replica_id, lease, doc)
+
+
+def discover_replicas(registry_dir: str | os.PathLike, grace_s: float = DEFAULT_GRACE_S) -> list[dict]:
+    """The live replica set: every unexpired ``replica-*`` lease with a
+    readable sidecar, sorted by id. Safe to call from any process — it only
+    reads. A replica whose lease expired (it died, or is stalled past
+    renewal) is excluded even if its sidecar file remains."""
+    registry = Path(registry_dir)
+    now = time.time()
+    out: list[dict] = []
+    for key, lease_doc in sorted(list_leases(registry / 'leases').items()):
+        if not key.startswith(_LEASE_PREFIX):
+            continue
+        if now > float(lease_doc.get('expires_at', 0.0)) + grace_s:
+            continue
+        replica_id = key[len(_LEASE_PREFIX) :]
+        try:
+            doc = json.loads((registry / f'{replica_id}.replica.json').read_text())
+        except (OSError, ValueError):
+            continue
+        doc['lease'] = {
+            'owner': lease_doc.get('owner'),
+            'expires_at': lease_doc.get('expires_at'),
+            'generation': lease_doc.get('generation'),
+        }
+        out.append(doc)
+    return out
+
+
+# --------------------------------------------------------------------- fleet
+
+
+class _Slot:
+    """One supervised replica slot: its subprocess, restart count, log."""
+
+    __slots__ = ('replica_id', 'proc', 'restarts', 'log_path', 'backoff_s')
+
+    def __init__(self, replica_id: str, log_path: Path):
+        self.replica_id = replica_id
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self.log_path = log_path
+        self.backoff_s = RESTART_BACKOFF_S
+
+
+_FLEETS: 'weakref.WeakSet[Fleet]' = weakref.WeakSet()
+
+
+class Fleet:
+    """Spawn + supervise N local ``da4ml-tpu serve`` replicas over one
+    artifact and one registry directory.
+
+    Every replica gets the same shared solution store
+    (``DA4ML_SOLUTION_STORE``) and its own local cache tier
+    (``DA4ML_STORE_LOCAL_TIER=<fleet_dir>/local/<id>``), so the first
+    replica to solve a key publishes it for the whole fleet and a restarted
+    replica warms from the shared tier. A crashed replica is restarted with
+    exponential backoff; the restarted process re-claims its slot lease
+    through the single-winner steal path."""
+
+    def __init__(
+        self,
+        artifact: str | os.PathLike,
+        replicas: int = 4,
+        fleet_dir: str | os.PathLike | None = None,
+        model_name: str = 'default',
+        shared_store: str | os.PathLike | None = None,
+        serve_args: list[str] | None = None,
+        env: dict | None = None,
+        replica_ttl_s: float = DEFAULT_REPLICA_TTL_S,
+    ):
+        import tempfile
+
+        self.artifact = Path(artifact)
+        self.n = max(1, int(replicas))
+        self.model_name = model_name
+        self.fleet_dir = Path(fleet_dir) if fleet_dir is not None else Path(tempfile.mkdtemp(prefix='da4ml-fleet-'))
+        self.registry_dir = self.fleet_dir / 'registry'
+        self.shared_store = Path(shared_store) if shared_store is not None else None
+        self.serve_args = list(serve_args or [])
+        self.replica_ttl_s = replica_ttl_s
+        self._extra_env = dict(env or {})
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        (self.fleet_dir / 'logs').mkdir(parents=True, exist_ok=True)
+        self.registry_dir.mkdir(parents=True, exist_ok=True)
+        self._slots = [_Slot(f'r{i}', self.fleet_dir / 'logs' / f'r{i}.log') for i in range(self.n)]
+        self._supervisors: list[threading.Thread] = []
+        _FLEETS.add(self)
+
+    # -- spawning ------------------------------------------------------------
+
+    def _env_for(self, slot: _Slot) -> dict:
+        env = dict(os.environ)
+        env.update(self._extra_env)
+        if self.shared_store is not None:
+            env['DA4ML_SOLUTION_STORE'] = str(self.shared_store)
+            local = self.fleet_dir / 'local' / slot.replica_id
+            local.mkdir(parents=True, exist_ok=True)
+            env['DA4ML_STORE_LOCAL_TIER'] = str(local)
+        return env
+
+    def _spawn(self, slot: _Slot) -> subprocess.Popen:
+        cmd = [
+            sys.executable,
+            '-m',
+            'da4ml_tpu',
+            'serve',
+            f'{self.model_name}={self.artifact}',
+            '--port',
+            '0',
+            '--registry',
+            str(self.registry_dir),
+            '--replica-id',
+            slot.replica_id,
+            *self.serve_args,
+        ]
+        if self.shared_store is not None and '--solve-store' not in self.serve_args:
+            cmd += ['--solve-store', str(self.shared_store)]
+        log = open(slot.log_path, 'ab')
+        try:
+            proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT, env=self._env_for(slot))
+        finally:
+            log.close()  # the child holds its own fd now
+        telemetry.counter('fleet.spawns').inc()
+        return proc
+
+    def _supervise(self, slot: _Slot) -> None:
+        while not self._stop.is_set():
+            proc = slot.proc
+            if proc is None:
+                return
+            rc = proc.wait()
+            if self._stop.is_set():
+                return
+            # crash (or unexpected clean exit): restart with backoff — the
+            # fresh process steals the expired slot lease and re-announces
+            slot.restarts += 1
+            telemetry.counter('fleet.restarts').inc()
+            telemetry.instant('fleet.restart', replica=slot.replica_id, rc=rc, restarts=slot.restarts)
+            if self._stop.wait(slot.backoff_s):
+                return
+            slot.backoff_s = min(slot.backoff_s * 2.0, RESTART_BACKOFF_CAP_S)
+            with self._lock:
+                if self._stop.is_set():
+                    return
+                slot.proc = self._spawn(slot)
+
+    def start(self) -> None:
+        with self._lock:
+            for slot in self._slots:
+                slot.proc = self._spawn(slot)
+        self._supervisors = [
+            threading.Thread(target=self._supervise, args=(s,), name=f'da4ml-fleet-sup-{s.replica_id}', daemon=True)
+            for s in self._slots
+        ]
+        for t in self._supervisors:
+            t.start()
+
+    def wait_ready(self, timeout_s: float = 60.0, n: int | None = None) -> list[dict]:
+        """Block until ``n`` (default: all) replicas are announced in the
+        registry; returns the discovered set. Raises TimeoutError with the
+        partial set's ids on expiry."""
+        want = self.n if n is None else n
+        deadline = time.monotonic() + timeout_s
+        while True:
+            live = discover_replicas(self.registry_dir)
+            if len(live) >= want:
+                return live
+            if time.monotonic() > deadline:
+                ids = sorted(d.get('replica_id', '?') for d in live)
+                raise TimeoutError(f'only {len(live)}/{want} replicas announced within {timeout_s}s: {ids}')
+            time.sleep(0.1)
+
+    # -- chaos hooks ---------------------------------------------------------
+
+    def kill_replica(self, replica_id: str, sig: int = signal.SIGKILL) -> int | None:
+        """Deliver ``sig`` to one replica (default SIGKILL — the crash
+        drill); returns the pid signalled, or None if the slot has no live
+        process. The supervisor restarts it with backoff."""
+        for slot in self._slots:
+            if slot.replica_id == replica_id and slot.proc is not None and slot.proc.poll() is None:
+                pid = slot.proc.pid
+                telemetry.counter('fleet.kills').inc()
+                os.kill(pid, sig)
+                return pid
+        return None
+
+    def replica_url(self, replica_id: str) -> str | None:
+        for doc in discover_replicas(self.registry_dir):
+            if doc.get('replica_id') == replica_id:
+                return doc.get('url')
+        return None
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        live = discover_replicas(self.registry_dir)
+        by_id = {d.get('replica_id'): d for d in live}
+        with self._lock:
+            slots = [
+                {
+                    'replica_id': s.replica_id,
+                    'pid': None if s.proc is None else s.proc.pid,
+                    'alive': s.proc is not None and s.proc.poll() is None,
+                    'restarts': s.restarts,
+                    'announced': s.replica_id in by_id,
+                    'url': (by_id.get(s.replica_id) or {}).get('url'),
+                }
+                for s in self._slots
+            ]
+        return {
+            'fleet_dir': str(self.fleet_dir),
+            'artifact': str(self.artifact),
+            'replicas': slots,
+            'n_live': sum(1 for s in slots if s['alive']),
+            'n_announced': len(live),
+            'registry': live,
+        }
+
+    def stop(self, grace_s: float = 15.0) -> None:
+        """SIGTERM every replica (graceful drain), escalate to SIGKILL for
+        stragglers past ``grace_s``."""
+        self._stop.set()
+        with self._lock:
+            procs = [s.proc for s in self._slots if s.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + grace_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        for t in self._supervisors:
+            t.join(timeout=2.0)
+
+
+# ------------------------------------------------------------------- health
+
+
+def fleet_health() -> dict | None:
+    """The /healthz ``fleet`` check for a process driving a fleet (None
+    otherwise). Resolved via ``sys.modules`` by ``telemetry.obs.health``."""
+    fleets = [f for f in _FLEETS if not f._stop.is_set()]
+    if not fleets:
+        return None
+    checks = []
+    for f in fleets:
+        st = f.status()
+        checks.append(
+            {
+                'fleet_dir': st['fleet_dir'],
+                'n_live': st['n_live'],
+                'n_announced': st['n_announced'],
+                'n_want': f.n,
+                'restarts': sum(s['restarts'] for s in st['replicas']),
+            }
+        )
+    degraded = any(c['n_announced'] < c['n_want'] for c in checks)
+    return {'status': 'degraded' if degraded else 'ok', 'fleets': checks}
+
+
+def fleet_status() -> dict | None:
+    """The /statusz ``fleet`` panel (full per-replica detail)."""
+    fleets = [f for f in _FLEETS if not f._stop.is_set()]
+    if not fleets:
+        return None
+    return {'fleets': [f.status() for f in fleets]}
+
+
+__all__ = [
+    'DEFAULT_REPLICA_TTL_S',
+    'Fleet',
+    'ReplicaAnnouncement',
+    'announce_replica',
+    'discover_replicas',
+    'fleet_health',
+    'fleet_status',
+]

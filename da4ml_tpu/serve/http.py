@@ -28,19 +28,38 @@ admission queue sheds anything beyond its hard ceiling.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from random import random
 
 import numpy as np
 
 from ..reliability.errors import InvalidInputError
-from .batching import ServeRejected
+from .batching import PayloadTooLarge, ServeRejected
 from .engine import ServeEngine
 
-#: request body ceiling (bytes): a hard parse-side bound so a single fat
-#: POST cannot balloon memory before admission control even sees it
+#: default request body ceiling (bytes): a hard parse-side bound so a
+#: single fat POST cannot balloon memory before admission control even
+#: sees it; override with DA4ML_SERVE_MAX_BODY_BYTES
 MAX_BODY_BYTES = 64 << 20
+
+
+def _max_body_bytes() -> int:
+    try:
+        return int(os.environ.get('DA4ML_SERVE_MAX_BODY_BYTES', '') or MAX_BODY_BYTES)
+    except ValueError:
+        return MAX_BODY_BYTES
+
+
+def _jitter_retry_after(seconds: float) -> float:
+    """±25% full jitter on an emitted backpressure hint: shed clients that
+    all honor the same Retry-After would otherwise re-arrive in one
+    synchronized herd and be shed again (docs/serving.md#backpressure).
+    Applied only at the wire — internal ``retry_after_s`` values stay
+    deterministic for tests and in-process callers."""
+    return max(seconds, 0.0) * (0.75 + 0.5 * random())
 
 
 class ServeServer:
@@ -80,7 +99,10 @@ class ServeServer:
                     doc = exc.to_doc()
                     headers = {}
                     if exc.retry_after_s is not None:
-                        headers['Retry-After'] = f'{max(exc.retry_after_s, 0.0):.3f}'
+                        # one jittered value, consistent across header + doc
+                        hint = _jitter_retry_after(exc.retry_after_s)
+                        doc['retry_after_s'] = round(hint, 3)
+                        headers['Retry-After'] = f'{hint:.3f}'
                     self._send_json(exc.http_status, {'error': doc}, headers=headers)
                 elif isinstance(exc, InvalidInputError):
                     self._send_json(400, {'error': {'type': 'InvalidInputError', 'message': str(exc), 'http_status': 400}})
@@ -168,8 +190,11 @@ class ServeServer:
                     length = int(self.headers.get('Content-Length', '0') or 0)
                 except ValueError:
                     length = 0
-                if length <= 0 or length > MAX_BODY_BYTES:
-                    raise InvalidInputError(f'request body must be 1..{MAX_BODY_BYTES} bytes, got {length}')
+                cap = _max_body_bytes()
+                if length > cap:
+                    raise PayloadTooLarge(f'request body of {length} bytes exceeds the {cap}-byte ceiling')
+                if length <= 0:
+                    raise InvalidInputError(f'request body must be 1..{cap} bytes, got {length}')
                 try:
                     body = json.loads(self.rfile.read(length))
                 except ValueError as e:
@@ -220,8 +245,14 @@ class ServeServer:
                     out['pipeline'] = doc['pipeline']
                 self._send_json(200, out)
 
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
-        self._httpd.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # the socketserver default backlog of 5 resets connections under
+            # reconnect bursts (routers + closed-loop clients open a fresh
+            # TCP connection per request)
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), _Handler)
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, name='da4ml-serve-http', daemon=True)

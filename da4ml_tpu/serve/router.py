@@ -1,0 +1,553 @@
+"""Health-aware HTTP router over a replica fleet (docs/serving.md#replica-fleets).
+
+The thin request plane above N ``da4ml-tpu serve`` replicas: Clipper-style
+hedged retries under TVM's compile/serve split (PAPERS.md). The router
+holds no model state — replicas are interchangeable because they hot-load
+the same digest-stamped artifact and every answer is bit-exact by
+construction, which is exactly what makes hedging safe: two replicas
+racing the same request can only produce identical bytes, so the first
+response wins and the loser is cancelled without a consistency check.
+
+Per-replica health, three signals deep:
+
+- **active probing** — a prober thread re-discovers the registry
+  (:func:`.fleet.discover_replicas`) and GETs each replica's ``/healthz``
+  every ``probe_interval_s``; an explicit ``draining`` status makes the
+  replica unroutable *without* a breaker penalty (it is shutting down
+  cleanly, not failing), connection refusal marks it dead;
+- **passive scoring** — every proxied response updates an EWMA service
+  latency; the pick is weighted least-loaded, ``(inflight+1) × ewma``,
+  so a slow replica sheds load to fast ones without any config;
+- **circuit breakers** — transport errors and 5xx responses feed a
+  per-replica breaker (``router.replica.<id>``) in the shared registry
+  (``reliability.breaker``): an open breaker removes the replica from the
+  pick set until its cooldown probe succeeds.
+
+Request legs are deadline-aware: after ``hedge_ms`` with no response the
+router fires a second leg on a different warm replica (counter
+``router.hedges_fired``); whichever leg answers first wins
+(``router.hedges_won`` when the hedge beats the primary) and the loser's
+connection is torn down. Transport errors and retryable statuses (429,
+5xx) rotate to another replica — honoring a server-supplied
+``Retry-After`` hint when waiting is cheaper than rotating — up to
+``max_attempts`` legs or the request deadline, whichever ends first.
+Samples are tallied once per *client* request (``router.samples``), never
+once per leg, no matter how many legs raced.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+import time
+import urllib.parse
+import weakref
+from random import random
+
+from .. import telemetry
+from ..reliability.breaker import breaker_for
+from .batching import ServeRejected
+
+#: default hedge delay: fires only for genuine stragglers well past the
+#: serve plane's p99, not for healthy-but-batched requests
+DEFAULT_HEDGE_MS = 75.0
+
+#: statuses worth rotating to another replica (the rest pass through;
+#: 504 stays definitive — the deadline is the client's global budget)
+_RETRYABLE_STATUS = frozenset({429, 500, 502, 503})
+
+#: statuses that charge the replica's breaker (429 is backpressure and 504
+#: a blown client budget — neither is the replica failing)
+_FAILURE_STATUS = frozenset({500, 502, 503})
+
+#: response headers forwarded verbatim to the client
+_PASS_HEADERS = ('Content-Type', 'Retry-After')
+
+
+class NoReplicaAvailable(ServeRejected):
+    """No routable replica (all dead, draining, or breaker-open) — HTTP
+    503 with a short Retry-After: replicas re-announce within seconds."""
+
+    http_status = 503
+
+
+class _Replica:
+    """Router-side view of one replica endpoint."""
+
+    __slots__ = ('id', 'url', 'host', 'port', 'inflight', 'ewma_s', 'probe_status', 'doc', 'lock')
+
+    def __init__(self, replica_id: str, url: str, doc: dict | None = None):
+        self.id = replica_id
+        self.url = url.rstrip('/')
+        parsed = urllib.parse.urlsplit(self.url)
+        self.host = parsed.hostname or '127.0.0.1'
+        self.port = parsed.port or (443 if parsed.scheme == 'https' else 80)
+        self.inflight = 0
+        self.ewma_s = 0.0
+        self.probe_status = 'unknown'  # ok | degraded | draining | dead | unknown
+        self.doc = doc or {}
+        self.lock = threading.Lock()
+
+    @property
+    def breaker(self):
+        return breaker_for(f'router.replica.{self.id}', fail_threshold=3, reset_after=2.0)
+
+    def observe_latency(self, seconds: float) -> None:
+        with self.lock:
+            self.ewma_s = seconds if self.ewma_s == 0.0 else 0.8 * self.ewma_s + 0.2 * seconds
+
+    def score(self) -> float:
+        """Weighted least-loaded: queue depth × observed service time."""
+        with self.lock:
+            return (self.inflight + 1) * max(self.ewma_s, 1e-3)
+
+    def routable(self) -> bool:
+        return self.probe_status in ('ok', 'degraded', 'unknown') and self.breaker.state != 'open'
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                'replica_id': self.id,
+                'url': self.url,
+                'probe_status': self.probe_status,
+                'breaker': self.breaker.state,
+                'inflight': self.inflight,
+                'ewma_ms': round(self.ewma_s * 1e3, 3),
+                'routable': self.probe_status in ('ok', 'degraded', 'unknown') and self.breaker.state != 'open',
+            }
+
+
+class _Leg(threading.Thread):
+    """One proxied attempt against one replica. Cancellation closes the
+    socket out from under the blocking read — the replica may still have
+    served the request (hedging's inherent duplicate work), but the bytes
+    never reach a client twice."""
+
+    def __init__(self, replica: _Replica, method: str, path: str, body: bytes | None, timeout_s: float, outcomes):
+        super().__init__(name=f'da4ml-router-leg-{replica.id}', daemon=True)
+        self.replica = replica
+        self.method = method
+        self.path = path
+        self.body = body
+        self.timeout_s = timeout_s
+        self.outcomes = outcomes
+        self.conn: http.client.HTTPConnection | None = None
+        self.cancelled = False
+
+    def run(self) -> None:
+        r = self.replica
+        with r.lock:
+            r.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            self.conn = http.client.HTTPConnection(r.host, r.port, timeout=self.timeout_s)
+            headers = {'Content-Type': 'application/json'} if self.body is not None else {}
+            self.conn.request(self.method, self.path, body=self.body, headers=headers)
+            resp = self.conn.getresponse()
+            data = resp.read()
+            hdrs = {k: resp.getheader(k) for k in _PASS_HEADERS if resp.getheader(k)}
+            out = {'leg': self, 'status': resp.status, 'body': data, 'headers': hdrs}
+        except Exception as e:  # noqa: BLE001 - transport failure is an outcome
+            out = {'leg': self, 'error': e}
+        finally:
+            try:
+                if self.conn is not None:
+                    self.conn.close()
+            except Exception:
+                pass
+            with r.lock:
+                r.inflight -= 1
+        if not self.cancelled:
+            if 'status' in out:
+                r.observe_latency(time.perf_counter() - t0)
+                if out['status'] in _FAILURE_STATUS:
+                    r.breaker.record_failure()
+                else:
+                    r.breaker.record_success()
+            else:
+                r.breaker.record_failure()
+        self.outcomes.put(out)
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        try:
+            if self.conn is not None:
+                self.conn.close()
+        except Exception:
+            pass
+
+
+class Router:
+    """Fan ``/v1/infer`` and ``/v1/solve`` over the live replica set."""
+
+    def __init__(
+        self,
+        registry_dir=None,
+        replicas: dict[str, str] | None = None,
+        hedge_ms: float = DEFAULT_HEDGE_MS,
+        max_attempts: int = 3,
+        probe_interval_s: float = 1.0,
+        default_deadline_ms: float = 1000.0,
+        probe_timeout_s: float = 1.0,
+    ):
+        self.registry_dir = registry_dir
+        self.hedge_ms = hedge_ms
+        self.max_attempts = max(1, int(max_attempts))
+        self.probe_interval_s = probe_interval_s
+        self.default_deadline_ms = default_deadline_ms
+        self.probe_timeout_s = probe_timeout_s
+        self._replicas: dict[str, _Replica] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        for rid, url in (replicas or {}).items():
+            self._replicas[rid] = _Replica(rid, url)
+        self._prober = threading.Thread(target=self._probe_loop, name='da4ml-router-probe', daemon=True)
+        self._prober.start()
+        _ROUTERS.add(self)
+
+    # -- discovery + probing -------------------------------------------------
+
+    def _discover(self) -> None:
+        if self.registry_dir is None:
+            return
+        from .fleet import discover_replicas
+
+        live = {d['replica_id']: d for d in discover_replicas(self.registry_dir) if d.get('url')}
+        with self._lock:
+            for rid, doc in live.items():
+                rep = self._replicas.get(rid)
+                if rep is None or rep.url != doc['url'].rstrip('/'):
+                    # new replica, or a restart re-announced on a new port:
+                    # fresh endpoint, fresh passive stats
+                    self._replicas[rid] = _Replica(rid, doc['url'], doc)
+                else:
+                    rep.doc = doc
+            for rid in list(self._replicas):
+                if rid not in live:
+                    # lease expired: the replica is gone (dead or withdrawn)
+                    del self._replicas[rid]
+
+    def _probe_one(self, rep: _Replica) -> None:
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(rep.host, rep.port, timeout=self.probe_timeout_s)
+            conn.request('GET', '/healthz')
+            resp = conn.getresponse()
+            doc = json.loads(resp.read() or b'{}')
+            status = str(doc.get('status', 'ok' if resp.status == 200 else 'degraded'))
+            rep.probe_status = status if status in ('ok', 'degraded', 'draining') else 'degraded'
+        except Exception:
+            rep.probe_status = 'dead'
+        finally:
+            try:
+                if conn is not None:
+                    conn.close()
+            except Exception:
+                pass
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._discover()
+                with self._lock:
+                    reps = list(self._replicas.values())
+                for rep in reps:
+                    self._probe_one(rep)
+                telemetry.counter('router.probes').inc(max(len(reps), 1))
+            except Exception:  # pragma: no cover - the prober must survive anything
+                pass
+            self._stop.wait(self.probe_interval_s)
+
+    def refresh(self) -> None:
+        """Synchronous discovery + probe round (tests, first request)."""
+        self._discover()
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            self._probe_one(rep)
+
+    # -- picking -------------------------------------------------------------
+
+    def _pick(self, exclude: set[str]) -> _Replica | None:
+        with self._lock:
+            candidates = [r for r in self._replicas.values() if r.id not in exclude and r.routable()]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.score())
+
+    # -- the hedged request --------------------------------------------------
+
+    def forward(self, method: str, path: str, body: bytes | None, deadline_s: float | None = None):
+        """Proxy one request: returns ``(status, body_bytes, headers)``.
+
+        Raises :class:`NoReplicaAvailable` when no replica is routable.
+        First definitive answer wins; retryable outcomes (transport error,
+        429/5xx) rotate to the next-best replica until ``max_attempts``
+        legs were fired or the deadline passed. ``hedge_ms`` after the
+        first leg with no answer, a second leg races on another replica.
+        """
+        deadline_t = time.monotonic() + deadline_s if deadline_s is not None else None
+        outcomes: 'queue.Queue[dict]' = queue.Queue()
+        legs: list[_Leg] = []
+        tried: set[str] = set()
+        stashed: dict | None = None  # best retryable outcome, for passthrough
+        hedge_leg: list[_Leg | None] = [None]
+        telemetry.counter('router.requests').inc()
+
+        def remaining() -> float:
+            if deadline_t is None:
+                return 30.0
+            return deadline_t - time.monotonic()
+
+        def fire() -> bool:
+            rep = self._pick(tried)
+            if rep is None or not rep.breaker.allow():
+                return False
+            tried.add(rep.id)
+            leg = _Leg(rep, method, path, body, timeout_s=max(remaining(), 0.05) + 5.0, outcomes=outcomes)
+            legs.append(leg)
+            leg.start()
+            return True
+
+        def finish(out: dict):
+            for leg in legs:
+                if leg is not out['leg'] and leg.is_alive():
+                    leg.cancel()
+                    telemetry.counter('router.hedge_cancelled').inc()
+            if out['leg'] is hedge_leg[0]:
+                telemetry.counter('router.hedges_won').inc()
+            return out['status'], out['body'], dict(out['headers'], **{'X-DA4ML-Replica': out['leg'].replica.id})
+
+        if not fire():
+            telemetry.counter('router.no_replica').inc()
+            raise NoReplicaAvailable('no routable replica (all dead, draining, or breaker-open)', retry_after_s=1.0)
+
+        while True:
+            live = sum(1 for leg in legs if leg.is_alive())
+            if live == 0 and outcomes.empty():
+                # every leg resolved retryable; rotate or give up
+                if len(legs) >= self.max_attempts or remaining() <= 0.05 or not fire():
+                    break
+                continue
+            hedge_wait = self.hedge_ms / 1e3 if (hedge_leg[0] is None and len(legs) == 1) else 0.25
+            try:
+                out = outcomes.get(timeout=max(min(hedge_wait, remaining()), 0.01))
+            except queue.Empty:
+                if hedge_leg[0] is None and len(legs) == 1 and remaining() > self.hedge_ms / 1e3:
+                    # straggler: race a second warm replica
+                    if fire():
+                        hedge_leg[0] = legs[-1]
+                        telemetry.counter('router.hedges_fired').inc()
+                if remaining() <= 0.0:
+                    break
+                continue
+            if 'status' in out and out['status'] not in _RETRYABLE_STATUS:
+                return finish(out)  # definitive: 2xx, client-owned 4xx, or 504
+            # retryable (transport error, 429, 500/502/503): stash the most
+            # informative outcome so a fully-shedding fleet passes its 429 +
+            # Retry-After hint through instead of a synthetic 503
+            if stashed is None or ('status' in out and 'status' not in stashed):
+                stashed = out
+            telemetry.counter('router.leg_failures').inc()
+            if len(legs) < self.max_attempts and remaining() > 0.05:
+                telemetry.counter('router.retries').inc()
+                fire()
+
+        if stashed is not None and 'status' in stashed:
+            return finish(stashed)  # bounded passthrough: e.g. every replica shedding 429
+        telemetry.counter('router.no_replica').inc()
+        raise NoReplicaAvailable(
+            f'no replica answered within {len(legs)} attempts', retry_after_s=0.5 + random() * 0.5
+        )
+
+    # -- introspection -------------------------------------------------------
+
+    def replicas(self) -> list[dict]:
+        with self._lock:
+            return [r.snapshot() for r in self._replicas.values()]
+
+    def status(self) -> dict:
+        reps = self.replicas()
+        return {
+            'registry': None if self.registry_dir is None else str(self.registry_dir),
+            'hedge_ms': self.hedge_ms,
+            'max_attempts': self.max_attempts,
+            'replicas': reps,
+            'n_routable': sum(1 for r in reps if r['routable']),
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._prober.join(timeout=2.0)
+        _ROUTERS.discard(self)
+
+
+# ----------------------------------------------------------------- http face
+
+_ROUTERS: 'weakref.WeakSet[Router]' = weakref.WeakSet()
+
+
+def router_health() -> dict | None:
+    """The /healthz ``router`` check (None when no router runs here).
+    Resolved via ``sys.modules`` by ``telemetry.obs.health``."""
+    routers = list(_ROUTERS)
+    if not routers:
+        return None
+    docs = [r.status() for r in routers]
+    degraded = any(d['n_routable'] == 0 or d['n_routable'] < len(d['replicas']) for d in docs)
+    return {'status': 'degraded' if degraded else 'ok', 'routers': docs}
+
+
+def router_status() -> dict | None:
+    """The /statusz ``router`` panel."""
+    routers = list(_ROUTERS)
+    if not routers:
+        return None
+    return {'routers': [r.status() for r in routers]}
+
+
+class RouterServer:
+    """HTTP face of one :class:`Router` — same stdlib fabric as
+    :class:`.http.ServeServer`, but every data-plane request is proxied."""
+
+    def __init__(self, router: Router, port: int = 0, host: str = '127.0.0.1'):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ..telemetry.metrics import enable_metrics
+
+        enable_metrics()
+        self.router = router
+        srv = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            server_version = 'da4ml-router'
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str = 'application/json', headers: dict | None = None):
+                self.send_response(code)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                for k, v in (headers or {}).items():
+                    if k.lower() != 'content-type':
+                        self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, doc: dict, headers: dict | None = None):
+                self._send(code, json.dumps(doc, default=str).encode(), headers=headers)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split('?', 1)[0]
+                    if path == '/v1/replicas':
+                        self._send_json(200, srv.router.status())
+                    elif path == '/metrics':
+                        from ..telemetry.obs.health import refresh_computed_gauges
+                        from ..telemetry.obs.openmetrics import CONTENT_TYPE, render_openmetrics
+
+                        refresh_computed_gauges()
+                        self._send(200, render_openmetrics().encode(), CONTENT_TYPE)
+                    elif path == '/healthz':
+                        from ..telemetry.obs.health import health_snapshot
+
+                        doc = health_snapshot()
+                        self._send_json(200 if doc.get('status') == 'ok' else 503, doc)
+                    elif path == '/statusz':
+                        from ..telemetry.obs.health import status_snapshot
+
+                        self._send_json(200, status_snapshot())
+                    elif path in ('/', ''):
+                        body = b'da4ml_tpu router: POST /v1/infer /v1/solve, GET /v1/replicas /metrics /healthz /statusz\n'
+                        self._send(200, body, 'text/plain; charset=utf-8')
+                    else:
+                        self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
+                except Exception:
+                    pass
+
+            def do_POST(self):
+                try:
+                    path = self.path.split('?', 1)[0]
+                    if path not in ('/v1/infer', '/v1/solve'):
+                        self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
+                        return
+                    try:
+                        length = int(self.headers.get('Content-Length', '0') or 0)
+                    except ValueError:
+                        length = 0
+                    from .batching import PayloadTooLarge
+                    from .http import _max_body_bytes
+
+                    if length > _max_body_bytes():
+                        # reject before buffering — same ceiling the replicas
+                        # enforce, but the router must not buffer it either
+                        raise PayloadTooLarge(
+                            f'request body of {length} bytes exceeds the {_max_body_bytes()}-byte ceiling'
+                        )
+                    raw = self.rfile.read(length) if length > 0 else b''
+                    deadline_s, n_rows = _peek_request(raw, srv.router.default_deadline_ms)
+                    status, body, headers = srv.router.forward('POST', path, raw, deadline_s)
+                    if status == 200 and path == '/v1/infer':
+                        # one client request = one sample tally, however many
+                        # legs raced (tests/test_fleet.py)
+                        telemetry.counter('router.samples').inc(n_rows)
+                    self._send(status, body, headers=headers)
+                except ServeRejected as e:
+                    doc = e.to_doc()
+                    headers = {}
+                    if e.retry_after_s is not None:
+                        headers['Retry-After'] = f'{max(e.retry_after_s, 0.0):.3f}'
+                    self._send_json(e.http_status, {'error': doc}, headers=headers)
+                except Exception as e:  # noqa: BLE001 - a broken proxy must answer something
+                    try:
+                        self._send_json(
+                            502, {'error': {'type': type(e).__name__, 'message': str(e), 'http_status': 502}}
+                        )
+                    except Exception:
+                        pass
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # the socketserver default backlog of 5 resets connections under
+            # a reconnect burst (every closed-loop client opens a fresh TCP
+            # connection per request) — exactly when a replica just died and
+            # the whole worker pool retries at once
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), _Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, name='da4ml-router-http', daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f'http://{self.host}:{self.port}'
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self.router.close()
+
+
+def _peek_request(raw: bytes, default_deadline_ms: float) -> tuple[float | None, int]:
+    """Deadline + row count from the request body, without mutating it (the
+    raw bytes are forwarded verbatim)."""
+    try:
+        doc = json.loads(raw)
+        deadline_ms = float(doc.get('deadline_ms', default_deadline_ms))
+        inputs = doc.get('inputs')
+        n_rows = len(inputs) if isinstance(inputs, list) else 0
+    except (ValueError, TypeError):
+        return (default_deadline_ms / 1e3 if default_deadline_ms > 0 else None), 0
+    return (deadline_ms / 1e3 if deadline_ms > 0 else None), n_rows
+
+
+__all__ = ['DEFAULT_HEDGE_MS', 'NoReplicaAvailable', 'Router', 'RouterServer', 'router_health', 'router_status']

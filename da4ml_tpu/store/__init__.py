@@ -4,7 +4,10 @@
 solved DAIS program with verify-on-read, single-flighted cold misses,
 negative caching, and breaker-guarded degradation. ``cmvm.api.solve``
 consults it via ``store=`` / ``DA4ML_SOLUTION_STORE``; campaigns publish
-into it; the serve plane exposes it as ``POST /v1/solve``.
+into it; the serve plane exposes it as ``POST /v1/solve``. ``TieredStore``
+(``DA4ML_STORE_LOCAL_TIER``) layers an in-proc LRU and a local-disk tier
+in front of the shared directory so fleet replicas warm from the shared
+tier instead of re-solving.
 """
 
 from .service import SolveService
@@ -22,10 +25,12 @@ from .solution_store import (
     store_key,
     store_status,
 )
+from .tiered import TieredStore, tiered_at
 
 __all__ = [
     'SolutionStore',
     'SolveService',
+    'TieredStore',
     'StoreEntryCorrupt',
     'StoreHit',
     'StoreNegativeEntry',
@@ -37,4 +42,5 @@ __all__ = [
     'store_health',
     'store_key',
     'store_status',
+    'tiered_at',
 ]

@@ -715,21 +715,40 @@ def store_at(path: str | os.PathLike, **kw) -> SolutionStore:
 
 
 def default_store() -> SolutionStore | None:
-    """The ``DA4ML_SOLUTION_STORE`` store, or None when unset."""
+    """The ``DA4ML_SOLUTION_STORE`` store, or None when unset. With
+    ``DA4ML_STORE_LOCAL_TIER`` also set, the env store is opened as a
+    :class:`~.tiered.TieredStore` (in-proc LRU → local disk → shared FS)
+    so every ``resolve_store`` caller — ``solve(store=)``, campaign
+    workers, ``POST /v1/solve`` replicas — reads through the tiers."""
     env = os.environ.get(_ENV_VAR, '').strip()
-    return store_at(env) if env else None
+    if not env:
+        return None
+    from .tiered import local_tier_env, tiered_at
+
+    local = local_tier_env()
+    if local:
+        return tiered_at(env, local)
+    return store_at(env)
 
 
 def resolve_store(store) -> SolutionStore | None:
     """Normalize a ``store=`` argument: None → the env-configured default,
     ``False`` → disabled (even with the env set — the cold-solve escape
-    hatch), a path → opened, a :class:`SolutionStore` → itself."""
+    hatch), a path → opened, a :class:`SolutionStore` → itself. An explicit
+    path honors ``DA4ML_STORE_LOCAL_TIER`` the same way the env default
+    does — a fleet replica handed ``--solve-store`` must still read through
+    its local cache tier (docs/store.md#tiers)."""
     if store is False:
         return None
     if store is None:
         return default_store()
     if isinstance(store, SolutionStore):
         return store
+    from .tiered import local_tier_env, tiered_at
+
+    local = local_tier_env()
+    if local:
+        return tiered_at(store, local)
     return store_at(store)
 
 

@@ -1,0 +1,234 @@
+"""Tiered artifact/solution cache: in-proc LRU → local disk → shared FS.
+
+At fleet scale the PR-13 :class:`~.solution_store.SolutionStore` read path
+has three very different latency regimes hiding behind one ``lookup()``:
+a program this process already verified (nanoseconds), an entry on the
+replica's local disk (sub-millisecond), and an entry on the shared
+filesystem every replica mounts (milliseconds to tens of milliseconds on
+NFS). :class:`TieredStore` makes the regimes explicit — the canonical
+cache hierarchy of the TVM-style compile/serve split (PAPERS.md,
+arXiv:1802.04799): solved artifacts flow *down* from the shared tier into
+each replica, never the other way up unless the replica itself solved.
+
+Tiers, probed in order on :meth:`lookup`:
+
+1. **mem** — per-process LRU of verified :class:`~.solution_store.StoreHit`
+   objects (bounded by ``mem_entries``; ``0`` disables the tier). A mem hit
+   costs no I/O and no re-verification — the entry was verified when it
+   entered the tier.
+2. **local** — a :class:`SolutionStore` directory on replica-local disk.
+   Verify-on-read applies exactly as on the shared tier (local disks flip
+   bits too); a corrupt local entry quarantines locally and the probe
+   falls through to the shared tier.
+3. **shared** — the shared-FS tier (``self`` — :class:`TieredStore` *is* a
+   :class:`SolutionStore` rooted at the shared directory, so single-flight
+   leases, negative caching, gc, and the breaker pair all keep operating
+   on the shared tier, where cross-host coordination lives).
+
+A hit at tier *k* **promotes** the entry into every tier above it: a
+shared-FS hit copies the raw entry bytes onto local disk (byte-identical
+— content-addressed entries are immutable, so a raw copy is exact) and
+parks the verified hit in mem. A cold replica joining a warm fleet
+therefore serves its first request from the shared tier and every repeat
+from mem — no re-solve, no new search (the fleet drill's acceptance
+gate, docs/serving.md#replica-fleets).
+
+Writes go through :meth:`publish`: the shared tier is written first (it
+is the tier other hosts see — a publish that only landed locally would
+be a silent fleet-wide miss), then written through to local + mem.
+
+Per-tier telemetry (docs/telemetry.md): ``store.tier.mem_hits`` /
+``store.tier.local_hits`` / ``store.tier.shared_hits`` /
+``store.tier.misses`` and ``store.tier.promotes_local`` /
+``store.tier.promotes_mem`` / ``store.tier.writethroughs``. The aggregate
+``store.hits``/``store.misses`` counters keep their PR-13 meaning (any
+tier answered / nothing did), so existing dashboards and budget rules
+stay valid.
+
+Wiring: ``DA4ML_STORE_LOCAL_TIER=<dir>`` (optionally with
+``DA4ML_STORE_MEM_ENTRIES=<n>``, default 64) upgrades the env-configured
+``DA4ML_SOLUTION_STORE`` to a tiered cache everywhere ``resolve_store``
+runs — ``solve(store=)``, campaign workers, ``POST /v1/solve`` replicas —
+or construct one explicitly via :func:`tiered_at`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from pathlib import Path
+
+from .. import telemetry
+from ..ir.comb import Pipeline
+from ..reliability.checkpoint import atomic_write_bytes
+from .solution_store import SolutionStore, StoreHit
+
+#: default in-proc LRU capacity (entries); DA4ML_STORE_MEM_ENTRIES overrides
+DEFAULT_MEM_ENTRIES = 64
+
+_LOCAL_ENV = 'DA4ML_STORE_LOCAL_TIER'
+_MEM_ENV = 'DA4ML_STORE_MEM_ENTRIES'
+
+
+def default_mem_entries() -> int:
+    try:
+        return int(os.environ.get(_MEM_ENV, '') or DEFAULT_MEM_ENTRIES)
+    except ValueError:
+        return DEFAULT_MEM_ENTRIES
+
+
+class TieredStore(SolutionStore):
+    """A :class:`SolutionStore` (rooted at the **shared** tier) with a
+    local-disk tier and an in-proc LRU layered in front of its read path.
+
+    Every coordination primitive — single-flight leases, negative markers,
+    breakers, gc — stays on the shared tier, where it must live for
+    cross-host correctness; the upper tiers only ever hold verified copies
+    of shared-tier content (or this process's own publishes)."""
+
+    def __init__(
+        self,
+        shared_root: str | os.PathLike,
+        local_root: str | os.PathLike | None = None,
+        mem_entries: int | None = None,
+        **kw,
+    ):
+        super().__init__(shared_root, **kw)
+        # the local tier never runs single-flight or negative caching of its
+        # own (those are shared-tier concerns); it inherits readonly-ness so
+        # a snapshotted shared store does not gain a writable shadow
+        self.local: SolutionStore | None = (
+            SolutionStore(local_root, readonly=self.readonly) if local_root is not None else None
+        )
+        self.mem_entries = default_mem_entries() if mem_entries is None else int(mem_entries)
+        self._mem: 'OrderedDict[str, StoreHit]' = OrderedDict()
+        self._mem_lock = threading.Lock()
+
+    # -- mem tier ------------------------------------------------------------
+
+    def _mem_get(self, key: str) -> StoreHit | None:
+        if self.mem_entries <= 0:
+            return None
+        with self._mem_lock:
+            hit = self._mem.get(key)
+            if hit is not None:
+                self._mem.move_to_end(key)
+            return hit
+
+    def _mem_put(self, hit: StoreHit) -> None:
+        if self.mem_entries <= 0:
+            return
+        with self._mem_lock:
+            self._mem[hit.key] = hit
+            self._mem.move_to_end(hit.key)
+            while len(self._mem) > self.mem_entries:
+                self._mem.popitem(last=False)
+                telemetry.counter('store.tier.mem_evictions').inc()
+
+    # -- promotion -----------------------------------------------------------
+
+    def _promote_to_local(self, key: str) -> None:
+        """Copy the verified shared entry's raw bytes onto local disk.
+
+        Byte-identical by construction: entries are content-addressed and
+        immutable, so a raw copy of the just-verified file is exact — no
+        re-serialization, no fresh timestamps. Best-effort: a failed
+        promotion costs the next request a shared-tier read, nothing else."""
+        if self.local is None or self.local.readonly:
+            return
+        try:
+            raw = self._entry_path(key).read_bytes()
+            atomic_write_bytes(self.local._entry_path(key), raw)
+        except OSError:
+            return
+        telemetry.counter('store.tier.promotes_local').inc()
+
+    # -- read path -----------------------------------------------------------
+
+    def lookup(self, key: str) -> StoreHit | None:
+        """Probe mem → local → shared; promote upward on a hit. Aggregate
+        ``store.hits``/``store.misses`` accounting is preserved."""
+        hit = self._mem_get(key)
+        if hit is not None:
+            telemetry.counter('store.tier.mem_hits').inc()
+            telemetry.counter('store.hits').inc()
+            return hit
+        if self.local is not None:
+            hit = self.local._read(key)
+            if hit is not None:
+                telemetry.counter('store.tier.local_hits').inc()
+                telemetry.counter('store.hits').inc()
+                self._mem_put(hit)
+                telemetry.counter('store.tier.promotes_mem').inc()
+                return hit
+        hit = super().lookup(key)  # shared tier: the accounted probe
+        if hit is not None:
+            telemetry.counter('store.tier.shared_hits').inc()
+            self._promote_to_local(key)
+            self._mem_put(hit)
+            telemetry.counter('store.tier.promotes_mem').inc()
+        else:
+            telemetry.counter('store.tier.misses').inc()
+        return hit
+
+    # -- write path ----------------------------------------------------------
+
+    def publish(self, key: str, pipeline: Pipeline, meta: dict | None = None) -> bool:
+        """Publish to the shared tier, then write through to local + mem.
+
+        The write-through copies the exact bytes that landed on the shared
+        tier (same byte-identity contract as promotion)."""
+        ok = super().publish(key, pipeline, meta=meta)
+        if ok:
+            self._promote_to_local(key)
+            telemetry.counter('store.tier.writethroughs').inc()
+            hit = StoreHit(key=key, pipeline=pipeline, doc={'key': key, 'cost': float(pipeline.cost), **(meta or {})})
+            self._mem_put(hit)
+        return ok
+
+    # -- introspection -------------------------------------------------------
+
+    def tier_occupancy(self) -> dict:
+        """Per-tier occupancy for /statusz and ``da4ml-tpu cache stats``."""
+        with self._mem_lock:
+            mem = len(self._mem)
+        return {
+            'mem': {'entries': mem, 'cap': self.mem_entries},
+            'local': self.local.occupancy() if self.local is not None else None,
+            'shared': super().occupancy(),
+        }
+
+    def occupancy(self) -> dict:
+        out = super().occupancy()
+        out['tiers'] = self.tier_occupancy()
+        return out
+
+
+def tiered_at(
+    shared_root: str | os.PathLike,
+    local_root: str | os.PathLike | None = None,
+    mem_entries: int | None = None,
+    **kw,
+) -> TieredStore:
+    """Process-wide :class:`TieredStore` per (shared, local) directory pair
+    (the tiered twin of :func:`~.solution_store.store_at`)."""
+    from .solution_store import _stores, _stores_lock
+
+    shared = str(Path(shared_root).expanduser().resolve())
+    local = str(Path(local_root).expanduser().resolve()) if local_root is not None else None
+    key = f'{shared}|tier:{local}'
+    with _stores_lock:
+        store = _stores.get(key)
+        if not isinstance(store, TieredStore):
+            _stores[key] = store = TieredStore(shared, local, mem_entries=mem_entries, **kw)
+        return store
+
+
+def local_tier_env() -> str | None:
+    """The ``DA4ML_STORE_LOCAL_TIER`` directory, or None when unset."""
+    env = os.environ.get(_LOCAL_ENV, '').strip()
+    return env or None
+
+
+__all__ = ['DEFAULT_MEM_ENTRIES', 'TieredStore', 'default_mem_entries', 'local_tier_env', 'tiered_at']

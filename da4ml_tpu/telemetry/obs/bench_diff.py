@@ -271,6 +271,9 @@ def load_budgets(path: 'str | Path | None') -> Budgets:
         [rules."configs.*.jax_compile_s"]
         max_rise_pct = 100.0       # opt a wall-clock metric into gating
 
+        [rules."fleet.p99_ms"]
+        max_value = 250.0          # absolute ceiling on the current value
+
         [rules."configs.*.host_rate"]
         ignore = true
     """
@@ -322,9 +325,10 @@ def diff_metrics(a: dict[str, float], b: dict[str, float], budgets: 'Budgets | N
         max_drop = rule.get('max_drop_pct') if rule else None
         max_rise = rule.get('max_rise_pct') if rule else None
         min_value = rule.get('min_value') if rule else None
-        if max_drop is None and max_rise is None and min_value is not None:
-            # an absolute floor alone opts the metric out of the relative
-            # defaults — the floor IS the budget
+        max_value = rule.get('max_value') if rule else None
+        if max_drop is None and max_rise is None and (min_value is not None or max_value is not None):
+            # an absolute floor/ceiling alone opts the metric out of the
+            # relative defaults — the bound IS the budget
             pass
         elif max_drop is None and max_rise is None:
             # defaults by classification
@@ -354,6 +358,15 @@ def diff_metrics(a: dict[str, float], b: dict[str, float], budgets: 'Budgets | N
             # jax_rate — rather than a relative drop from a noisy baseline
             limit = (limit + ',' if limit else '') + f'min>={min_value:g}'
             if vb < min_value - 1e-9:
+                status = 'regressed'
+            elif status == 'info':
+                status = 'ok'
+        if max_value is not None:
+            # absolute ceiling on the CURRENT value — gates a latency-class
+            # metric (e.g. the fleet drill's p99) against a hard budget
+            # instead of a relative rise from a noisy baseline
+            limit = (limit + ',' if limit else '') + f'max<={max_value:g}'
+            if vb > max_value + 1e-9:
                 status = 'regressed'
             elif status == 'info':
                 status = 'ok'

@@ -81,6 +81,32 @@ def _store_check() -> dict | None:
         return None
 
 
+def _router_check() -> dict | None:
+    """Replica-router health (routable replica count, per-replica probe +
+    breaker states) when a :class:`~da4ml_tpu.serve.router.Router` runs in
+    this process. Resolved via ``sys.modules`` — scrape-safe."""
+    mod = sys.modules.get('da4ml_tpu.serve.router')
+    if mod is None:
+        return None
+    try:
+        return mod.router_health()
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
+def _fleet_check() -> dict | None:
+    """Fleet-driver health (live/announced replica counts, restarts) when a
+    :class:`~da4ml_tpu.serve.fleet.Fleet` runs in this process. Resolved
+    via ``sys.modules`` — scrape-safe."""
+    mod = sys.modules.get('da4ml_tpu.serve.fleet')
+    if mod is None:
+        return None
+    try:
+        return mod.fleet_health()
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
 def _store_status() -> dict | None:
     """Occupancy + hit ratio of any solution store opened in this process
     (``/statusz``)."""
@@ -162,7 +188,7 @@ def refresh_computed_gauges() -> None:
     ratio = _cache_check(snap)['hit_ratio']
     if ratio is not None:
         gauge('cache.hit_ratio').set(ratio)
-    gauge('health.status').set(0.0 if health_snapshot(snap)['status'] == 'ok' else 1.0)
+    gauge('health.status').set({'ok': 0.0, 'draining': 0.5}.get(health_snapshot(snap)['status'], 1.0))
 
 
 def health_snapshot(snap: dict | None = None) -> dict:
@@ -187,9 +213,22 @@ def health_snapshot(snap: dict | None = None) -> dict:
     store = _store_check()
     if store is not None:
         checks['store'] = store
-    degraded = any(c['status'] == 'degraded' for c in checks.values())
+    router = _router_check()
+    if router is not None:
+        checks['router'] = router
+    fleet = _fleet_check()
+    if fleet is not None:
+        checks['fleet'] = fleet
+    # draining trumps degraded: an explicitly-draining serve plane is about
+    # to exit — routers must stop sending to it now, whatever else is true
+    if any(c['status'] == 'draining' for c in checks.values()):
+        status = 'draining'
+    elif any(c['status'] == 'degraded' for c in checks.values()):
+        status = 'degraded'
+    else:
+        status = 'ok'
     return {
-        'status': 'degraded' if degraded else 'ok',
+        'status': status,
         'checks': checks,
         'pid': os.getpid(),
         'uptime_s': round(time.monotonic() - _T0, 3),
@@ -216,6 +255,28 @@ def _serve_status() -> dict | None:
         return None
     try:
         return mod.serve_status()
+    except Exception:
+        return None
+
+
+def _router_status() -> dict | None:
+    """Per-replica router detail for ``/statusz``."""
+    mod = sys.modules.get('da4ml_tpu.serve.router')
+    if mod is None:
+        return None
+    try:
+        return mod.router_status()
+    except Exception:
+        return None
+
+
+def _fleet_status() -> dict | None:
+    """Fleet-driver detail (slots, restarts, registry) for ``/statusz``."""
+    mod = sys.modules.get('da4ml_tpu.serve.fleet')
+    if mod is None:
+        return None
+    try:
+        return mod.fleet_status()
     except Exception:
         return None
 
@@ -258,6 +319,8 @@ def status_snapshot() -> dict:
         'serve': serve,
         'serve_metrics': serve_metrics,
         'store': _store_status(),
+        'router': _router_status(),
+        'fleet': _fleet_status(),
         'deadline_workers': deadline_workers,
         'devices': _device_inventory(),
     }

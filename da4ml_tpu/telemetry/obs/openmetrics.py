@@ -69,6 +69,28 @@ _HELP = {
     'serve.hedge_won': 'hedged batches answered by the fallback chain first',
     'serve.reloads': 'hot executor reloads',
     'serve.executor_evictions': 'compiled executors evicted from the LRU serve cache',
+    'router.requests': 'client requests proxied by the replica router',
+    'router.samples': 'inference sample rows answered through the router',
+    'router.hedges_fired': 'hedge legs launched against slow replicas',
+    'router.hedges_won': 'requests answered by the hedge leg first',
+    'router.hedge_cancelled': 'loser legs torn down after a definitive answer',
+    'router.retries': 'retry legs after a retryable replica outcome',
+    'router.leg_failures': 'transport-level leg failures (replica died mid-request)',
+    'router.no_replica': 'requests rejected because no replica was routable',
+    'router.probes': 'active /healthz probe rounds',
+    'fleet.spawns': 'replica subprocesses spawned by the fleet driver',
+    'fleet.restarts': 'crashed replicas restarted with backoff',
+    'fleet.kills': 'replicas signalled by the chaos drill',
+    'fleet.announcements': 'replica registry slots claimed (lease + URL sidecar)',
+    'fleet.announcements_lost': 'replica slots stolen while presumed dead',
+    'store.tier.mem_hits': 'solution lookups served from the in-process LRU tier',
+    'store.tier.local_hits': 'solution lookups served from the local-disk tier',
+    'store.tier.shared_hits': 'solution lookups served from the shared-FS tier',
+    'store.tier.misses': 'solution lookups that missed every cache tier',
+    'store.tier.promotes_local': 'shared-tier entries promoted to the local-disk tier',
+    'store.tier.writethroughs': 'published solutions written through to the local tier',
+    'store.tier.mem_evictions': 'entries evicted from the in-process LRU tier',
+    'retry.hints_honored': 'retry sleeps that honored a server Retry-After hint',
 }
 
 

@@ -1,0 +1,418 @@
+"""Replica-fleet suite (docs/serving.md#replica-fleets).
+
+Fast, CPU-only, no subprocess spawning: the router is exercised against
+in-process fake replicas (stdlib HTTP servers with scripted latency,
+status, and health), the registry against real lease files, and the
+tiered cache against real store directories.
+
+- hedged dispatch: the hedge leg wins, the straggler is cancelled, and
+  ``router.samples`` tallies the client request exactly once no matter
+  how many legs raced;
+- retry rotation on retryable statuses (503) vs. 504 staying definitive;
+- an explicitly ``draining`` replica is unroutable without a breaker
+  penalty; a fleet of only draining replicas raises
+  :class:`NoReplicaAvailable`;
+- the router's request-body ceiling (``DA4ML_SERVE_MAX_BODY_BYTES``)
+  rejects with 413 before buffering or forwarding;
+- registry: duplicate announcements refused while the holder is live,
+  ``close()`` withdraws, and an expired slot is stolen by exactly one of
+  N racing successors;
+- tiered cache: publish-writethrough and shared→local promotion are
+  byte-identical, repeats hit mem, the LRU bound evicts, and
+  ``DA4ML_STORE_LOCAL_TIER`` upgrades ``resolve_store`` for explicit
+  store paths (what fleet replicas pass via ``--solve-store``);
+- ``retry_call`` honors a server-supplied ``retry_after_s`` hint (capped,
+  jittered upward only) instead of the exponential guess.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from da4ml_tpu import telemetry
+from da4ml_tpu.cmvm.api import solve
+from da4ml_tpu.reliability.breaker import reset_all_breakers
+from da4ml_tpu.reliability.retry import retry_call
+from da4ml_tpu.serve.batching import QueueFull
+from da4ml_tpu.serve.fleet import Fleet, announce_replica, discover_replicas
+from da4ml_tpu.serve.router import NoReplicaAvailable, Router, RouterServer
+from da4ml_tpu.store import SolutionStore, reset_store_registry, resolve_store, store_key
+from da4ml_tpu.store.tiered import TieredStore
+
+BACKEND = 'pure-python'
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    from da4ml_tpu.telemetry.metrics import enable_metrics, reset_metrics
+
+    monkeypatch.delenv('DA4ML_SOLUTION_STORE', raising=False)
+    monkeypatch.delenv('DA4ML_STORE_LOCAL_TIER', raising=False)
+    enable_metrics()
+    reset_metrics()
+    reset_all_breakers()
+    reset_store_registry()
+    yield
+    reset_all_breakers()
+    reset_store_registry()
+
+
+def _counter(name: str) -> float:
+    m = telemetry.metrics_snapshot().get(name)
+    return float(m.get('value', 0.0)) if m else 0.0
+
+
+def _kernel(seed=0, dim=4, bits=3):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 2**bits, (dim, dim)) * rng.choice([-1.0, 1.0], (dim, dim))).astype(np.float64)
+
+
+def _blob(pipe) -> str:
+    return json.dumps(pipe.to_dict(), sort_keys=True)
+
+
+# ----------------------------------------------------------- fake replicas
+
+
+class _FakeReplica:
+    """A scripted stand-in for one ``da4ml-tpu serve`` process: answers
+    ``/healthz`` with a configurable status and ``/v1/infer`` with a
+    configurable delay + HTTP status, counting every infer it serves."""
+
+    def __init__(self, *, delay_s: float = 0.0, status: int = 200, health: str = 'ok'):
+        self.delay_s = delay_s
+        self.status = status
+        self.health = health
+        self.infers = 0
+        self._lock = threading.Lock()
+        fake = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, doc: dict):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.split('?', 1)[0] == '/healthz':
+                    self._send(200, {'status': fake.health})
+                else:
+                    self._send(404, {'error': 'not found'})
+
+            def do_POST(self):
+                length = int(self.headers.get('Content-Length', '0') or 0)
+                self.rfile.read(length)
+                with fake._lock:
+                    fake.infers += 1
+                if fake.delay_s:
+                    time.sleep(fake.delay_s)
+                if fake.status == 200:
+                    self._send(200, {'model': 'default', 'outputs': [[1.0]], 'served_by': 'fake'})
+                else:
+                    self._send(fake.status, {'error': {'type': 'Scripted', 'http_status': fake.status}})
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._httpd = _Server(('127.0.0.1', 0), _Handler)
+        self.url = f'http://127.0.0.1:{self._httpd.server_address[1]}'
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+def _post(url: str, doc: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url + '/v1/infer',
+        data=json.dumps(doc).encode(),
+        headers={'Content-Type': 'application/json'},
+        method='POST',
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+# ------------------------------------------------------------------ router
+
+
+def test_hedge_wins_cancels_straggler_and_tallies_once():
+    slow = _FakeReplica(delay_s=0.6)
+    fast = _FakeReplica(delay_s=0.0)
+    router = Router(replicas={'slow': slow.url, 'fast': fast.url}, hedge_ms=30.0, default_deadline_ms=5000.0)
+    server = RouterServer(router)
+    try:
+        # steer the first pick to the straggler: fresh replicas tie at the
+        # ewma floor, so a raised ewma on `fast` demotes it for leg one
+        router._replicas['fast'].ewma_s = 0.05
+        before = {k: _counter(k) for k in ('router.requests', 'router.samples', 'router.hedges_fired', 'router.hedges_won', 'router.hedge_cancelled')}
+        status, doc, headers = _post(server.url, {'model': 'default', 'inputs': [[0.0]] * 3, 'deadline_ms': 5000})
+        assert status == 200 and doc['outputs'] == [[1.0]]
+        assert headers.get('X-DA4ML-Replica') == 'fast'  # the hedge won
+        assert _counter('router.hedges_fired') - before['router.hedges_fired'] >= 1
+        assert _counter('router.hedges_won') - before['router.hedges_won'] >= 1
+        assert _counter('router.hedge_cancelled') - before['router.hedge_cancelled'] >= 1
+        # one client request = one tally, even though two legs raced
+        assert _counter('router.requests') - before['router.requests'] == 1
+        assert _counter('router.samples') - before['router.samples'] == 3
+    finally:
+        server.close()
+        slow.close()
+        fast.close()
+
+
+def test_retryable_status_rotates_to_next_replica():
+    bad = _FakeReplica(status=503)
+    good = _FakeReplica(status=200)
+    router = Router(replicas={'bad': bad.url, 'good': good.url}, hedge_ms=500.0, default_deadline_ms=5000.0)
+    server = RouterServer(router)
+    try:
+        router._replicas['good'].ewma_s = 0.05  # bad goes first
+        before_retries = _counter('router.retries')
+        status, doc, headers = _post(server.url, {'model': 'default', 'inputs': [[0.0]], 'deadline_ms': 5000})
+        assert status == 200
+        assert headers.get('X-DA4ML-Replica') == 'good'
+        assert bad.infers >= 1  # the 503 really was attempted first
+        assert _counter('router.retries') - before_retries >= 1
+        assert _counter('router.leg_failures') >= 1
+    finally:
+        server.close()
+        bad.close()
+        good.close()
+
+
+def test_504_is_definitive_no_rotation():
+    expired = _FakeReplica(status=504)
+    spare = _FakeReplica(status=200)
+    router = Router(replicas={'expired': expired.url, 'spare': spare.url}, hedge_ms=500.0, default_deadline_ms=5000.0)
+    server = RouterServer(router)
+    try:
+        router._replicas['spare'].ewma_s = 0.05
+        before = _counter('router.retries')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.url, {'model': 'default', 'inputs': [[0.0]], 'deadline_ms': 5000})
+        assert ei.value.code == 504  # the deadline is the client's budget
+        assert spare.infers == 0
+        assert _counter('router.retries') == before
+    finally:
+        server.close()
+        expired.close()
+        spare.close()
+
+
+def test_draining_replica_is_unroutable_without_breaker_penalty():
+    draining = _FakeReplica(health='draining')
+    healthy = _FakeReplica(health='ok')
+    router = Router(replicas={'drn': draining.url, 'ok': healthy.url}, hedge_ms=500.0, default_deadline_ms=5000.0)
+    try:
+        router.refresh()
+        snap = {r['replica_id']: r for r in router.replicas()}
+        assert snap['drn']['probe_status'] == 'draining' and not snap['drn']['routable']
+        assert snap['drn']['breaker'] == 'closed'  # shutting down cleanly, not failing
+        assert snap['ok']['routable']
+        status, body, headers = router.forward('POST', '/v1/infer', b'{"inputs": [[0.0]]}', 5.0)
+        assert status == 200 and headers['X-DA4ML-Replica'] == 'ok'
+        assert draining.infers == 0
+    finally:
+        router.close()
+        draining.close()
+        healthy.close()
+
+
+def test_all_draining_raises_no_replica():
+    draining = _FakeReplica(health='draining')
+    router = Router(replicas={'drn': draining.url}, default_deadline_ms=1000.0)
+    try:
+        router.refresh()
+        before = _counter('router.no_replica')
+        with pytest.raises(NoReplicaAvailable) as ei:
+            router.forward('POST', '/v1/infer', b'{}', 1.0)
+        assert ei.value.http_status == 503 and ei.value.retry_after_s is not None
+        assert _counter('router.no_replica') - before == 1
+    finally:
+        router.close()
+        draining.close()
+
+
+def test_router_rejects_oversized_body_before_forwarding(monkeypatch):
+    monkeypatch.setenv('DA4ML_SERVE_MAX_BODY_BYTES', '1024')
+    replica = _FakeReplica()
+    router = Router(replicas={'r': replica.url}, default_deadline_ms=5000.0)
+    server = RouterServer(router)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.url, {'model': 'default', 'inputs': [[0.0] * 600]})
+        assert ei.value.code == 413
+        doc = json.loads(ei.value.read())
+        assert doc['error']['type'] == 'PayloadTooLarge'
+        assert replica.infers == 0  # rejected before any leg fired
+    finally:
+        server.close()
+        replica.close()
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_announce_refuses_live_duplicate_and_close_withdraws(tmp_path):
+    reg = tmp_path / 'registry'
+    a = announce_replica(reg, 'r0', 'http://127.0.0.1:1/', ttl_s=5.0)
+    assert a is not None and a.live
+    assert announce_replica(reg, 'r0', 'http://127.0.0.1:2/', ttl_s=5.0) is None  # slot held
+    live = discover_replicas(reg)
+    assert [d['replica_id'] for d in live] == ['r0']
+    assert live[0]['url'] == 'http://127.0.0.1:1/'
+    a.close()
+    assert discover_replicas(reg) == []  # withdrawn, not just expired
+    b = announce_replica(reg, 'r0', 'http://127.0.0.1:3/', ttl_s=5.0)
+    assert b is not None
+    b.close()
+
+
+def test_expired_slot_stolen_by_exactly_one_successor(tmp_path):
+    reg = tmp_path / 'registry'
+    a = announce_replica(reg, 'r0', 'http://127.0.0.1:1/', ttl_s=0.5)
+    assert a is not None
+    # simulate SIGKILL: renewal stops without withdrawing the lease
+    a._stop.set()
+    a._thread.join(timeout=2.0)
+    expires_at = float(a.lease.expires_at)
+    time.sleep(max(expires_at + 1.0 + 0.4 - time.time(), 0.0))  # ttl + steal grace
+
+    winners: list = []
+    barrier = threading.Barrier(6)
+
+    def race(i):
+        barrier.wait()
+        got = announce_replica(reg, 'r0', f'http://127.0.0.1:{10 + i}/', ttl_s=5.0)
+        if got is not None:
+            winners.append(got)
+
+    threads = [threading.Thread(target=race, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert len(winners) == 1  # single-winner steal, however many restarts race
+    assert len(discover_replicas(reg)) == 1
+    winners[0].close()
+
+
+def test_fleet_gives_each_replica_its_own_local_tier(tmp_path):
+    fleet = Fleet(tmp_path / 'artifact.json', replicas=2, fleet_dir=tmp_path / 'fleet', shared_store=tmp_path / 'store')
+    try:
+        envs = [fleet._env_for(s) for s in fleet._slots]
+        assert all(e['DA4ML_SOLUTION_STORE'] == str(tmp_path / 'store') for e in envs)
+        tiers = [e['DA4ML_STORE_LOCAL_TIER'] for e in envs]
+        assert tiers[0].endswith('local/r0') and tiers[1].endswith('local/r1')
+        assert len(set(tiers)) == 2  # local tiers are per-replica, never shared
+    finally:
+        fleet._stop.set()
+
+
+# ------------------------------------------------------------ tiered cache
+
+
+def test_tiered_publish_and_promotion_are_byte_identical(tmp_path):
+    shared = tmp_path / 'shared'
+    warm = TieredStore(shared, tmp_path / 'local-warm')
+    k = _kernel(3)
+    key = store_key(k, BACKEND)
+    pipe = solve(k, backend=BACKEND, store=False)
+    assert warm.publish(key, pipe)
+    raw = warm._entry_path(key).read_bytes()
+    assert warm.local._entry_path(key).read_bytes() == raw  # write-through copy
+    assert _counter('store.tier.writethroughs') == 1
+
+    # a cold replica (empty mem + local) warms from the shared tier
+    cold = TieredStore(shared, tmp_path / 'local-cold')
+    before = {k2: _counter(k2) for k2 in ('store.tier.shared_hits', 'store.tier.mem_hits', 'store.tier.promotes_local')}
+    hit = cold.lookup(key)
+    assert hit is not None and _blob(hit.pipeline) == _blob(pipe)
+    assert _counter('store.tier.shared_hits') - before['store.tier.shared_hits'] == 1
+    assert _counter('store.tier.promotes_local') - before['store.tier.promotes_local'] == 1
+    assert cold.local._entry_path(key).read_bytes() == raw  # promotion is a raw copy
+
+    # the repeat is answered from mem — no tier below is touched again
+    again = cold.lookup(key)
+    assert again is not None and _blob(again.pipeline) == _blob(pipe)
+    assert _counter('store.tier.mem_hits') - before['store.tier.mem_hits'] == 1
+    assert _counter('store.tier.shared_hits') - before['store.tier.shared_hits'] == 1
+
+
+def test_tiered_mem_lru_evicts_and_falls_back_to_local(tmp_path):
+    store = TieredStore(tmp_path / 'shared', tmp_path / 'local', mem_entries=1)
+    keys = []
+    for seed in (1, 2):
+        k = _kernel(seed)
+        keys.append(store_key(k, BACKEND))
+        assert store.publish(keys[-1], solve(k, backend=BACKEND, store=False))
+    assert _counter('store.tier.mem_evictions') >= 1
+    assert store.tier_occupancy()['mem'] == {'entries': 1, 'cap': 1}
+    before_local = _counter('store.tier.local_hits')
+    assert store.lookup(keys[0]) is not None  # evicted from mem, still local
+    assert _counter('store.tier.local_hits') - before_local == 1
+
+
+def test_resolve_store_env_upgrades_explicit_paths(tmp_path, monkeypatch):
+    plain = resolve_store(tmp_path / 'shared')
+    assert isinstance(plain, SolutionStore) and not isinstance(plain, TieredStore)
+    reset_store_registry()
+    # the fleet wiring: replicas get --solve-store <shared> on the command
+    # line plus DA4ML_STORE_LOCAL_TIER in the environment — the explicit
+    # path must still read through the local tier
+    monkeypatch.setenv('DA4ML_STORE_LOCAL_TIER', str(tmp_path / 'local'))
+    tiered = resolve_store(tmp_path / 'shared')
+    assert isinstance(tiered, TieredStore)
+    assert tiered.local is not None and str(tiered.local.root).endswith('local')
+
+
+# ------------------------------------------------------------- retry hints
+
+
+def test_retry_call_honors_server_hint_capped_and_upward_jittered():
+    delays: list[float] = []
+    calls: list[int] = []
+
+    def flaky():
+        if not calls:
+            calls.append(1)
+            raise QueueFull('shed', retry_after_s=0.2)
+        return 'served'
+
+    before = _counter('retry.hints_honored')
+    out = retry_call(flaky, retries=3, base_delay=10.0, max_delay=5.0, retry_on=lambda e: True, sleep=delays.append)
+    assert out == 'served'
+    assert len(delays) == 1
+    # the hint replaces the exponential guess (base_delay=10 would have
+    # slept seconds) and jitters upward only, never below the hint
+    assert 0.2 <= delays[0] <= 0.2 * 1.25 + 1e-9
+    assert _counter('retry.hints_honored') - before == 1
+
+    def always_hinting():
+        raise QueueFull('shed', retry_after_s=30.0)
+
+    delays.clear()
+    with pytest.raises(QueueFull):
+        retry_call(always_hinting, retries=2, base_delay=0.01, max_delay=0.5, retry_on=lambda e: True, sleep=delays.append)
+    assert delays and all(d <= 0.5 + 1e-9 for d in delays)  # hint capped at max_delay
