@@ -11,6 +11,8 @@ slots directly into CI::
     da4ml-tpu verify prog.json --conformance     # + differential backends
     da4ml-tpu verify --fuzz 12 --out report.json # corpus conformance +
                                                  # transfer-soundness sweep
+    da4ml-tpu verify --concurrency               # lock/thread lint + catalog
+                                                 # drift gates + locktrace
 
 ``--conformance`` adds the opt-in cross-backend conformance pass per
 program; ``--fuzz N`` needs no paths — it sweeps N randomized ``ir.synth``
@@ -50,6 +52,13 @@ def add_verify_args(parser: argparse.ArgumentParser) -> None:
         metavar='N',
         help='no paths needed: run the N-program ir.synth differential conformance corpus plus '
         'the per-opcode transfer-soundness fuzz, and exit non-zero on any finding',
+    )
+    parser.add_argument(
+        '--concurrency',
+        action='store_true',
+        help='no paths needed: run the concurrency soundness plane — the static lock/thread '
+        'lint (X501-X507), the knob/metric catalog drift gates (X520-X525), and the runtime '
+        'lock-order report when DA4ML_LOCKTRACE is armed (X510/X511)',
     )
     parser.add_argument('--seed', type=int, default=0, help='base seed for --fuzz / --conformance inputs')
     parser.add_argument('--samples', type=int, default=64, help='input samples per program for conformance runs')
@@ -151,9 +160,51 @@ def _fuzz_main(args: argparse.Namespace) -> int:
     return 0 if report['ok'] else 1
 
 
+def _concurrency_main(args: argparse.Namespace) -> int:
+    """The concurrency soundness plane as one CI-gateable verdict: static
+    lock/thread lint + catalog drift gates + (when armed) the runtime
+    lock-order report."""
+    from ..analysis.catalogs import lint_catalogs
+    from ..analysis.concurrency import lint_concurrency
+    from ..analysis.diagnostics import VerifyResult
+    from ..reliability import locktrace
+
+    static = lint_concurrency()
+    catalogs = lint_catalogs()
+    runtime = VerifyResult(locktrace.locktrace_diagnostics(), target='locktrace')
+    combined = VerifyResult(
+        static.diagnostics + catalogs.diagnostics + runtime.diagnostics, target='concurrency'
+    )
+    rc = 0 if combined.ok and not (args.strict and combined.warnings) else 1
+    if args.as_json:
+        report = combined.to_dict()
+        report['locktrace'] = locktrace.locktrace_report()
+        if args.out:
+            args.out.write_text(json.dumps(report, indent=2))
+        print(json.dumps(report, indent=2))
+        return rc
+    print(combined.format_text(show_warnings=not args.no_warnings))
+    trace = locktrace.locktrace_report()
+    if trace['enabled']:
+        c = trace['counters']
+        print(
+            f'  locktrace: {c["acquires"]} acquires, {c["edges"]} order edges, '
+            f'{c["rank_inversions"]} rank inversions, {c["cycles"]} cycles'
+        )
+    else:
+        print('  locktrace: not armed (set DA4ML_LOCKTRACE=1 to record runtime lock order)')
+    if args.out:
+        report = combined.to_dict()
+        report['locktrace'] = trace
+        args.out.write_text(json.dumps(report, indent=2))
+    return rc
+
+
 def verify_main(args: argparse.Namespace) -> int:
     from ..analysis import verify
 
+    if args.concurrency:
+        return _concurrency_main(args)
     if args.fuzz:
         return _fuzz_main(args)
     if not args.paths:

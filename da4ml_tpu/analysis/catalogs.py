@@ -1,0 +1,277 @@
+"""Drift gates for the environment-knob and metric catalogs.
+
+Two more instances of the library's "one declarative table, lint the
+world against it" discipline (docs/analysis.md#drift-lints):
+
+- **Knobs** — :data:`KNOBS` declares every ``DA4ML_*`` environment
+  variable the library reads, with a one-line meaning. A regex scan of
+  the package finds the names actually consulted; an undocumented knob
+  or a stale table entry fails CI (X524/X525). The docs/api.md knob
+  table is *generated* from this table (``analysis.docgen``), so the
+  table, the code, and the docs cannot drift apart independently.
+- **Metrics** — :data:`da4ml_tpu.telemetry.catalog.METRICS` declares
+  every metric family with its OpenMetrics HELP text. An AST scan finds
+  every ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` /
+  ``timer(...)`` emission site; an emitted name missing from the
+  catalog, a catalogued family with no emission site, a *dynamic*
+  (f-string) emission in a module not registered in ``DYNAMIC_SITES``,
+  or a catalogued family missing its docs/telemetry.md row fails CI
+  (X520–X523).
+
+CLI: ``python -m da4ml_tpu.analysis.catalogs [--json]`` (the CI lint
+job); also folded into ``da4ml-tpu verify --concurrency``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .diagnostics import Diagnostic, VerifyResult
+
+__all__ = ['KNOBS', 'lint_knobs', 'lint_metrics', 'render_knob_table', 'scan_knobs', 'scan_metrics']
+
+#: every ``DA4ML_*`` environment variable the library reads -> meaning.
+#: docs/api.md#environment-knobs is generated from this table.
+KNOBS: dict[str, str] = {
+    'DA4ML_DIST_CONNECT_RETRIES': 'distributed coordinator connect attempts before giving up',
+    'DA4ML_DIST_CONNECT_TIMEOUT_S': 'per-attempt distributed coordinator connect timeout',
+    'DA4ML_FAULT_INJECT': 'fault-injection plan, `site=mode[:count[:arg]]` entries (reliability.md)',
+    'DA4ML_FUSED_L': 'pin the fused-CSE tile length L instead of auto-tuning it',
+    'DA4ML_HEALTH_STALL_S': 'heartbeat age that flips /healthz to degraded',
+    'DA4ML_INTERLEAVE_SEEDS': 'schedules per primitive in the deterministic interleaving suite (default 200)',
+    'DA4ML_JAX_ASYNC_EMIT': '`0` emits search buckets serially instead of overlapping device rounds',
+    'DA4ML_JAX_CACHE': 'legacy alias of `DA4ML_XLA_CACHE`',
+    'DA4ML_JAX_DEBUG': 'verbose device-search logging + sanity checks',
+    'DA4ML_JAX_DEVICE_RESIDENT': '`0` restores the host-state rung loop (per-rung fetch/re-upload)',
+    'DA4ML_JAX_EINSUM_DTYPE': '`bf16`/`f32` digit-einsum element type (default bf16 on TPU)',
+    'DA4ML_JAX_EXPORT_CACHE': '`0` disables the jax.export artifact runner cache',
+    'DA4ML_JAX_HBM_BUDGET': 'device-memory budget (bytes) steering search chunking',
+    'DA4ML_JAX_INFER_CHUNKS': 'fixed inference sample-axis chunk count override',
+    'DA4ML_JAX_INFER_CHUNK_BYTES': 'inference chunking byte budget (alternative to a fixed count)',
+    'DA4ML_JAX_MESH': '`0` never auto-mesh, `1` force the multi-device mesh',
+    'DA4ML_JAX_PMAX': 'cap on the decomposition power P explored by the device search',
+    'DA4ML_JAX_PREWARM': '`0` disables the background shape-class prewarm compiler',
+    'DA4ML_JAX_SELECT': 'selection kernel: `top4` | `xla` | `fused`',
+    'DA4ML_JAX_TOPK': 'device search top-k width override',
+    'DA4ML_JAX_TOPK_IMPL': 'top-k implementation: `sort` (fused lax.top_k) or `scan`',
+    'DA4ML_LOCKTRACE': '`1` arms the runtime lock-order tracer (locktrace.LOCK_TABLE ranks)',
+    'DA4ML_LOG_LEVEL': 'library log level (`debug`/`info`/`warning`/...)',
+    'DA4ML_METRICS_PORT': 'start the observability endpoint on this port (`0` = ephemeral)',
+    'DA4ML_NO_NATIVE_BUILD': '`1` skips building the native extension (pure-python/jax only)',
+    'DA4ML_PROFILE': 'arm `jax.profiler` and write device profiles to this directory',
+    'DA4ML_RUN_AUTOTUNE': '`0` disables runtime execution-mode autotuning',
+    'DA4ML_RUN_AUTOTUNE_BATCH': 'sample rows per autotune probe',
+    'DA4ML_RUN_AUTOTUNE_MIN_OPS': 'minimum program size before autotune probes run',
+    'DA4ML_RUN_DONATE': '`0` disables input-buffer donation on dispatch',
+    'DA4ML_RUN_MODE': 'force the DAIS execution mode instead of resolving it',
+    'DA4ML_RUN_SHARD': '`0` disables sample-axis sharding across the mesh',
+    'DA4ML_SEARCH_TRACE_DIR': 'write beam solve traces here (learned-ranker training data)',
+    'DA4ML_SERVE_MAX_BODY_BYTES': 'HTTP request-body ceiling (rejected 413 before buffering)',
+    'DA4ML_SERVE_STALL_S': 'serve queue age that flips /healthz to degraded',
+    'DA4ML_SOLUTION_STORE': 'default solution-store root (`resolve_store(None)`)',
+    'DA4ML_SOLVE_FALLBACK': '`0` disables the solve backend fallback chain (fail fast)',
+    'DA4ML_STORE_LOCAL_TIER': 'local-disk tier root layered in front of the shared store',
+    'DA4ML_STORE_MEM_ENTRIES': 'in-process LRU tier capacity (entries)',
+    'DA4ML_STORE_NEGATIVE_TTL_S': 'negative-marker lifetime after terminal solve failures',
+    'DA4ML_STORE_RO': '`1` opens the solution store read-only (no publishes, no leases)',
+    'DA4ML_TRACE': 'trace sink path (`.jsonl` streaming, else Chrome trace JSON)',
+    'DA4ML_VERIFY': '`1` verifies every solve post-hoc; `0` bypasses codegen preconditions',
+    'DA4ML_XLA_CACHE': 'persistent XLA compile cache dir (`0` disables)',
+}
+
+#: modules excluded from the metric emission scan: the registry
+#: implementation itself (its accessors take caller-supplied names)
+_METRIC_SCAN_SKIP = frozenset({'da4ml_tpu/telemetry/metrics.py'})
+
+_METRIC_FNS = frozenset({'counter', 'gauge', 'histogram', 'timer'})
+_KNOB_RE = re.compile(r'DA4ML_[A-Z0-9_]+')
+
+
+def _package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+def _py_files(root: Path):
+    for path in sorted(root.rglob('*.py')):
+        yield path, path.relative_to(root.parent).as_posix()
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def scan_knobs(root: Path | None = None) -> dict[str, list[str]]:
+    """Every ``DA4ML_*`` name appearing in the package -> modules using it.
+
+    A plain text scan on purpose: knobs are read through ``os.environ``,
+    ``os.getenv`` and doc strings alike, and a knob mentioned only in a
+    docstring still promises behavior the table must document.
+    """
+    root = root or _package_root()
+    found: dict[str, list[str]] = {}
+    for path, rel in _py_files(root):
+        if rel == 'da4ml_tpu/analysis/catalogs.py':
+            continue  # the table itself
+        for name in set(_KNOB_RE.findall(path.read_text())):
+            found.setdefault(name, []).append(rel)
+    return found
+
+
+def lint_knobs(root: Path | None = None) -> VerifyResult:
+    found = scan_knobs(root)
+    diags: list[Diagnostic] = []
+    for name in sorted(set(found) - set(KNOBS)):
+        diags.append(
+            Diagnostic(
+                rule='X524',
+                message=f'{name} (read in {found[name][0]}) is not documented in catalogs.KNOBS',
+            )
+        )
+    for name in sorted(set(KNOBS) - set(found)):
+        diags.append(
+            Diagnostic(rule='X525', message=f'KNOBS entry {name} has no remaining reader in the library')
+        )
+    return VerifyResult(diags, target='knob-catalog')
+
+
+def render_knob_table() -> str:
+    """The generated docs/api.md environment-knob table."""
+    lines = ['| knob | meaning |', '|---|---|']
+    for name, meaning in sorted(KNOBS.items()):
+        lines.append(f'| `{name}` | {meaning} |')
+    return '\n'.join(lines)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def _call_fn_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def scan_metrics(root: Path | None = None) -> tuple[dict[str, list[str]], list[tuple[str, int, str]]]:
+    """(literal emissions -> modules, dynamic emission sites).
+
+    Dynamic sites are ``(module, lineno, repr)`` for every metric call
+    whose name argument is not a string literal.
+    """
+    root = root or _package_root()
+    literal: dict[str, list[str]] = {}
+    dynamic: list[tuple[str, int, str]] = []
+    for path, rel in _py_files(root):
+        if rel in _METRIC_SCAN_SKIP:
+            continue
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or _call_fn_name(node) not in _METRIC_FNS or not node.args:
+                continue
+            arg = node.args[0]
+            branches = [arg.body, arg.orelse] if isinstance(arg, ast.IfExp) else [arg]
+            for branch in branches:
+                if isinstance(branch, ast.Constant) and isinstance(branch.value, str):
+                    literal.setdefault(branch.value, []).append(rel)
+                else:  # f-string/variable/call — anything we cannot resolve
+                    dynamic.append((rel, node.lineno, ast.unparse(branch)))
+    return literal, dynamic
+
+
+def lint_metrics(root: Path | None = None, docs_root: Path | None = None) -> VerifyResult:
+    from ..telemetry.catalog import DYNAMIC_SITES, METRICS, fold_family
+
+    literal, dynamic = scan_metrics(root)
+    diags: list[Diagnostic] = []
+
+    for name in sorted(set(literal)):
+        if fold_family(name) not in METRICS:
+            diags.append(
+                Diagnostic(
+                    rule='X520',
+                    message=(
+                        f'metric {name!r} (emitted in {literal[name][0]}) has no telemetry.catalog.METRICS '
+                        f'entry — give it a HELP string'
+                    ),
+                )
+            )
+
+    emitted = {fold_family(name) for name in literal}
+    for families in DYNAMIC_SITES.values():
+        emitted.update(families)
+    for name in sorted(set(METRICS) - emitted):
+        diags.append(
+            Diagnostic(rule='X521', message=f'METRICS entry {name!r} has no emission site left in the library')
+        )
+
+    for rel, lineno, expr in sorted(dynamic):
+        if rel not in DYNAMIC_SITES:
+            diags.append(
+                Diagnostic(
+                    rule='X522',
+                    message=(
+                        f'{rel}:{lineno}: dynamic metric name `{expr}` in a module not registered in '
+                        f'telemetry.catalog.DYNAMIC_SITES'
+                    ),
+                )
+            )
+    for rel, families in DYNAMIC_SITES.items():
+        if not any(site_rel == rel for site_rel, _, _ in dynamic):
+            diags.append(
+                Diagnostic(rule='X521', message=f'DYNAMIC_SITES entry {rel!r} has no dynamic emission left')
+            )
+        for fam in families:
+            if fam not in METRICS:
+                diags.append(
+                    Diagnostic(rule='X520', message=f'DYNAMIC_SITES family {fam!r} ({rel}) missing from METRICS')
+                )
+
+    docs = (docs_root or _package_root().parent / 'docs') / 'telemetry.md'
+    try:
+        doc_text = docs.read_text()
+    except OSError:
+        doc_text = None  # installed without docs: the doc-row check is a repo gate
+    if doc_text is not None:
+        for name in sorted(METRICS):
+            # folded families may be documented as `family.<label>` rows
+            if f'`{name}`' not in doc_text and f'`{name}.' not in doc_text:
+                diags.append(
+                    Diagnostic(
+                        rule='X523',
+                        message=f'metric family {name!r} has no `{name}` row/mention in docs/telemetry.md',
+                    )
+                )
+    return VerifyResult(diags, target='metric-catalog')
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def lint_catalogs() -> VerifyResult:
+    """Both gates as one result (the CI lint job entry point)."""
+    knobs, metrics = lint_knobs(), lint_metrics()
+    return VerifyResult(knobs.diagnostics + metrics.diagnostics, target='catalogs')
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog='python -m da4ml_tpu.analysis.catalogs', description=__doc__)
+    parser.add_argument('--json', action='store_true')
+    args = parser.parse_args(argv)
+    result = lint_catalogs()
+    print(result.to_json(indent=1) if args.json else result.format_text())
+    return 0 if result.ok else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,0 +1,388 @@
+"""Static concurrency lint (``da4ml-tpu verify --concurrency``).
+
+The declarative lock/thread registry (:mod:`da4ml_tpu.reliability.locktrace`)
+is the single source of truth for the host plane's synchronization. This
+lint AST-scans the package and fails when the source drifts from the
+tables, in the same spirit as the opcode drift lint (driftlint.py):
+
+- **X501** every ``threading.Lock/RLock/Condition`` construction must go
+  through ``make_lock``/``make_condition`` with a registered name — or,
+  for the telemetry bootstrap layer, match a ``traced=False`` table entry
+  at the declared module + attribute.
+- **X502 / X506** table entries whose construction site vanished are
+  stale — the tables cannot rot.
+- **X503** lexically nested ``with``-acquisitions must strictly ascend
+  the declared rank order (the total-order deadlock-freedom argument;
+  cross-function nesting is the runtime tracer's job).
+- **X504** no HTTP / subprocess / jax-dispatch / sleep call while
+  lexically holding a lock, unless the entry declares ``io_ok`` with a
+  reason.
+- **X505 / X507** every ``threading.Thread(...)`` must carry a ``name=``
+  whose static prefix resolves in ``THREAD_TABLE``, and daemon threads
+  must have a documented shutdown/drain path.
+
+Violations are structured :class:`Diagnostic` objects (X5xx rules,
+docs/analysis.md catalog), so the CLI, CI and ``/statusz`` consume the
+same shapes as the IR verifier.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..reliability.locktrace import LOCK_TABLE, THREAD_TABLE, LockSpec
+from .diagnostics import Diagnostic, VerifyResult
+
+#: modules allowed to construct raw threading primitives wholesale, with the
+#: reason (driftlint-style allowlist; growing it is a reviewed act).
+RAW_ALLOWLIST: dict[str, str] = {
+    'da4ml_tpu/reliability/locktrace.py': 'the lock factory itself (its internal graph lock must be raw)',
+    'da4ml_tpu/analysis/interleave.py': 'the deterministic scheduler: its gates/thread machinery must not be traced',
+}
+
+#: call names that mean blocking I/O or device dispatch under a lock (X504).
+_IO_CALLS = frozenset(
+    {
+        'urlopen',
+        'getresponse',
+        'HTTPConnection',
+        'Popen',
+        'check_call',
+        'check_output',
+        'communicate',
+        'serve_forever',
+        'block_until_ready',
+        'device_put',
+    }
+)
+#: dotted calls that mean the same (module alias -> attr).
+_IO_DOTTED = frozenset({('time', 'sleep'), ('subprocess', 'run'), ('jax', 'jit')})
+
+_LOCKISH = ('lock', 'cond')
+
+
+def _attr_form(node: ast.expr) -> str | None:
+    """The table's attr-form for an expression: ``.x`` for attribute access
+    (``self._lock``, ``state.lock``), bare ``x`` for a module-level name."""
+    if isinstance(node, ast.Attribute):
+        return '.' + node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _looks_lockish(form: str | None) -> bool:
+    return form is not None and any(form.lower().rstrip('s').endswith(k) for k in _LOCKISH)
+
+
+def _call_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name):
+            return node.func.id
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+    return None
+
+
+def _dotted(node: ast.expr) -> tuple[str, str] | None:
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+    ):
+        return (node.func.value.id, node.func.attr)
+    return None
+
+
+def _is_super_call(node: ast.Call) -> bool:
+    return (
+        isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Call)
+        and isinstance(node.func.value.func, ast.Name)
+        and node.func.value.func.id == 'super'
+    )
+
+
+def _walk_no_funcs(stmts: list[ast.stmt]):
+    """Walk statements without descending into nested function/lambda
+    bodies — code in a nested def does not run under the enclosing lock."""
+    stack: list[ast.AST] = list(stmts)
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _static_prefix(node: ast.expr) -> str | None:
+    """The constant prefix of a thread-name expression (Constant or the
+    leading literal of an f-string)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+class _ModuleIndex:
+    """Per-module resolution of attr-forms to LOCK_TABLE entries."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.by_form: dict[str, LockSpec] = {}
+        for spec in LOCK_TABLE.values():
+            if spec.module == rel or rel in spec.shared_with:
+                for form in spec.attrs:
+                    self.by_form[form] = spec
+
+    def resolve(self, node: ast.expr) -> LockSpec | None:
+        form = _attr_form(node)
+        return self.by_form.get(form) if form is not None else None
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, rel: str, lines: list[str]):
+        self.rel = rel
+        self.lines = lines
+        self.index = _ModuleIndex(rel)
+        self.diags: list[Diagnostic] = []
+        self.make_lock_names: list[tuple[str, int]] = []  # (name, lineno)
+        self.thread_prefixes: list[str] = []
+        self.raw_locks: list[tuple[str | None, int, str]] = []  # (target form, lineno, kind)
+        self._with_stack: list[LockSpec] = []
+
+    def _snippet(self, node: ast.AST) -> str:
+        i = getattr(node, 'lineno', 1) - 1
+        return self.lines[i].strip() if i < len(self.lines) else ''
+
+    def _diag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.diags.append(Diagnostic(rule=rule, message=f'{self.rel}:{getattr(node, "lineno", "?")}: {msg}'))
+
+    # -- constructions -------------------------------------------------------
+
+    def _check_construction(self, node: ast.Call, target_form: str | None) -> None:
+        """One threading.Lock/RLock/Condition() call: raw constructions are
+        only legal at a declared traced=False site."""
+        kind = _call_name(node)
+        dotted = _dotted(node)
+        if dotted and dotted[0] not in ('threading', '_threading'):
+            return
+        spec = self.index.by_form.get(target_form) if target_form else None
+        if spec is not None and not spec.traced:
+            self.make_lock_names.append((spec.name, node.lineno))
+            return
+        self._diag(
+            'X501',
+            node,
+            f'raw threading.{kind}() construction — use locktrace.make_lock/make_condition with a '
+            f'LOCK_TABLE entry (or declare a traced=False bootstrap entry): {self._snippet(node)}',
+        )
+
+    def _check_make_lock(self, node: ast.Call) -> None:
+        if not node.args or not isinstance(node.args[0], ast.Constant) or not isinstance(node.args[0].value, str):
+            self._diag('X501', node, f'make_lock/make_condition requires a literal registered name: {self._snippet(node)}')
+            return
+        name = node.args[0].value
+        spec = LOCK_TABLE.get(name)
+        if spec is None:
+            self._diag('X501', node, f'make_lock({name!r}): name not in locktrace.LOCK_TABLE')
+            return
+        if spec.module != self.rel:
+            self._diag(
+                'X501',
+                node,
+                f'make_lock({name!r}) constructed outside its declared owning module ({spec.module})',
+            )
+            return
+        self.make_lock_names.append((name, node.lineno))
+
+    def _check_thread(self, node: ast.Call) -> None:
+        name_kw = next((kw.value for kw in node.keywords if kw.arg == 'name'), None)
+        daemon = any(
+            kw.arg == 'daemon' and isinstance(kw.value, ast.Constant) and kw.value.value is True
+            for kw in node.keywords
+        )
+        if name_kw is None:
+            self._diag('X505', node, f'Thread() without a name= (every library thread is registered by prefix): {self._snippet(node)}')
+            return
+        prefix = _static_prefix(name_kw)
+        if prefix is None:
+            self._diag('X505', node, f'Thread name is not statically prefixed (use a literal or f-string with a literal head): {self._snippet(node)}')
+            return
+        # longest table prefix the static name head extends; when the head is
+        # itself shorter than every table prefix (a bare f-string stem), fall
+        # back to the longest table prefix it is a stem of
+        spec = None
+        for ts in THREAD_TABLE.values():
+            if prefix.startswith(ts.prefix) and (spec is None or len(ts.prefix) > len(spec.prefix)):
+                spec = ts
+        if spec is None:
+            for ts in THREAD_TABLE.values():
+                if ts.prefix.startswith(prefix) and (spec is None or len(ts.prefix) > len(spec.prefix)):
+                    spec = ts
+        if spec is None:
+            self._diag('X505', node, f'Thread name prefix {prefix!r} has no locktrace.THREAD_TABLE entry')
+            return
+        if spec.module != self.rel:
+            self._diag('X505', node, f'Thread prefix {spec.prefix!r} constructed outside its declared module ({spec.module})')
+            return
+        if daemon and (not spec.shutdown or spec.shutdown.strip().lower() in ('none', '')):
+            self._diag('X507', node, f'daemon thread {spec.prefix!r} declares no shutdown/drain path in THREAD_TABLE')
+        self.thread_prefixes.append(spec.prefix)
+
+    def visit_Assign(self, node: ast.Assign):
+        self._maybe_construction(node.value, node.targets[0] if len(node.targets) == 1 else None)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._maybe_construction(node.value, node.target)
+        self.generic_visit(node)
+
+    def _maybe_construction(self, value: ast.expr, target: ast.expr | None) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        cname = _call_name(value)
+        if cname in ('Lock', 'RLock', 'Condition'):
+            dotted = _dotted(value)
+            if dotted is None or dotted[0] in ('threading', '_threading'):
+                value._lt_handled = True  # type: ignore[attr-defined]
+                self._check_construction(value, _attr_form(target) if target is not None else None)
+
+    def visit_Call(self, node: ast.Call):
+        cname = _call_name(node)
+        dotted = _dotted(node)
+        if cname in ('make_lock', '_make_lock', 'make_condition'):
+            # conditions constructed over an existing lock only re-register it
+            if cname != 'make_condition' or not (len(node.args) > 1 or any(k.arg == 'lock' for k in node.keywords)):
+                self._check_make_lock(node)
+        elif cname == 'Thread':
+            if dotted is None or dotted[0] in ('threading', '_threading'):
+                self._check_thread(node)
+        elif cname == '__init__' and _is_super_call(node):
+            # Thread subclasses register through super().__init__(name=...)
+            if any(kw.arg == 'name' for kw in node.keywords):
+                prefix = _static_prefix(next(kw.value for kw in node.keywords if kw.arg == 'name'))
+                if prefix is not None and prefix.startswith('da4ml-'):
+                    self._check_thread(node)
+        elif cname in ('Lock', 'RLock', 'Condition'):
+            if dotted is not None and dotted[0] in ('threading', '_threading'):
+                # a construction not captured by visit_Assign (argument,
+                # default, field factory): raw and unanchored
+                if not getattr(node, '_lt_handled', False):
+                    self._check_construction(node, None)
+        self.generic_visit(node)
+
+    # -- nesting + IO-under-lock --------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        specs = []
+        for item in node.items:
+            expr = item.context_expr
+            spec = self.index.resolve(expr)
+            if spec is None and _looks_lockish(_attr_form(expr)):
+                self._diag(
+                    'X501',
+                    node,
+                    f'`with {self._snippet(node).removeprefix("with ").rstrip(":")}`: lock-like context '
+                    f'manager not resolvable to a LOCK_TABLE entry for this module',
+                )
+            if spec is not None:
+                for held in self._with_stack:
+                    if held.rank >= spec.rank:
+                        self._diag(
+                            'X503',
+                            node,
+                            f'acquires {spec.name!r} (rank {spec.rank}) while lexically holding '
+                            f'{held.name!r} (rank {held.rank}) — nested acquisition must ascend rank',
+                        )
+                specs.append(spec)
+        self._with_stack.extend(specs)
+        if specs and not all(s.io_ok for s in self._with_stack):
+            held = ', '.join(s.name for s in self._with_stack)
+            for sub in _walk_no_funcs(node.body):
+                if isinstance(sub, ast.Call):
+                    cname = _call_name(sub)
+                    if cname in _IO_CALLS or _dotted(sub) in _IO_DOTTED:
+                        self._diag(
+                            'X504',
+                            sub,
+                            f'{cname} called while holding {held} — move the I/O outside the lock '
+                            f'or declare io_ok with a reason in LOCK_TABLE: {self._snippet(sub)}',
+                        )
+        self.generic_visit(node)
+        del self._with_stack[len(self._with_stack) - len(specs):]
+
+
+def _scan_source(rel: str, source: str) -> _Scanner:
+    scanner = _Scanner(rel, source.splitlines())
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return scanner
+    scanner.visit(tree)
+    return scanner
+
+
+def lint_concurrency(root: str | Path | None = None) -> VerifyResult:
+    """Scan the package against LOCK_TABLE/THREAD_TABLE; returns a
+    :class:`VerifyResult` whose diagnostics are the X5xx findings."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    root = Path(root)
+    pkg = root / 'da4ml_tpu'
+    diags: list[Diagnostic] = []
+    seen_locks: set[str] = set()
+    seen_threads: set[str] = set()
+    for path in sorted(pkg.rglob('*.py')):
+        rel = path.relative_to(root).as_posix()
+        if rel in RAW_ALLOWLIST:
+            continue
+        scanner = _scan_source(rel, path.read_text())
+        diags.extend(scanner.diags)
+        seen_locks.update(name for name, _ in scanner.make_lock_names)
+        seen_threads.update(scanner.thread_prefixes)
+    for name, spec in LOCK_TABLE.items():
+        if name not in seen_locks:
+            diags.append(
+                Diagnostic(rule='X502', message=f'LOCK_TABLE entry {name!r} has no construction site in {spec.module}')
+            )
+    for prefix, tspec in THREAD_TABLE.items():
+        if prefix not in seen_threads and tspec.module not in RAW_ALLOWLIST:
+            diags.append(
+                Diagnostic(
+                    rule='X506', message=f'THREAD_TABLE entry {prefix!r} has no construction site in {tspec.module}'
+                )
+            )
+    seen_msgs: set[tuple[str, str]] = set()
+    unique = [d for d in diags if (d.rule, d.message) not in seen_msgs and not seen_msgs.add((d.rule, d.message))]
+    return VerifyResult(unique, target='concurrency')
+
+
+def lint_concurrency_main(args) -> int:
+    result = lint_concurrency(getattr(args, 'root', None))
+    if result.ok:
+        print(
+            f'lint-concurrency: ok ({len(LOCK_TABLE)} registered locks, '
+            f'{len(THREAD_TABLE)} registered thread families, 0 violations)'
+        )
+        return 0
+    print(result.format_text())
+    return 1
+
+
+def add_lint_concurrency_args(parser) -> None:
+    parser.add_argument('--root', default=None, help='repository root to scan (default: the installed package root)')
+
+
+__all__ = [
+    'RAW_ALLOWLIST',
+    'lint_concurrency',
+    'lint_concurrency_main',
+    'add_lint_concurrency_args',
+]

@@ -47,6 +47,23 @@ RULES: dict[str, tuple[str, str, str]] = {
     'D310': ('transfer-unsound', ERROR, 'a concrete result escapes the abstract transfer interval (verifier bug)'),
     'C401': ('backend-mismatch', ERROR, 'a runtime backend diverges bit-wise from the table-generated reference'),
     'C402': ('coverage-gap', ERROR, 'an opcode of the DAIS v1 table has no coverage in the fuzz corpus'),
+    'X501': ('unregistered-lock', ERROR, 'a `threading` lock/condition constructed outside `locktrace.LOCK_TABLE`'),
+    'X502': ('stale-lock-entry', ERROR, 'a `LOCK_TABLE` entry with no construction site left in the library'),
+    'X503': ('static-rank-inversion', ERROR, 'lexically nested lock acquisition against the declared rank order'),
+    'X504': ('lock-over-io', ERROR, 'HTTP/subprocess/jax-dispatch/sleep call while lexically holding a lock (absent a documented `io_ok` waiver)'),
+    'X505': ('unregistered-thread', ERROR, 'a `threading.Thread` whose name prefix is missing from `locktrace.THREAD_TABLE` (or unnamed)'),
+    'X506': ('stale-thread-entry', ERROR, 'a `THREAD_TABLE` entry with no construction site left in the library'),
+    'X507': ('no-shutdown-path', ERROR, 'a daemon thread whose table entry declares no shutdown/drain path'),
+    'X510': ('lock-cycle', ERROR, 'runtime lock-order graph contains a cycle (potential deadlock) — DA4ML_LOCKTRACE'),
+    'X511': ('rank-inversion', ERROR, 'runtime acquisition nested against the declared rank order — DA4ML_LOCKTRACE'),
+    'X512': ('invariant-violation', ERROR, 'an interleaving-harness invariant (single winner, exact tally, no lost request) failed under a seeded schedule'),
+    'X513': ('schedule-deadlock', ERROR, 'every runnable thread blocked under a seeded schedule — a real interleaving deadlock'),
+    'X520': ('undocumented-metric', ERROR, 'a metric emitted by the library with no `telemetry.catalog.METRICS` entry (no HELP text)'),
+    'X521': ('stale-metric-entry', ERROR, 'a `METRICS`/`DYNAMIC_SITES` entry with no emission site left in the library'),
+    'X522': ('unregistered-dynamic-metric', ERROR, 'a dynamically-named metric emission in a module not registered in `telemetry.catalog.DYNAMIC_SITES`'),
+    'X523': ('metric-doc-missing', ERROR, 'a catalogued metric family with no row in docs/telemetry.md'),
+    'X524': ('undocumented-knob', ERROR, 'a `DA4ML_*` environment variable read by the library but missing from `analysis.catalogs.KNOBS`'),
+    'X525': ('stale-knob-entry', ERROR, 'a `KNOBS` entry no longer read anywhere in the library'),
 }
 
 

@@ -1,9 +1,10 @@
 """Documentation generator for the table-owned reference sections.
 
-The opcode reference table in ``docs/dais.md`` and the rule catalog in
-``docs/analysis.md`` are *generated* from the single sources of truth
-(``ir/optable.py`` rows and ``analysis.diagnostics.RULES``) between marker
-comments::
+The opcode reference table in ``docs/dais.md``, the rule catalog in
+``docs/analysis.md``, and the environment-knob table in ``docs/api.md``
+are *generated* from the single sources of truth (``ir/optable.py`` rows,
+``analysis.diagnostics.RULES``, ``analysis.catalogs.KNOBS``) between
+marker comments::
 
     <!-- BEGIN GENERATED: dais-opcode-table -->
     ...
@@ -27,6 +28,7 @@ import sys
 from pathlib import Path
 
 from ..ir.optable import OP_TABLE
+from .catalogs import render_knob_table
 from .diagnostics import RULES
 
 
@@ -54,6 +56,7 @@ def render_rule_catalog() -> str:
 SECTIONS: dict[str, dict[str, object]] = {
     'docs/dais.md': {'dais-opcode-table': render_opcode_table},
     'docs/analysis.md': {'analysis-rule-catalog': render_rule_catalog},
+    'docs/api.md': {'env-knob-table': render_knob_table},
 }
 
 

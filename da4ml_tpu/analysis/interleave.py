@@ -1,0 +1,672 @@
+"""Deterministic thread-interleaving harness for the serve/store plane.
+
+The concurrency primitives this library leans on — the EDF admission
+queue, lease claim/renew/steal, single-flighted store misses, hedged
+router legs, fleet slot adoption — are exactly the code paths ordinary
+tests exercise under one lucky scheduling. This module replays them under
+*seeded* schedules instead: every traced lock operation
+(:mod:`da4ml_tpu.reliability.locktrace`) and every fault-injection site
+(:func:`da4ml_tpu.reliability.faults.fault_check`) is a preemption point,
+a cooperative :class:`Schedule` holds all participant threads parked and
+grants exactly one of them a step at a time, and a ``random.Random(seed)``
+picks who runs next. The same seed therefore produces the same
+interleaving — byte-identical schedule logs — and 200 seeds are 200
+genuinely different thread orderings of the same scenario.
+
+Each scenario checks *invariants*, not outputs: a request is resolved
+exactly once, a contended lease has exactly one winner, a dead
+single-flight winner's key is re-solved exactly once, hedged legs return
+the inflight count to zero, one fleet slot is adopted by one announcer.
+An invariant failure is a structured ``X512`` diagnostic; a schedule in
+which every runnable thread is blocked on a lock is a real interleaving
+deadlock, ``X513``. Lock-order violations observed while the tracer is
+armed (``X510``/``X511``) are folded into the result as well.
+
+CLI: ``python -m da4ml_tpu.analysis.interleave --seeds 200`` (the CI
+concurrency gate); single scenarios via ``--scenario queue``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..reliability import locktrace
+from .diagnostics import Diagnostic, VerifyResult
+
+__all__ = [
+    'SCENARIOS',
+    'Schedule',
+    'ScenarioResult',
+    'run_scenario',
+    'run_suite',
+]
+
+# acceptance floor: schedules per primitive in CI; DA4ML_INTERLEAVE_SEEDS
+# widens (soak runs) or narrows (quick local loops) the sweep
+_DEFAULT_SEEDS = int(os.environ.get('DA4ML_INTERLEAVE_SEEDS', '') or 200)
+_MAX_STEPS = 20_000  # livelock backstop: a scenario must converge well below
+
+
+class _Aborted(BaseException):
+    """Raised inside a participant to unwind it when the schedule aborts
+    (deadlock detected or step budget exhausted). BaseException so scenario
+    code's ``except Exception`` recovery paths cannot swallow it."""
+
+
+class _Participant:
+    __slots__ = ('name', 'thread', 'gate', 'state', 'blocked_on', 'error')
+
+    def __init__(self, name: str):
+        self.name = name
+        self.thread: threading.Thread | None = None
+        self.gate = threading.Event()
+        self.state = 'new'  # new | ready | running | blocked | finished
+        self.blocked_on: str | None = None
+        self.error: BaseException | None = None
+
+
+class Schedule:
+    """Cooperative scheduler: all participants parked, one granted a step.
+
+    Participants are registered with :meth:`spawn` before :meth:`run`.
+    While the schedule runs, :func:`locktrace.set_schedule_hook` routes
+    every traced lock acquire/release, condition wait and fault-check site
+    reached *by a participant thread* into :meth:`_yield_point`; threads
+    the library spawns internally (lease renewers, ...) pass through
+    unscheduled. The grant log is deterministic in the seed — it is the
+    reproduction artifact a failing seed prints.
+    """
+
+    def __init__(self, seed: int, max_steps: int = _MAX_STEPS):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.max_steps = max_steps
+        self.log: list[str] = []
+        self.deadlocked = False
+        self.aborted = False
+        self._parts: dict[str, _Participant] = {}
+        self._m = threading.Lock()  # harness-internal, deliberately raw
+        self._sched_evt = threading.Event()
+
+    # -- participant side ----------------------------------------------------
+
+    def spawn(self, name: str, fn, *args, **kwargs) -> None:
+        """Register participant ``name`` running ``fn(*args, **kwargs)``.
+
+        The thread starts parked; it takes its first step only when the
+        scheduler grants it.
+        """
+        if name in self._parts:
+            raise ValueError(f'duplicate participant {name!r}')
+        part = _Participant(name)
+
+        def _body():
+            try:
+                self._park(part, 'start', '-')
+                fn(*args, **kwargs)
+            except _Aborted:
+                pass
+            except BaseException as e:  # noqa: BLE001 - surfaced via .errors
+                part.error = e
+            finally:
+                with self._m:
+                    part.state = 'finished'
+                    self._sched_evt.set()
+
+        part.thread = threading.Thread(target=_body, name=f'da4ml-interleave-{name}', daemon=True)
+        self._parts[name] = part
+
+    def checkpoint(self, label: str) -> None:
+        """An explicit preemption point for scenario code (canned
+        transports etc.) — equivalent to reaching a fault-check site."""
+        self._yield_point('site', label)
+
+    # -- the hook ------------------------------------------------------------
+
+    def _yield_point(self, op: str, name: str) -> None:
+        part = self._parts.get(threading.current_thread().name.removeprefix('da4ml-interleave-'))
+        if part is None:
+            return  # library-internal thread: runs unscheduled
+        if op == 'release':
+            with self._m:
+                for other in self._parts.values():
+                    if other.blocked_on == name:
+                        other.blocked_on = None
+                        other.state = 'ready'
+            self._park(part, op, name)
+        elif op == 'blocked':
+            with self._m:
+                self.log.append(f'{part.name} blocked {name}')
+                part.blocked_on = name
+                part.state = 'blocked'
+                self._sched_evt.set()
+            part.gate.wait()
+            part.gate.clear()
+            if self.aborted:
+                raise _Aborted
+        else:  # acquire | cond_wait | site | start
+            self._park(part, op, name)
+
+    def _park(self, part: _Participant, op: str, name: str) -> None:
+        with self._m:
+            self.log.append(f'{part.name} {op} {name}')
+            part.state = 'ready'
+            self._sched_evt.set()
+        part.gate.wait()
+        part.gate.clear()
+        if self.aborted:
+            raise _Aborted
+
+    # -- scheduler side ------------------------------------------------------
+
+    def run(self, join_timeout_s: float = 30.0) -> None:
+        """Drive the schedule to completion (every participant finished),
+        deadlock, or step-budget exhaustion."""
+        prev_hook = locktrace._sched_hook
+        locktrace.set_schedule_hook(self._yield_point)
+        try:
+            for part in self._parts.values():
+                part.thread.start()
+            steps = 0
+            while True:
+                with self._m:
+                    states = [p.state for p in self._parts.values()]
+                    ready = sorted(n for n, p in self._parts.items() if p.state == 'ready')
+                if all(s == 'finished' for s in states):
+                    return
+                if any(s in ('running', 'new') for s in states):
+                    # someone is executing between yield points (or still
+                    # starting): wait for the next park/finish
+                    self._sched_evt.wait(timeout=10.0)
+                    self._sched_evt.clear()
+                    continue
+                if not ready:
+                    # every unfinished participant is blocked on a lock:
+                    # a genuine interleaving deadlock under this schedule
+                    self.deadlocked = True
+                    self.log.append('DEADLOCK')
+                    self._abort()
+                    return
+                steps += 1
+                if steps > self.max_steps:
+                    self.log.append('STEP-BUDGET')
+                    self._abort()
+                    raise RuntimeError(
+                        f'schedule seed={self.seed} exceeded {self.max_steps} steps (scenario livelock)'
+                    )
+                pick = self._parts[self.rng.choice(ready)]
+                self.log.append(f'grant {pick.name}')
+                with self._m:
+                    pick.state = 'running'
+                pick.gate.set()
+        finally:
+            locktrace.set_schedule_hook(prev_hook)
+            self._join_all(join_timeout_s)
+
+    def _abort(self) -> None:
+        self.aborted = True
+        for part in self._parts.values():
+            part.gate.set()
+
+    def _join_all(self, timeout_s: float) -> None:
+        # after the hook is cleared, unwound/granted threads run freely;
+        # anything still parked is released by abort semantics
+        self.aborted = True
+        for part in self._parts.values():
+            part.gate.set()
+        deadline = time.monotonic() + timeout_s
+        for part in self._parts.values():
+            part.thread.join(timeout=max(deadline - time.monotonic(), 0.1))
+
+    @property
+    def errors(self) -> dict[str, BaseException]:
+        return {n: p.error for n, p in self._parts.items() if p.error is not None}
+
+    def log_text(self) -> str:
+        return '\n'.join(self.log)
+
+
+# ---------------------------------------------------------------------------
+# scenario plumbing
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScenarioResult:
+    """Outcome of one scenario under one seed."""
+
+    scenario: str
+    seed: int
+    log: str
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+    #: False for scenarios whose step count depends on wall-clock backoff
+    #: (their invariants still hold; their logs are not byte-comparable)
+    deterministic_log: bool = True
+
+    @property
+    def ok(self) -> bool:
+        return not self.diagnostics
+
+
+def _finish(schedule: Schedule, scenario: str, violations: list[str], deterministic_log=True) -> ScenarioResult:
+    diags = [Diagnostic(rule='X512', message=f'{scenario}[seed={schedule.seed}]: {v}') for v in violations]
+    if schedule.deadlocked:
+        diags.append(
+            Diagnostic(rule='X513', message=f'{scenario}[seed={schedule.seed}]: all participants blocked')
+        )
+    for name, err in schedule.errors.items():
+        diags.append(
+            Diagnostic(
+                rule='X512',
+                message=f'{scenario}[seed={schedule.seed}]: participant {name} died: {type(err).__name__}: {err}',
+            )
+        )
+    diags.extend(
+        Diagnostic(rule=v['rule'], message=f'{scenario}[seed={schedule.seed}]: [{v["thread"]}] {v["message"]}')
+        for v in locktrace.locktrace_violations()
+    )
+    return ScenarioResult(scenario, schedule.seed, schedule.log_text(), diags, deterministic_log)
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+_pipeline_cache: list = []
+
+
+def _reference_pipeline():
+    """One tiny solved pipeline, shared across every store-scenario seed
+    (the scenario exercises the store's coordination, not the solver)."""
+    if not _pipeline_cache:
+        import numpy as np
+
+        from ..cmvm.api import solve
+
+        kernel = (np.arange(9, dtype=np.float64).reshape(3, 3) % 5) - 2.0
+        _pipeline_cache.append(solve(kernel, backend='pure-python', store=False))
+    return _pipeline_cache[0]
+
+
+def scenario_queue(seed: int, inject: str | None = None) -> ScenarioResult:
+    """EDF admission queue: producers racing a draining consumer.
+
+    Capacity forces deadline-edf evictions mid-schedule. Invariant: every
+    produced request is settled exactly once — served with a result,
+    evicted with a structured error, or rejected at push — and never both
+    served and evicted (no lost request, no double resolution).
+    """
+    import numpy as np
+
+    from ..serve.batching import AdmissionQueue, InferRequest, QueueFull
+
+    n_producers, per_producer = 3, 4
+    total = n_producers * per_producer
+    q = AdmissionQueue(cap_rows=6, policy='deadline-edf')
+    stop = threading.Event()
+    m = threading.Lock()
+    produced: list[InferRequest] = []
+    served: list[InferRequest] = []
+    rejected: list[InferRequest] = []
+    settled = [0]
+
+    def _settle(n: int = 1) -> None:
+        with m:
+            settled[0] += n
+            if settled[0] >= total:
+                stop.set()
+
+    def producer(pi: int) -> None:
+        for j in range(per_producer):
+            # deadlines spaced in whole seconds: EDF comparisons stay
+            # deterministic against scheduling jitter
+            req = InferRequest(np.zeros((2, 3)), deadline_s=float(10 + ((pi * 7 + j * 3) % 9) * 10))
+            with m:
+                produced.append(req)
+            try:
+                victim = q.push(req)
+            except QueueFull:
+                with m:
+                    rejected.append(req)
+                _settle()
+            else:
+                if victim is not None:
+                    _settle()  # victim was resolved via set_error by push
+
+    def consumer() -> None:
+        while settled[0] < total:
+            batch = q.take_batch(max_rows=4, window_s=0.0, stop=stop, poll_s=0.001)
+            for req in batch:
+                req.set_result(np.zeros((req.n_rows, 1)), served_by='interleave')
+                with m:
+                    served.append(req)
+            _settle(len(batch))
+
+    sched = Schedule(seed)
+    for pi in range(n_producers):
+        sched.spawn(f'prod{pi}', producer, pi)
+    sched.spawn('consumer', consumer)
+    sched.run()
+
+    violations: list[str] = []
+    if inject == 'double-serve' and served:
+        served.append(served[0])  # harness self-test: a double resolution
+    evicted = [r for r in produced if r._error is not None and r not in rejected]
+    if len(served) + len(evicted) + len(rejected) != total:
+        violations.append(
+            f'lost request: {len(served)} served + {len(evicted)} evicted + '
+            f'{len(rejected)} rejected != {total} produced'
+        )
+    seen_ids = [r.id for r in served] + [r.id for r in evicted] + [r.id for r in rejected]
+    if len(set(seen_ids)) != len(seen_ids):
+        violations.append('double resolution: a request was settled more than once')
+    for req in served:
+        if req._error is not None:
+            violations.append(f'request {req.id} both served and evicted')
+    if q.depth_requests() != 0:
+        violations.append(f'{q.depth_requests()} requests left in the queue')
+    return _finish(sched, 'queue', violations)
+
+
+def scenario_lease(seed: int, inject: str | None = None) -> ScenarioResult:
+    """Lease claim/steal race on an expired lease: exactly one winner.
+
+    Every claimant finds the lease expired and races the steal protocol;
+    the ``lease.steal`` site parks each of them between the expiry read
+    and the steal-lock attempt — the exact window the single-winner rename
+    must protect. ``inject='double-claim'`` makes ``exclusive_create`` lie
+    (every O_EXCL attempt "succeeds"), proving the invariant catches a
+    broken mutual exclusion as X512.
+    """
+    import json
+
+    from ..reliability import lease as lease_mod
+
+    n_claimants = 4
+    winners: list = []
+    m = threading.Lock()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        lease_dir = f'{tmp}/leases'
+        # a dead owner's lease: expired beyond any grace
+        stale = lease_mod.Lease(
+            path=lease_mod.Path(lease_dir) / 'work.lease',
+            key='work',
+            owner='dead-owner',
+            ttl_s=1.0,
+            expires_at=time.time() - 60.0,
+        )
+        lease_mod.Path(lease_dir).mkdir(parents=True)
+        stale.path.write_text(json.dumps(stale._doc()))
+
+        def claim(ci: int) -> None:
+            got = lease_mod.claim_lease(lease_dir, 'work', owner=f'claimant-{ci}', ttl_s=30.0, grace_s=0.0)
+            if got is not None:
+                with m:
+                    winners.append(got)
+
+        real_excl = lease_mod.exclusive_create
+        if inject == 'double-claim':
+
+            def lying_excl(path, payload):
+                real_excl(path, payload)
+                return True  # mutual exclusion broken on purpose
+
+            lease_mod.exclusive_create = lying_excl
+        try:
+            sched = Schedule(seed)
+            for ci in range(n_claimants):
+                sched.spawn(f'claim{ci}', claim, ci)
+            sched.run()
+        finally:
+            lease_mod.exclusive_create = real_excl
+
+    violations: list[str] = []
+    if len(winners) != 1:
+        violations.append(f'{len(winners)} claimants won the expired lease (expected exactly 1)')
+    return _finish(sched, 'lease', violations)
+
+
+def scenario_store(seed: int, inject: str | None = None) -> ScenarioResult:
+    """Single-flight winner death: the first winner's cold solve dies; the
+    key must be re-solved exactly once and every other caller must get the
+    published result.
+
+    The dead winner raises :class:`SolveTimeout` (no negative marker), its
+    lease is released in the winner's ``finally``, and the next claimant
+    through the loop becomes the new winner. Invariants: exactly 2 cold
+    solves (the death + the recovery), exactly 1 caller sees the death,
+    everyone else returns the bit-exact published pipeline.
+    """
+    from ..reliability.errors import SolveTimeout
+    from ..store.solution_store import SolutionStore
+
+    pipeline = _reference_pipeline()
+
+    n_callers = 3
+    m = threading.Lock()
+    cold_calls = [0]
+    outcomes: dict[str, object] = {}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = SolutionStore(tmp, lease_ttl_s=10.0)
+        key = 'deadbeef' * 8
+
+        def cold_solve():
+            with m:
+                cold_calls[0] += 1
+                first = cold_calls[0] == 1
+            if first:
+                raise SolveTimeout('injected winner death: search budget blown')
+            return pipeline
+
+        def caller(ci: int) -> None:
+            try:
+                outcomes[f'c{ci}'] = store.solve_through(key, cold_solve)
+            except SolveTimeout as e:
+                outcomes[f'c{ci}'] = e
+
+        sched = Schedule(seed)
+        for ci in range(n_callers):
+            sched.spawn(f'call{ci}', caller, ci)
+        sched.run()
+
+        violations: list[str] = []
+        if inject == 'double-solve':
+            cold_calls[0] += 1  # harness self-test
+        deaths = [v for v in outcomes.values() if isinstance(v, SolveTimeout)]
+        results = [v for v in outcomes.values() if not isinstance(v, BaseException)]
+        if cold_calls[0] != 2:
+            violations.append(f'{cold_calls[0]} cold solves for one key (expected 2: death + recovery)')
+        if len(deaths) != 1:
+            violations.append(f'{len(deaths)} callers saw the winner death (expected exactly 1)')
+        if len(results) != n_callers - 1:
+            violations.append(f'{len(results)}/{n_callers - 1} surviving callers got a pipeline')
+        blobs = {str(sorted(r.to_dict().items())) for r in results}
+        if len(blobs) > 1:
+            violations.append('surviving callers disagree on the published pipeline')
+        if store.lookup(key) is None:
+            violations.append('recovery result was never published')
+    return _finish(sched, 'store', violations, deterministic_log=False)
+
+
+def scenario_router(seed: int, inject: str | None = None) -> ScenarioResult:
+    """Hedged legs with a mid-flight cancel: inflight bookkeeping returns
+    to zero and exactly the uncancelled winner's bytes count.
+
+    Two legs race canned transports against one replica's shared state
+    while a canceller revokes the hedge at an arbitrary point in the
+    schedule; every leg still deposits exactly one outcome (cancelled legs
+    must not vanish — the router's outcome loop accounts for them).
+    """
+    import queue as queue_mod
+
+    from ..reliability.breaker import reset_all_breakers
+    from ..serve.router import _Leg, _Replica
+
+    reset_all_breakers()
+    rep = _Replica('r0', 'http://127.0.0.1:1')
+    outcomes: 'queue_mod.Queue[dict]' = queue_mod.Queue()
+
+    class _CannedLeg(_Leg):
+        def __init__(self, body: bytes, sched_ref):
+            super().__init__(rep, 'POST', '/v1/infer', b'{}', timeout_s=1.0, outcomes=outcomes)
+            self._body = body
+            self._sched = sched_ref
+
+        def _transport(self) -> dict:
+            self._sched[0].checkpoint('leg.transport')
+            return {'status': 200, 'body': self._body, 'headers': {}}
+
+    sched_ref: list = [None]
+    leg_a = _CannedLeg(b'A', sched_ref)
+    leg_b = _CannedLeg(b'B', sched_ref)
+
+    def canceller() -> None:
+        leg_b.cancel()
+
+    sched = Schedule(seed)
+    sched_ref[0] = sched
+    sched.spawn('legA', leg_a.run)
+    sched.spawn('legB', leg_b.run)
+    sched.spawn('cancel', canceller)
+    sched.run()
+
+    violations: list[str] = []
+    outs = []
+    while not outcomes.empty():
+        outs.append(outcomes.get_nowait())
+    if inject == 'lost-leg' and outs:
+        outs.pop()  # harness self-test: a leg's outcome vanished
+    if len(outs) != 2:
+        violations.append(f'{len(outs)} outcomes from 2 legs (a leg was lost or double-counted)')
+    with rep.lock:
+        inflight = rep.inflight
+    if inflight != 0:
+        violations.append(f'replica inflight count is {inflight} after all legs resolved (leak)')
+    winners = [o for o in outs if not o['leg'].cancelled and o.get('status') == 200]
+    if not any(o['leg'] is leg_a for o in winners):
+        violations.append('the uncancelled primary leg is missing from the winner set')
+    return _finish(sched, 'router', violations)
+
+
+def scenario_fleet(seed: int, inject: str | None = None) -> ScenarioResult:
+    """Slot adoption race: the slot's previous holder is dead (expired
+    lease); concurrent announcers must adopt it exactly once."""
+    import json
+
+    from ..reliability import lease as lease_mod
+    from ..serve.fleet import _LEASE_PREFIX, announce_replica
+
+    n_announcers = 3
+    announcements: list = []
+    m = threading.Lock()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        lease_dir = lease_mod.Path(tmp) / 'leases'
+        lease_dir.mkdir(parents=True)
+        stale = lease_mod.Lease(
+            path=lease_dir / f'{_LEASE_PREFIX}slot0.lease',
+            key=f'{_LEASE_PREFIX}slot0',
+            owner='dead-replica',
+            ttl_s=1.0,
+            expires_at=time.time() - 60.0,
+        )
+        stale.path.write_text(json.dumps(stale._doc()))
+
+        def announce(ai: int) -> None:
+            got = announce_replica(tmp, 'slot0', url=f'http://127.0.0.1:{9000 + ai}', ttl_s=30.0)
+            if got is not None:
+                with m:
+                    announcements.append(got)
+
+        sched = Schedule(seed)
+        for ai in range(n_announcers):
+            sched.spawn(f'ann{ai}', announce, ai)
+        sched.run()
+
+        violations: list[str] = []
+        if len(announcements) != 1:
+            violations.append(f'{len(announcements)} announcers adopted the expired slot (expected exactly 1)')
+        for ann in announcements:
+            ann.close()
+    return _finish(sched, 'fleet', violations)
+
+
+SCENARIOS = {
+    'queue': scenario_queue,
+    'lease': scenario_lease,
+    'store': scenario_store,
+    'router': scenario_router,
+    'fleet': scenario_fleet,
+}
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def run_scenario(name: str, seed: int, inject: str | None = None) -> ScenarioResult:
+    """One scenario under one seed, with the lock tracer armed and reset."""
+    was_armed = locktrace.locktrace_enabled()
+    locktrace.enable_locktrace()
+    locktrace.reset_locktrace()
+    try:
+        return SCENARIOS[name](seed, inject=inject)
+    finally:
+        locktrace.reset_locktrace()
+        if not was_armed:
+            locktrace.disable_locktrace()
+
+
+def run_suite(
+    scenarios: list[str] | None = None,
+    seeds: int = _DEFAULT_SEEDS,
+    seed_base: int = 0,
+) -> VerifyResult:
+    """Every scenario × ``seeds`` schedules; diagnostics from failing seeds
+    only (a failing seed's log is the reproduction: re-run it by name)."""
+    diags: list[Diagnostic] = []
+    for name in scenarios or sorted(SCENARIOS):
+        for seed in range(seed_base, seed_base + seeds):
+            result = run_scenario(name, seed)
+            diags.extend(result.diagnostics)
+    return VerifyResult(diags, target='interleave')
+
+
+def add_interleave_args(parser) -> None:
+    parser.add_argument('--scenario', action='append', choices=sorted(SCENARIOS), help='scenario(s) to run (default: all)')
+    parser.add_argument('--seeds', type=int, default=_DEFAULT_SEEDS, help='schedules per scenario')
+    parser.add_argument('--seed-base', type=int, default=0, help='first seed')
+    parser.add_argument('--show-log', type=int, default=None, metavar='SEED', help='print one seed\'s schedule log')
+    parser.add_argument('--json', action='store_true', help='machine-readable result')
+
+
+def interleave_main(args) -> int:
+    if args.show_log is not None:
+        for name in args.scenario or sorted(SCENARIOS):
+            result = run_scenario(name, args.show_log)
+            print(f'--- {name} seed={args.show_log} ok={result.ok}')
+            print(result.log)
+        return 0
+    result = run_suite(args.scenario, seeds=args.seeds, seed_base=args.seed_base)
+    print(result.to_json(indent=1) if args.json else result.format_text())
+    return 0 if result.ok else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description='deterministic interleaving harness')
+    add_interleave_args(parser)
+    return interleave_main(parser.parse_args(argv))
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

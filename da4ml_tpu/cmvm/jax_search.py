@@ -2723,8 +2723,10 @@ def solve_single_lanes(
 import queue as _queue
 import threading as _threading
 
+from ..reliability.locktrace import make_lock as _make_lock  # noqa: E402
+
 _PREWARM_Q: _queue.SimpleQueue | None = None
-_PREWARM_LOCK = _threading.Lock()
+_PREWARM_LOCK = _make_lock('cmvm.prewarm')
 
 
 def _prewarm_enabled() -> bool:

@@ -12,12 +12,13 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
 
 import numpy as np
 from numpy.typing import NDArray
 
-_lock = threading.Lock()
+from ..reliability.locktrace import make_lock
+
+_lock = make_lock('native.build')
 _lib: ctypes.CDLL | None = None
 _lib_failed: str | None = None
 

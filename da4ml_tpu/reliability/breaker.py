@@ -15,10 +15,10 @@ one solve benefits every later solve in the process.
 
 from __future__ import annotations
 
-import threading
 import time
 
 from .. import telemetry
+from .locktrace import make_lock
 
 #: numeric encoding of breaker states for the ``breaker.state.<name>`` gauge
 _STATE_CODE = {'closed': 0.0, 'half-open': 0.5, 'open': 1.0}
@@ -32,7 +32,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: float | None = None
         self._probing = False
-        self._lock = threading.Lock()
+        self._lock = make_lock('reliability.breaker.instance')
 
     def _note_transition(self, old: str, new: str) -> None:
         """Record a state change (called outside the lock)."""
@@ -85,7 +85,7 @@ class CircuitBreaker:
 
 
 _registry: dict[str, CircuitBreaker] = {}
-_registry_lock = threading.Lock()
+_registry_lock = make_lock('reliability.breaker.registry')
 
 
 def breaker_for(name: str, fail_threshold: int = 3, reset_after: float = 30.0) -> CircuitBreaker:

@@ -24,7 +24,10 @@ Spec grammar (comma-separated entries)::
 Instrumented sites (kept in docs/reliability.md): ``cmvm.solve``,
 ``cmvm.jax``, ``cmvm.native``, ``cmvm.cpu``, ``native.load_lib``,
 ``runtime.jax``, ``distributed.init``, ``checkpoint.write``,
-``checkpoint.post_save``, ``lease.claim``, ``campaign.solve`` (a planned
+``checkpoint.post_save``, ``lease.claim``, ``lease.steal`` (entered when a
+claimant found the lease expired and is about to race the steal-lock —
+a fault or interleave preemption here lands between the expiry read and
+the single-winner rename), ``campaign.solve`` (a planned
 ``sleep`` here parks a campaign worker mid-solve with its lease held — the
 chaos drill's SIGKILL target), ``campaign.post_result`` (kill-after-durable
 -result resume drills), ``store.read`` / ``store.write`` (solution-store
@@ -39,9 +42,9 @@ analysis/mutation.py).
 from __future__ import annotations
 
 import os
-import threading
 import time
 
+from . import locktrace
 from .errors import BackendUnavailable, TransientError
 
 _ENV_VAR = 'DA4ML_FAULT_INJECT'
@@ -78,7 +81,7 @@ def parse_spec(text: str) -> dict[str, _Fault]:
     return plan
 
 
-_lock = threading.Lock()
+_lock = locktrace.make_lock('reliability.faults.plan')
 _env_plan: dict[str, _Fault] | None = None  # parsed lazily from the env var
 _env_raw: str | None = None  # the raw value _env_plan was parsed from
 _override_plan: dict[str, _Fault] | None = None  # fault_injection() override
@@ -119,8 +122,11 @@ def fault_check(site: str) -> None:
     """Raise/act if an error-type fault is planned at `site` (no-op otherwise).
 
     Called at every instrumented site; the fast path (no plan) is one dict
-    lookup of the env var.
+    lookup of the env var. Instrumented sites double as preemption points
+    for the deterministic interleaving harness (analysis/interleave.py).
     """
+    if locktrace._sched_hook is not None:
+        locktrace._sched_hook('site', site)
     fault = _take(site)
     if fault is None:
         return

@@ -165,6 +165,7 @@ def claim_lease(
     # so a plain claimant's O_EXCL create can never slip in mid-steal, and
     # a racing stealer never clobbers a fresh lease. A stealer that dies
     # holding the lock leaves a stale lock broken by mtime after its ttl.
+    fault_check('lease.steal')
     lock = lease_dir / f'{key}.steal-lock'
     lock_ttl = max(grace_s, 2.0)
     try:

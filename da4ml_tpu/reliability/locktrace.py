@@ -1,0 +1,749 @@
+"""Declarative lock/thread registry + opt-in runtime lock-order tracing.
+
+The host plane that coordinates solves and serving — lease stealing, the
+EDF batcher, single-flighted store misses, the hedged router, the fleet
+supervisor — is built from ~28 hand-rolled lock sites and ~19 daemon
+threads. This module is the single source of truth for all of them, in
+the same "generate from one table, lint everything against it" discipline
+the opcode drift lint applies to the DAIS ISA (docs/analysis.md):
+
+- :data:`LOCK_TABLE` declares every lock in the library: a stable name,
+  the owning module, a documented **rank** (nested acquisitions must
+  strictly ascend rank — the classic total-order deadlock-freedom
+  argument), and whether I/O under the lock is an accepted invariant.
+- :data:`THREAD_TABLE` declares every thread the library starts, by name
+  prefix, with its documented shutdown/drain path.
+- :func:`make_lock` / :func:`make_condition` are the only sanctioned way
+  to construct a lock outside the telemetry bootstrap layer. They return
+  a plain ``threading.Lock`` passthrough wrapper whose fast path is a
+  single global check; with ``DA4ML_LOCKTRACE=1`` (or
+  :func:`enable_locktrace`) every acquisition is recorded into a
+  per-thread held stack and a global lock-order graph. A cycle in that
+  graph (potential deadlock) or a table-rank inversion becomes a
+  structured ``X5xx`` diagnostic surfaced via ``da4ml-tpu verify
+  --concurrency``, ``/statusz`` and the ``locktrace.*`` metric family.
+
+The static side — AST lints that force every raw ``threading.Lock()`` /
+``Thread(...)`` construction to be registered here — lives in
+:mod:`da4ml_tpu.analysis.concurrency`; the deterministic interleaving
+harness that drives the serve/store primitives through seeded schedules
+with this tracer armed lives in :mod:`da4ml_tpu.analysis.interleave`.
+
+This module intentionally imports **only the stdlib**: it must be
+importable from every layer (telemetry excepted — see ``traced=False``
+entries) without creating an import cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+__all__ = [
+    'LOCK_TABLE',
+    'THREAD_TABLE',
+    'LockSpec',
+    'ThreadSpec',
+    'TracedLock',
+    'TracedCondition',
+    'make_lock',
+    'make_condition',
+    'enable_locktrace',
+    'disable_locktrace',
+    'locktrace_enabled',
+    'locktrace_report',
+    'locktrace_violations',
+    'reset_locktrace',
+    'set_schedule_hook',
+    'thread_spec_for',
+]
+
+
+# ---------------------------------------------------------------------------
+# declarative tables
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LockSpec:
+    """One registered lock.
+
+    ``rank`` orders nested acquisition: while holding a lock of rank r, a
+    thread may only acquire locks of strictly greater rank. ``attrs`` are
+    the source forms the static lint resolves ``with <expr>:`` statements
+    against — a leading ``.`` means attribute access (``self._lock``,
+    ``state.lock``), a bare name means a module-level global. ``traced``
+    is False only for the telemetry bootstrap layer, which must stay
+    importable before the reliability package exists; those locks are
+    covered by the static lint but not the runtime tracer. ``io_ok``
+    documents a lock deliberately held across I/O, with the reason."""
+
+    name: str
+    rank: int
+    module: str
+    attrs: tuple[str, ...]
+    doc: str
+    kind: str = 'lock'  # 'lock' | 'condition'
+    traced: bool = True
+    io_ok: bool = False
+    io_reason: str = ''
+    #: other modules that acquire this lock by importing it (rare; the
+    #: static lint resolves `with` statements there too)
+    shared_with: tuple[str, ...] = ()
+
+
+def _spec(name, rank, module, attrs, doc, **kw) -> tuple[str, LockSpec]:
+    return name, LockSpec(name, rank, module, tuple(attrs), doc, **kw)
+
+
+#: Every lock in the library, ordered by rank (outermost first). Nested
+#: acquisition must strictly ascend rank; the static lint (X503) checks
+#: lexical nesting and the runtime tracer (X511) checks actual nesting.
+LOCK_TABLE: dict[str, LockSpec] = dict(
+    [
+        _spec(
+            'serve.engine.registry',
+            10,
+            'da4ml_tpu/serve/engine.py',
+            ('._lock',),
+            'ServeEngine model registry: load/unload/lookup of _ModelState entries.',
+        ),
+        _spec(
+            'serve.engine.model',
+            15,
+            'da4ml_tpu/serve/engine.py',
+            ('.lock',),
+            'Per-model state: version swaps (hot reload) and batcher wiring.',
+        ),
+        _spec(
+            'serve.engine.executors',
+            18,
+            'da4ml_tpu/serve/engine.py',
+            ('._exec_lock',),
+            'Compiled-executor LRU; eviction accounting happens under it.',
+        ),
+        _spec(
+            'serve.fleet.slots',
+            20,
+            'da4ml_tpu/serve/fleet.py',
+            ('._lock',),
+            'Fleet slot table: spawn/restart vs. close() exclusion.',
+            io_ok=True,
+            io_reason=(
+                'subprocess.Popen runs under the lock by design: a restart must be '
+                'atomic against close() killing the slot, or a crash-looping replica '
+                'could be respawned after shutdown.'
+            ),
+        ),
+        _spec(
+            'serve.router.registry',
+            25,
+            'da4ml_tpu/serve/router.py',
+            ('._lock',),
+            'Router replica registry: discovery refresh vs. pick/forward.',
+        ),
+        _spec(
+            'serve.router.replica',
+            30,
+            'da4ml_tpu/serve/router.py',
+            ('.lock',),
+            'Per-replica inflight/EWMA bookkeeping (hedge legs + prober).',
+        ),
+        _spec(
+            'serve.http.inflight',
+            35,
+            'da4ml_tpu/serve/http.py',
+            ('._inflight_lock',),
+            'In-flight request count for graceful drain on close().',
+        ),
+        _spec(
+            'serve.queue',
+            40,
+            'da4ml_tpu/serve/batching.py',
+            ('._lock', '._cond'),
+            'AdmissionQueue items/rows + its condition (EDF push/take_batch).',
+            kind='condition',
+        ),
+        _spec(
+            'serve.loadgen.tally',
+            45,
+            'da4ml_tpu/serve/loadgen.py',
+            ('.lock',),
+            'Load-generator outcome accumulator shared by worker threads.',
+        ),
+        _spec(
+            'store.registry',
+            50,
+            'da4ml_tpu/store/solution_store.py',
+            ('_stores_lock',),
+            'Process-wide SolutionStore handle cache (tiered.py imports it '
+            'to register TieredStore handles in the same cache).',
+            shared_with=('da4ml_tpu/store/tiered.py',),
+        ),
+        _spec(
+            'store.tiered.mem',
+            55,
+            'da4ml_tpu/store/tiered.py',
+            ('._mem_lock',),
+            'TieredStore in-process LRU tier.',
+        ),
+        _spec(
+            'reliability.breaker.registry',
+            60,
+            'da4ml_tpu/reliability/breaker.py',
+            ('_registry_lock',),
+            'Process-global circuit-breaker registry.',
+        ),
+        _spec(
+            'reliability.breaker.instance',
+            65,
+            'da4ml_tpu/reliability/breaker.py',
+            ('._lock',),
+            'One breaker state machine; transitions are noted outside it.',
+        ),
+        _spec(
+            'reliability.faults.plan',
+            70,
+            'da4ml_tpu/reliability/faults.py',
+            ('_lock',),
+            'Active fault-injection plan and its per-site budgets.',
+        ),
+        _spec(
+            'native.build',
+            75,
+            'da4ml_tpu/native/bindings.py',
+            ('_lock',),
+            'Native extension build/load singleton.',
+            io_ok=True,
+            io_reason=(
+                'the C compiler subprocess runs under the lock by design: exactly one '
+                'thread may build the extension; the others must wait for the artifact, '
+                'not race a second compile.'
+            ),
+        ),
+        _spec(
+            'cmvm.prewarm',
+            80,
+            'da4ml_tpu/cmvm/jax_search.py',
+            ('_PREWARM_LOCK',),
+            'Lazy construction of the prewarm queue + worker thread.',
+        ),
+        _spec(
+            'telemetry.state',
+            85,
+            'da4ml_tpu/telemetry/core.py',
+            ('.lock',),
+            'Tracing sink set + span bookkeeping.',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.export.sink',
+            86,
+            'da4ml_tpu/telemetry/export.py',
+            ('._lock',),
+            'Per-sink serialization of trace event writes (both sink classes).',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.obs.profile',
+            87,
+            'da4ml_tpu/telemetry/obs/profile.py',
+            ('_lock',),
+            'Device-profile capture singleton.',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.obs.server',
+            88,
+            'da4ml_tpu/telemetry/obs/server.py',
+            ('_lock',),
+            'Observability HTTP server singleton (per-pid).',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.log.configure',
+            90,
+            'da4ml_tpu/telemetry/log.py',
+            ('_configure_lock',),
+            'One-shot logging handler configuration.',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.log.warn_once',
+            91,
+            'da4ml_tpu/telemetry/log.py',
+            ('_warn_once_lock',),
+            'Deduplicated warning registry.',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.metrics.registry',
+            95,
+            'da4ml_tpu/telemetry/metrics.py',
+            ('_lock',),
+            'Metric name -> instance registry.',
+            traced=False,
+        ),
+        _spec(
+            'telemetry.metrics.instance',
+            99,
+            'da4ml_tpu/telemetry/metrics.py',
+            ('._lock',),
+            'Per-metric value lock (innermost rank: metrics are emitted under '
+            'other subsystem locks; hot path, untraced by design).',
+            traced=False,
+        ),
+    ]
+)
+
+
+@dataclass(frozen=True)
+class ThreadSpec:
+    """One registered thread family, keyed by ``threading.Thread`` name
+    prefix. ``shutdown`` documents the drain path the lint (X507)
+    requires: how the thread is stopped or why abandoning it is safe."""
+
+    prefix: str
+    module: str
+    shutdown: str
+    doc: str
+
+
+def _tspec(prefix, module, shutdown, doc) -> tuple[str, ThreadSpec]:
+    return prefix, ThreadSpec(prefix, module, shutdown, doc)
+
+
+#: Every thread the library starts. Thread constructions must pass a
+#: ``name=`` whose static prefix resolves here (longest prefix wins).
+THREAD_TABLE: dict[str, ThreadSpec] = dict(
+    [
+        _tspec(
+            'da4ml-obs-server',
+            'da4ml_tpu/telemetry/obs/server.py',
+            'atexit-registered stop_server() shuts the socket down; fork-safe via per-pid guard',
+            'serve_forever loop of the /metrics //healthz //statusz endpoint.',
+        ),
+        _tspec(
+            'da4ml-serve-http',
+            'da4ml_tpu/serve/http.py',
+            'ServeServer.close(): in-flight drain, then httpd.shutdown() + join',
+            'HTTP front door of one ServeEngine.',
+        ),
+        _tspec(
+            'da4ml-serve-hedge-',
+            'da4ml_tpu/serve/engine.py',
+            'bounded: races exactly one device call and exits; winner signals the done event',
+            'Hedged fallback leg of a device dispatch.',
+        ),
+        _tspec(
+            'da4ml-serve-',
+            'da4ml_tpu/serve/engine.py',
+            'ServeEngine.drain()/close(): per-model stop event, queue drained, then join',
+            'Per-model batcher loop (take_batch -> device dispatch).',
+        ),
+        _tspec(
+            'da4ml-router-probe',
+            'da4ml_tpu/serve/router.py',
+            'Router.close(): stop event + join',
+            'Replica health prober / registry refresh loop.',
+        ),
+        _tspec(
+            'da4ml-router-leg-',
+            'da4ml_tpu/serve/router.py',
+            'bounded: one proxied HTTP call; cancelled legs decrement inflight and exit',
+            'One hedged forwarding attempt against one replica.',
+        ),
+        _tspec(
+            'da4ml-router-http',
+            'da4ml_tpu/serve/router.py',
+            'RouterServer.close(): httpd.shutdown() + join',
+            'HTTP front door of the replica-fleet router.',
+        ),
+        _tspec(
+            'da4ml-replica-renew-',
+            'da4ml_tpu/serve/fleet.py',
+            'ReplicaAnnouncement.close(): stop event + join, then lease release',
+            'Slot-lease renewal at ttl/3 while a replica is announced.',
+        ),
+        _tspec(
+            'da4ml-fleet-sup-',
+            'da4ml_tpu/serve/fleet.py',
+            'Fleet.close(): stop event observed at wait/restart points, then join',
+            'Per-slot crash supervisor (wait -> backoff -> respawn).',
+        ),
+        _tspec(
+            'da4ml-deadline-',
+            'da4ml_tpu/reliability/deadline.py',
+            'bounded-by-contract: abandoned detached on timeout (documented in run_with_deadline)',
+            'Supervised wall-clock budget worker.',
+        ),
+        _tspec(
+            'da4ml-store-renew-',
+            'da4ml_tpu/store/solution_store.py',
+            'scoped: _Renewer.stop() by the single-flight winner in a finally block',
+            'Single-flight lease renewal while the winner solves.',
+        ),
+        _tspec(
+            'da4ml-lease-renew-',
+            'da4ml_tpu/parallel/campaign.py',
+            'scoped: _Renewer.stop() by the campaign worker in a finally block',
+            'Campaign work-item lease renewal.',
+        ),
+        _tspec(
+            'da4ml-solve-svc-',
+            'da4ml_tpu/store/service.py',
+            'SolveService.close(): stop event, queue drained, then join',
+            'Solve-service worker pulling from the admission queue.',
+        ),
+        _tspec(
+            'da4ml-prewarm',
+            'da4ml_tpu/cmvm/jax_search.py',
+            'daemon-by-design: speculative AOT compiles die with the process '
+            '(joining would hang interpreter exit on a queued remote compile)',
+            'Background shape-class prewarm compiler.',
+        ),
+        _tspec(
+            'da4ml-warmup',
+            'da4ml_tpu/_cli/convert.py',
+            'bounded one-shot: runs warmup_main once and exits; safe to abandon at exit',
+            'Post-convert background cache warmup.',
+        ),
+        _tspec(
+            'da4ml-loadgen-',
+            'da4ml_tpu/serve/loadgen.py',
+            'scoped: joined by closed_loop()/burst() before they return',
+            'Load-generator worker firing requests at a serve endpoint.',
+        ),
+        _tspec(
+            'da4ml-chaos-load',
+            'da4ml_tpu/serve/chaos.py',
+            'scoped: joined by the drill before the report is assembled',
+            'Background load thread of a chaos drill.',
+        ),
+        _tspec(
+            'da4ml-interleave-',
+            'da4ml_tpu/analysis/interleave.py',
+            'scoped: gate-stepped and joined by Schedule.run()',
+            'Deterministic-interleaving harness participant.',
+        ),
+    ]
+)
+
+
+def thread_spec_for(name: str) -> ThreadSpec | None:
+    """Resolve a thread name to its table entry (longest prefix wins)."""
+    best = None
+    for prefix, spec in THREAD_TABLE.items():
+        if name.startswith(prefix) and (best is None or len(prefix) > len(best.prefix)):
+            best = spec
+    return best
+
+
+# ---------------------------------------------------------------------------
+# runtime tracer state
+# ---------------------------------------------------------------------------
+
+_MAX_VIOLATIONS = 256  # bounded: a pathological loop must not grow unbounded state
+
+_armed = os.environ.get('DA4ML_LOCKTRACE', '') in ('1', 'true', 'on')
+_sched_hook = None  # interleave-harness yield hook: fn(op, name) -> None
+
+_tls = threading.local()  # .held: list[TracedLock] per thread
+_graph_lock = threading.Lock()  # raw by necessity: the tracer's own leaf lock
+_edges: dict[str, set[str]] = {}  # observed held -> acquired orderings
+_violations: list[dict] = []
+_violation_keys: set[tuple] = set()  # dedup: one report per (rule, a, b)
+_counts = {'acquires': 0, 'edges': 0, 'rank_inversions': 0, 'cycles': 0}
+
+
+def locktrace_enabled() -> bool:
+    return _armed
+
+
+def enable_locktrace() -> None:
+    """Arm the tracer (equivalent to ``DA4ML_LOCKTRACE=1``). Locks made by
+    :func:`make_lock` switch to recording on the next acquisition — no
+    reconstruction needed."""
+    global _armed
+    _armed = True
+
+
+def disable_locktrace() -> None:
+    global _armed
+    _armed = False
+
+
+def reset_locktrace() -> None:
+    """Forget the observed order graph and violations (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+        _violations.clear()
+        _violation_keys.clear()
+        for k in _counts:
+            _counts[k] = 0
+
+
+def set_schedule_hook(hook) -> None:
+    """Install (or clear, with None) the interleaving harness's yield hook.
+
+    The hook is called as ``hook(op, name)`` with op in ``'acquire'``
+    (before an acquisition attempt), ``'blocked'`` (a non-blocking attempt
+    failed), ``'release'``, ``'cond_wait'`` and ``'site'`` (a fault-check
+    site). Only :mod:`da4ml_tpu.analysis.interleave` should set this."""
+    global _sched_hook
+    _sched_hook = hook
+
+
+def _held() -> list:
+    held = getattr(_tls, 'held', None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _find_cycle(src: str, dst: str) -> list[str] | None:
+    """Path dst ~> src in the order graph (the new edge src->dst closes it)."""
+    stack = [(dst, [dst])]
+    seen = {dst}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt == src:
+                return path + [src]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_violation(rule: str, key: tuple, doc: dict) -> None:
+    if key in _violation_keys or len(_violations) >= _MAX_VIOLATIONS:
+        return
+    _violation_keys.add(key)
+    doc['rule'] = rule
+    doc['thread'] = threading.current_thread().name
+    _violations.append(doc)
+
+
+def _note_acquired(lock: 'TracedLock') -> None:
+    """Bookkeeping after a successful traced acquisition."""
+    held = _held()
+    with _graph_lock:
+        _counts['acquires'] += 1
+        for h in held:
+            if h.name == lock.name:
+                continue
+            peers = _edges.setdefault(h.name, set())
+            if lock.name not in peers:
+                peers.add(lock.name)
+                _counts['edges'] += 1
+                cycle = _find_cycle(h.name, lock.name)
+                if cycle is not None:
+                    _counts['cycles'] += 1
+                    _record_violation(
+                        'X510',
+                        ('X510', h.name, lock.name),
+                        {
+                            'held': h.name,
+                            'acquiring': lock.name,
+                            'cycle': cycle,
+                            'message': f'lock-order cycle: {" -> ".join(cycle)}',
+                        },
+                    )
+            if h.rank >= lock.rank:
+                _counts['rank_inversions'] += 1
+                _record_violation(
+                    'X511',
+                    ('X511', h.name, lock.name),
+                    {
+                        'held': h.name,
+                        'held_rank': h.rank,
+                        'acquiring': lock.name,
+                        'acquiring_rank': lock.rank,
+                        'message': (
+                            f'rank inversion: acquired {lock.name!r} (rank {lock.rank}) '
+                            f'while holding {h.name!r} (rank {h.rank})'
+                        ),
+                    },
+                )
+    held.append(lock)
+
+
+def _note_released(lock: 'TracedLock') -> None:
+    held = getattr(_tls, 'held', None)
+    if held:
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+
+
+# ---------------------------------------------------------------------------
+# traced primitives
+# ---------------------------------------------------------------------------
+
+
+class TracedLock:
+    """``threading.Lock`` wrapper that records acquisition order when the
+    tracer is armed and yields to the interleaving scheduler when one is
+    installed. The unarmed fast path is a single global check."""
+
+    __slots__ = ('name', 'rank', '_raw', '_owner')
+
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = rank
+        self._raw = threading.Lock()
+        self._owner: int | None = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        hook = _sched_hook
+        if hook is None and not _armed:
+            got = self._raw.acquire(blocking, timeout)
+            if got:
+                self._owner = threading.get_ident()
+            return got
+        if hook is not None and blocking:
+            hook('acquire', self.name)
+            while not self._raw.acquire(False):
+                hook('blocked', self.name)
+            got = True
+        else:
+            got = self._raw.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+            if _armed:
+                _note_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._owner = None
+        self._raw.release()
+        if _armed:
+            _note_released(self)
+        hook = _sched_hook
+        if hook is not None:
+            hook('release', self.name)
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # threading.Condition duck-type hooks: route the condition's internal
+    # lock juggling through the traced acquire/release so the held stack
+    # stays correct across wait().
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        self.release()
+        return None
+
+    def _acquire_restore(self, _state) -> None:
+        self.acquire()
+
+    def __repr__(self) -> str:
+        return f'<TracedLock {self.name!r} rank={self.rank} locked={self.locked()}>'
+
+
+class TracedCondition(threading.Condition):
+    """Condition over a :class:`TracedLock`. Under the interleaving
+    scheduler, ``wait`` degrades to release -> yield -> reacquire (spurious
+    wakeup semantics — every caller in this codebase re-checks its
+    predicate in a loop), because a real waiter park is not schedulable."""
+
+    def __init__(self, lock: TracedLock):
+        super().__init__(lock)
+        self.name = lock.name
+
+    def wait(self, timeout: float | None = None) -> bool:
+        hook = _sched_hook
+        if hook is not None:
+            self._lock.release()
+            try:
+                hook('cond_wait', self.name)
+            finally:
+                self._lock.acquire()
+            return True
+        return super().wait(timeout)
+
+
+# ---------------------------------------------------------------------------
+# factory
+# ---------------------------------------------------------------------------
+
+
+def make_lock(name: str):
+    """Construct the registered lock ``name``.
+
+    The table entry is the contract: an unregistered name is a programming
+    error (register it in :data:`LOCK_TABLE` with a documented rank — the
+    static lint enforces the same rule at the source level)."""
+    spec = LOCK_TABLE.get(name)
+    if spec is None:
+        raise KeyError(
+            f'lock {name!r} is not registered in locktrace.LOCK_TABLE; '
+            f'declare it with a documented rank before constructing it'
+        )
+    if not spec.traced:
+        return threading.Lock()
+    return TracedLock(name, spec.rank)
+
+
+def make_condition(name: str, lock=None):
+    """Construct a condition over the registered lock ``name`` (or over an
+    already-constructed lock from :func:`make_lock`)."""
+    if lock is None:
+        lock = make_lock(name)
+    if isinstance(lock, TracedLock):
+        return TracedCondition(lock)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+
+def locktrace_violations() -> list[dict]:
+    with _graph_lock:
+        return [dict(v) for v in _violations]
+
+
+def locktrace_counters() -> dict[str, int]:
+    with _graph_lock:
+        return dict(_counts)
+
+
+def locktrace_report() -> dict:
+    """The runtime lock-order report: edges observed, violations, counters.
+
+    Shape is stable — it feeds ``/statusz``, ``da4ml-tpu verify
+    --concurrency --json`` and the CI artifact."""
+    with _graph_lock:
+        return {
+            'enabled': _armed,
+            'locks_registered': len(LOCK_TABLE),
+            'threads_registered': len(THREAD_TABLE),
+            'edges': sorted((a, b) for a, peers in _edges.items() for b in peers),
+            'violations': [dict(v) for v in _violations],
+            'counters': dict(_counts),
+        }
+
+
+def locktrace_diagnostics() -> list:
+    """Runtime violations as structured :class:`Diagnostic` objects
+    (lazy import: analysis must not be a hard dependency of the serve
+    plane)."""
+    from ..analysis.diagnostics import Diagnostic
+
+    out = []
+    for v in locktrace_violations():
+        out.append(Diagnostic(rule=v['rule'], message=f'[{v["thread"]}] {v["message"]}'))
+    return out

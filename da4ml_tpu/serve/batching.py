@@ -31,6 +31,7 @@ import numpy as np
 from numpy.typing import NDArray
 
 from ..reliability.errors import ReliabilityError
+from ..reliability.locktrace import make_condition, make_lock
 
 _req_ids = itertools.count(1)
 
@@ -183,8 +184,8 @@ class AdmissionQueue:
         self.policy = policy
         self._items: list[InferRequest] = []
         self._rows = 0
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = make_lock('serve.queue')
+        self._cond = make_condition('serve.queue', self._lock)
         self.shed_total = 0
         self.admitted_total = 0
 

@@ -116,7 +116,7 @@ def chaos_drill(
         )
 
     with telemetry.span('serve.chaos_drill'):
-        lt = threading.Thread(target=load_thread, daemon=True)
+        lt = threading.Thread(target=load_thread, name='da4ml-chaos-load', daemon=True)
         lt.start()
         t_phase = max(duration_s / 4.0, 0.5)
         time.sleep(t_phase)  # phase 1: steady state
@@ -339,7 +339,7 @@ def fleet_chaos_drill(
 
             kill_id, reload_id = rids[2], rids[3]
             kill_old_pid = next(d['pid'] for d in discover_replicas(fleet.registry_dir) if d['replica_id'] == kill_id)
-            lt = threading.Thread(target=load_thread, daemon=True)
+            lt = threading.Thread(target=load_thread, name='da4ml-chaos-load', daemon=True)
             lt.start()
             time.sleep(max(duration_s / 3.0, 1.0))
             killed_pid = fleet.kill_replica(kill_id)

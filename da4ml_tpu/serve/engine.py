@@ -45,6 +45,7 @@ from ..parallel.shapes import canon_dim, grid_rungs
 from ..reliability.breaker import breaker_for
 from ..reliability.errors import InvalidInputError
 from ..reliability.faults import fault_check
+from ..reliability.locktrace import make_lock
 from .batching import (
     AdmissionQueue,
     DeadlineExpired,
@@ -101,7 +102,7 @@ class _ModelState:
     source: str | None
     version: int = 1
     queue: AdmissionQueue = field(default=None)  # type: ignore[assignment]
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: threading.Lock = field(default_factory=lambda: make_lock('serve.engine.model'))
     stop: threading.Event = field(default_factory=threading.Event)
     warm_rows: set[int] = field(default_factory=set)
     n_in: int = 0
@@ -159,8 +160,8 @@ class ServeEngine:
         self._models: dict[str, _ModelState] = {}
         self._workers: dict[str, threading.Thread] = {}
         self._executors: 'dict[str, tuple[int, object]]' = {}  # name -> (version, executor), LRU
-        self._exec_lock = threading.Lock()
-        self._lock = threading.Lock()
+        self._exec_lock = make_lock('serve.engine.executors')
+        self._lock = make_lock('serve.engine.registry')
         self._stop = threading.Event()
         self._draining = False
         self._shed_times: list[float] = []  # recent shed timestamps (rate window)

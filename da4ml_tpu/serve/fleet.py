@@ -42,6 +42,7 @@ from pathlib import Path
 from .. import telemetry
 from ..reliability.checkpoint import atomic_write_bytes
 from ..reliability.lease import DEFAULT_GRACE_S, claim_lease, default_owner, list_leases, release_lease, renew_lease
+from ..reliability.locktrace import make_lock
 
 #: replica liveness lease TTL: short enough that routers drop a SIGKILLed
 #: replica within seconds, long enough that renew-at-ttl/3 is cheap
@@ -219,7 +220,7 @@ class Fleet:
         self.replica_ttl_s = replica_ttl_s
         self._extra_env = dict(env or {})
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock('serve.fleet.slots')
         (self.fleet_dir / 'logs').mkdir(parents=True, exist_ok=True)
         self.registry_dir.mkdir(parents=True, exist_ok=True)
         self._slots = [_Slot(f'r{i}', self.fleet_dir / 'logs' / f'r{i}.log') for i in range(self.n)]

@@ -37,6 +37,7 @@ from random import random
 import numpy as np
 
 from ..reliability.errors import InvalidInputError
+from ..reliability.locktrace import make_lock
 from .batching import PayloadTooLarge, ServeRejected
 from .engine import ServeEngine
 
@@ -72,7 +73,7 @@ class ServeServer:
         self.engine = engine
         self.solve_service = solve_service
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock('serve.http.inflight')
         srv = self
 
         class _Handler(BaseHTTPRequestHandler):

@@ -23,6 +23,7 @@ import urllib.request
 import numpy as np
 
 from ..reliability.errors import InvalidInputError
+from ..reliability.locktrace import make_lock
 from .batching import DeadlineExpired, QueueFull, ServeRejected
 
 
@@ -38,7 +39,7 @@ class _Tally:
     """Thread-safe outcome accumulator."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock('serve.loadgen.tally')
         self.lat_ms: list[float] = []
         self.ok = 0
         self.shed = 0
@@ -188,7 +189,7 @@ def closed_loop(
                         tally.mismatches += 1
                 tally.record('ok', lat_ms=lat_ms, rows=len(x), served_by=served_by)
 
-    threads = [threading.Thread(target=worker, args=(w,), daemon=True) for w in range(workers)]
+    threads = [threading.Thread(target=worker, args=(w,), name=f'da4ml-loadgen-{w}', daemon=True) for w in range(workers)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -232,7 +233,7 @@ def burst(
                     tally.mismatches += 1
             tally.record('ok', lat_ms=(time.perf_counter() - t0) * 1e3, rows=len(x), served_by=served_by)
 
-    threads = [threading.Thread(target=one, args=(i,), daemon=True) for i in range(n_requests)]
+    threads = [threading.Thread(target=one, args=(i,), name=f'da4ml-loadgen-burst-{i}', daemon=True) for i in range(n_requests)]
     for t in threads:
         t.start()
     t0 = time.perf_counter()

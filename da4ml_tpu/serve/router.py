@@ -48,6 +48,7 @@ from random import random
 
 from .. import telemetry
 from ..reliability.breaker import breaker_for
+from ..reliability.locktrace import make_lock
 from .batching import ServeRejected
 
 #: default hedge delay: fires only for genuine stragglers well past the
@@ -88,7 +89,7 @@ class _Replica:
         self.ewma_s = 0.0
         self.probe_status = 'unknown'  # ok | degraded | draining | dead | unknown
         self.doc = doc or {}
-        self.lock = threading.Lock()
+        self.lock = make_lock('serve.router.replica')
 
     @property
     def breaker(self):
@@ -136,19 +137,27 @@ class _Leg(threading.Thread):
         self.conn: http.client.HTTPConnection | None = None
         self.cancelled = False
 
+    def _transport(self) -> dict:
+        """One HTTP attempt against the replica. Split out from :meth:`run`
+        so the interleaving harness (analysis/interleave.py) can substitute
+        a canned transport and drive the shared-state bookkeeping — inflight
+        counts, breaker charges, the winner/cancel tally — deterministically."""
+        r = self.replica
+        self.conn = http.client.HTTPConnection(r.host, r.port, timeout=self.timeout_s)
+        headers = {'Content-Type': 'application/json'} if self.body is not None else {}
+        self.conn.request(self.method, self.path, body=self.body, headers=headers)
+        resp = self.conn.getresponse()
+        data = resp.read()
+        hdrs = {k: resp.getheader(k) for k in _PASS_HEADERS if resp.getheader(k)}
+        return {'status': resp.status, 'body': data, 'headers': hdrs}
+
     def run(self) -> None:
         r = self.replica
         with r.lock:
             r.inflight += 1
         t0 = time.perf_counter()
         try:
-            self.conn = http.client.HTTPConnection(r.host, r.port, timeout=self.timeout_s)
-            headers = {'Content-Type': 'application/json'} if self.body is not None else {}
-            self.conn.request(self.method, self.path, body=self.body, headers=headers)
-            resp = self.conn.getresponse()
-            data = resp.read()
-            hdrs = {k: resp.getheader(k) for k in _PASS_HEADERS if resp.getheader(k)}
-            out = {'leg': self, 'status': resp.status, 'body': data, 'headers': hdrs}
+            out = {'leg': self, **self._transport()}
         except Exception as e:  # noqa: BLE001 - transport failure is an outcome
             out = {'leg': self, 'error': e}
         finally:
@@ -199,7 +208,7 @@ class Router:
         self.default_deadline_ms = default_deadline_ms
         self.probe_timeout_s = probe_timeout_s
         self._replicas: dict[str, _Replica] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock('serve.router.registry')
         self._stop = threading.Event()
         for rid, url in (replicas or {}).items():
             self._replicas[rid] = _Replica(rid, url)

@@ -60,6 +60,7 @@ from ..reliability.checkpoint import atomic_write_bytes, fsync_dir, kernel_diges
 from ..reliability.errors import BackendUnavailable, ReliabilityError, SolveTimeout, classify
 from ..reliability.faults import fault_active, fault_check
 from ..reliability.lease import DEFAULT_GRACE_S, claim_lease, default_owner, release_lease, renew_lease
+from ..reliability.locktrace import make_lock
 
 _VERSION = 1
 
@@ -701,7 +702,7 @@ class SolutionStore:
 # ----------------------------------------------------------------- resolution
 
 _stores: dict[str, SolutionStore] = {}
-_stores_lock = threading.Lock()
+_stores_lock = make_lock('store.registry')
 
 
 def store_at(path: str | os.PathLike, **kw) -> SolutionStore:

@@ -62,6 +62,7 @@ from pathlib import Path
 from .. import telemetry
 from ..ir.comb import Pipeline
 from ..reliability.checkpoint import atomic_write_bytes
+from ..reliability.locktrace import make_lock
 from .solution_store import SolutionStore, StoreHit
 
 #: default in-proc LRU capacity (entries); DA4ML_STORE_MEM_ENTRIES overrides
@@ -103,7 +104,7 @@ class TieredStore(SolutionStore):
         )
         self.mem_entries = default_mem_entries() if mem_entries is None else int(mem_entries)
         self._mem: 'OrderedDict[str, StoreHit]' = OrderedDict()
-        self._mem_lock = threading.Lock()
+        self._mem_lock = make_lock('store.tiered.mem')
 
     # -- mem tier ------------------------------------------------------------
 

@@ -1,0 +1,212 @@
+"""The declarative metric catalog: every family this library emits.
+
+One table, three consumers — the same discipline the opcode table applies
+to the DAIS ISA and ``locktrace.LOCK_TABLE`` applies to locks:
+
+- :mod:`.obs.openmetrics` renders each family's OpenMetrics ``HELP``
+  string from the ``METRICS`` value (no second copy of the text);
+- the drift lint (:mod:`da4ml_tpu.analysis.catalogs`) AST-scans the
+  library for ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` /
+  ``timer(...)`` emission sites and fails CI when an emitted name is
+  missing here, when a catalog entry no longer has an emission site, or
+  when a catalogued family is missing from the docs/telemetry.md table;
+- dashboards read docs/telemetry.md, which the catalog keeps honest.
+
+Dynamic families (``run.mode.<mode>``, ``breaker.state.<name>``) are
+catalogued under their *folded* family name — the exposition layer folds
+the trailing component into a label (``openmetrics._LABEL_FOLD``) — and
+their construction sites are registered in ``DYNAMIC_SITES`` below, so a
+new f-string metric cannot appear without a catalog decision either.
+
+This module is import-light on purpose (stdlib only): the catalog must be
+readable by the analysis layer without pulling in the metrics runtime.
+"""
+
+from __future__ import annotations
+
+__all__ = ['DYNAMIC_SITES', 'FOLDS', 'METRICS', 'fold_family', 'help_for']
+
+#: dotted family name -> HELP text (OpenMetrics HELP + docs/telemetry.md)
+METRICS: dict[str, str] = {
+    # -- solve plane --------------------------------------------------------
+    'solve.calls': 'cmvm.api.solve invocations',
+    'solve.duration_s': 'wall clock per solve',
+    'solve.adders': 'result cost (adder count) per solve',
+    'fallback.events': 'reliability chain degradations (solve + runtime)',
+    'retry.sleeps': 'transient-error retry sleeps',
+    'retry.delay_s': 'backoff delay per retry sleep',
+    'retry.hints_honored': 'retry sleeps that honored a server Retry-After hint',
+    # -- device search ------------------------------------------------------
+    'jit.compile': 'first calls of a device compile class paying a real XLA compile',
+    'jit.compile_s': 'wall clock of first calls that paid a real XLA compile',
+    'jit.cache_load': 'first calls of a device compile class served from the persistent cache',
+    'jit.cache_load_s': 'wall clock of first calls served from the persistent cache',
+    'jit.cache_miss': 'aggregate first calls per device compile class (compile or cache load)',
+    'jit.first_call_s': 'aggregate first-call wall clock per device compile class',
+    'jit.execute_s': 'steady-state executor dispatch wall clock',
+    'jit.export_load': 'serialized executors hot-loaded from an export artifact',
+    'jit.export_save': 'compiled executors serialized to an export artifact',
+    'cache.hit_ratio': 'persistent compile cache hit ratio (jit.cache_load / first calls)',
+    'cse.device_rounds': 'greedy-CSE device calls',
+    'cse.substitutions': 'CSE substitutions materialized across lanes',
+    'search.beam_width': 'current adaptive beam width',
+    'search.lanes_expanded': 'beam lanes expanded on device',
+    'search.frontier_culled': 'frontier states culled by dominance',
+    'search.device_forks': 'beam forks dispatched to the device path',
+    'search.device_prunes': 'beam prunes decided on device',
+    'search.fork_lanes': 'lanes created by device forks',
+    'search.host_rescues': 'device-search rungs rescued by the host fallback',
+    'search.host_seeded_lanes': 'beam lanes seeded from host solutions',
+    'search.root_park_hits': 'root-parking cache hits during beam expansion',
+    'search.strict_wins': 'candidate comparisons won strictly',
+    'search.ties': 'candidate comparisons tied on cost',
+    'search.trace_records': 'search-trace records written (DA4ML_SEARCH_TRACE_DIR)',
+    'sched.rungs': 'CMVM search rungs scheduled',
+    'sched.device_resident_rungs': 'rungs kept device-resident end to end',
+    'sched.bucket_groups': 'same-shape rung groups batched into one dispatch',
+    'sched.bucket_lanes': 'lanes packed via shape-bucket batching',
+    'sched.dedup_lanes': 'duplicate lanes elided by the scheduler',
+    'sched.entry_carry_groups': 'entry-carry groups propagated across rungs',
+    'sched.fetch_bytes': 'bytes fetched from device per rung chunk',
+    'sched.upload_bytes': 'bytes uploaded to device per rung chunk',
+    'sched.device_s': 'device wall clock per CMVM search rung chunk (dispatch to fetch)',
+    'sched.hbm_bytes': 'estimated device-resident bytes per CMVM search rung chunk',
+    # -- runtime ------------------------------------------------------------
+    'run.mode': 'DAIS executors constructed per resolved execution mode',
+    'run.mode_cache_hit': 'executor constructions answered by the mode cache',
+    'run.autotune': 'autotune decisions recorded',
+    'run.samples': 'DAIS inference samples served',
+    'run.samples_per_s': 'recent DAIS inference throughput',
+    'run.batch_s': 'wall clock per inference batch',
+    'run.batch_samples': 'samples per inference batch',
+    'run.compile_s': 'runtime executor compile wall clock',
+    'run.device_s': 'device wall clock per DAIS inference batch',
+    'run.hbm_bytes': 'estimated device-resident bytes per DAIS inference batch',
+    'runtime.samples': 'samples served by the legacy runtime entry point',
+    'runtime.run_s': 'wall clock per legacy runtime batch',
+    'emit.async_batches': 'asynchronously emitted device batches',
+    'emit.async_wait_s': 'wait for async emission drains',
+    'trace.ops': 'DAIS ops traced into programs',
+    'fuse.stages': 'pipeline stages fused',
+    'fuse.seam_ops': 'seam ops eliminated by pipeline fusion',
+    'fuse.depth_before': 'pipeline depth before fusion',
+    'fuse.depth_after': 'pipeline depth after fusion',
+    # -- reliability --------------------------------------------------------
+    'breaker.state': 'circuit breaker state: 0 closed, 0.5 half-open, 1 open',
+    'breaker.transitions': 'circuit breaker state transitions',
+    'checkpoint.hits': 'campaign kernels restored from a checkpoint instead of re-solved',
+    'checkpoint.misses': 'campaign kernels absent from the checkpoint (solved fresh)',
+    'lease.claims': 'work-item leases claimed',
+    'lease.renewals': 'lease deadline extensions',
+    'lease.steals': 'expired leases stolen from dead owners',
+    'lease.lost': 'leases lost to a stealer (owner presumed dead)',
+    'locktrace.acquires': 'traced lock acquisitions (DA4ML_LOCKTRACE=1)',
+    'locktrace.edges': 'distinct held->acquired orderings in the runtime lock-order graph',
+    'locktrace.rank_inversions': 'runtime acquisitions against the declared lock-rank order',
+    'locktrace.cycles': 'cycles detected in the runtime lock-order graph',
+    'campaign.claims': 'campaign work items claimed',
+    'campaign.kernel_failures': 'campaign kernels that exhausted every backend',
+    'campaign.kernels_stolen': 'campaign kernels stolen from dead workers',
+    'campaign.done': 'campaign kernels completed',
+    'campaign.total': 'campaign kernels total',
+    'campaign.workers_alive': 'campaign workers with a live heartbeat',
+    'campaign.heartbeat_age_s': 'seconds since the last solve_many campaign heartbeat',
+    'health.status': 'aggregate health: 0 ok, 1 degraded',
+    # -- solution store -----------------------------------------------------
+    'store.hits': 'verified solution-store hits',
+    'store.misses': 'solution-store lookups that missed',
+    'store.publishes': 'solutions published to the store',
+    'store.read_errors': 'store reads that failed (unreachable/corrupt path)',
+    'store.write_errors': 'store writes that failed',
+    'store.corrupt_quarantined': 'store entries quarantined after failing verification',
+    'store.negative_hits': 'lookups answered by a live negative marker',
+    'store.negative_publishes': 'negative markers published after terminal solve failures',
+    'store.singleflight_waits': 'cold misses that waited on another solver\'s lease',
+    'store.singleflight_fallthroughs': 'waiters that solved locally to honor a deadline',
+    'store.gc_evictions': 'store entries evicted by gc',
+    'store.lookup_s': 'wall clock per store lookup',
+    'store.tier.mem_hits': 'solution lookups served from the in-process LRU tier',
+    'store.tier.local_hits': 'solution lookups served from the local-disk tier',
+    'store.tier.shared_hits': 'solution lookups served from the shared-FS tier',
+    'store.tier.misses': 'solution lookups that missed every cache tier',
+    'store.tier.promotes_mem': 'entries promoted into the in-process LRU tier',
+    'store.tier.promotes_local': 'shared-tier entries promoted to the local-disk tier',
+    'store.tier.writethroughs': 'published solutions written through to the local tier',
+    'store.tier.mem_evictions': 'entries evicted from the in-process LRU tier',
+    'serve.solve_requests': 'solve requests admitted by the solve service',
+    'serve.solve_shed': 'solve requests shed by admission control',
+    'serve.solve_expired': 'solve requests whose deadline expired before dispatch',
+    'serve.solve_hits': 'solve-service answers served from the store',
+    'serve.solve_misses': 'solve-service answers that ran a cold solve',
+    # -- serve plane --------------------------------------------------------
+    'serve.requests': 'inference requests admitted to a serve queue',
+    'serve.samples': 'inference sample rows served',
+    'serve.shed': 'requests shed by admission control (HTTP 429)',
+    'serve.deadline_miss': 'requests whose deadline expired while queued (rejected before dispatch)',
+    'serve.batches': 'coalesced device batches dispatched by the serve plane',
+    'serve.batch_rows': 'rows per coalesced serve batch',
+    'serve.batch_fill': 'serve batch fill ratio (rows dispatched / row budget)',
+    'serve.latency_s': 'request latency: admission to resolution',
+    'serve.queue_wait_s': 'request queue wait before its batch dispatched',
+    'serve.queue_depth': 'admission queue depth in rows (last served model)',
+    'serve.queue_age_s': 'age of the oldest queued serve request',
+    'serve.degraded': 'serve batches answered by the bit-exact fallback chain',
+    'serve.dispatch_failures': 'device dispatch failures absorbed by the serve envelope',
+    'serve.shape_miss': 'serve batches whose padded shape was not prewarmed (new XLA compile)',
+    'serve.shape_hit': 'serve batches landing on a prewarmed canonical shape',
+    'serve.hedge_fired': 'straggler hedges launched against slow device batches',
+    'serve.hedge_won': 'hedged batches answered by the fallback chain first',
+    'serve.reloads': 'hot executor reloads',
+    'serve.executor_evictions': 'compiled executors evicted from the LRU serve cache',
+    'serve.exports': 'serving artifacts exported',
+    'router.requests': 'client requests proxied by the replica router',
+    'router.samples': 'inference sample rows answered through the router',
+    'router.hedges_fired': 'hedge legs launched against slow replicas',
+    'router.hedges_won': 'requests answered by the hedge leg first',
+    'router.hedge_cancelled': 'loser legs torn down after a definitive answer',
+    'router.retries': 'retry legs after a retryable replica outcome',
+    'router.leg_failures': 'transport-level leg failures (replica died mid-request)',
+    'router.no_replica': 'requests rejected because no replica was routable',
+    'router.probes': 'active /healthz probe rounds',
+    'fleet.spawns': 'replica subprocesses spawned by the fleet driver',
+    'fleet.restarts': 'crashed replicas restarted with backoff',
+    'fleet.kills': 'replicas signalled by the chaos drill',
+    'fleet.announcements': 'replica registry slots claimed (lease + URL sidecar)',
+    'fleet.announcements_lost': 'replica slots stolen while presumed dead',
+    # -- warmup -------------------------------------------------------------
+    'warmup.grid_s': 'wall clock per canonical-grid warmup shape',
+    'warmup.compile_s': 'wall clock per warmup compile',
+}
+
+#: label-folded family prefixes: a literal ``<prefix><variant>`` emission
+#: (e.g. ``run.mode.fused_ir``) belongs to the ``<family>`` catalog entry;
+#: the OpenMetrics encoder folds the variant into a label the same way.
+FOLDS: dict[str, str] = {
+    'breaker.state.': 'breaker.state',
+    'run.mode.': 'run.mode',
+}
+
+
+def fold_family(name: str) -> str:
+    """The catalog family a metric name belongs to (identity when unfolded)."""
+    for prefix, family in FOLDS.items():
+        if name.startswith(prefix):
+            return family
+    return name
+
+#: registered dynamic emission sites: module (repo-relative) -> folded
+#: family names its f-string metrics resolve to. The drift lint rejects
+#: any non-literal ``counter(f'...')`` call outside this table.
+DYNAMIC_SITES: dict[str, tuple[str, ...]] = {
+    'da4ml_tpu/runtime/jax_backend.py': ('run.mode',),
+    'da4ml_tpu/reliability/breaker.py': ('breaker.state',),
+    'da4ml_tpu/telemetry/obs/health.py': ('breaker.state',),
+    'da4ml_tpu/cmvm/jax_search.py': ('jit.compile', 'jit.compile_s', 'jit.cache_load', 'jit.cache_load_s'),
+    'da4ml_tpu/store/service.py': ('serve.solve_hits', 'serve.solve_misses'),
+}
+
+
+def help_for(family: str) -> str:
+    """HELP text for a (folded) family; generic pointer when uncatalogued
+    (the drift lint keeps this branch unreachable for library metrics)."""
+    return METRICS.get(family, f'da4ml_tpu metric {family} (docs/telemetry.md)')

@@ -157,6 +157,22 @@ def _campaign_check(snap: dict) -> dict:
     return out
 
 
+def _locktrace_status() -> dict | None:
+    """Runtime lock-order tracer counters + violations (``/statusz``), when
+    ``DA4ML_LOCKTRACE=1`` armed it. Resolved via ``sys.modules`` — the
+    tracer module is always loaded (locks are built through it), so gate on
+    its armed flag instead to keep unarmed scrapes silent."""
+    mod = sys.modules.get('da4ml_tpu.reliability.locktrace')
+    if mod is None or not mod.locktrace_enabled():
+        return None
+    try:
+        out = dict(mod.locktrace_counters())
+        out['violations'] = mod.locktrace_violations()
+        return out
+    except Exception:  # pragma: no cover - never fail a scrape
+        return None
+
+
 def _cache_check(snap: dict) -> dict:
     compiles = _metric_value(snap, 'jit.compile') or 0.0
     loads = _metric_value(snap, 'jit.cache_load') or 0.0
@@ -184,6 +200,12 @@ def refresh_computed_gauges() -> None:
     age = core.beat_age_s('campaign')
     if age is not None:
         gauge('campaign.heartbeat_age_s').set(round(age, 6))
+    lock = _locktrace_status()
+    if lock is not None:
+        gauge('locktrace.acquires').set(float(lock.get('acquires', 0)))
+        gauge('locktrace.edges').set(float(lock.get('edges', 0)))
+        gauge('locktrace.rank_inversions').set(float(lock.get('rank_inversions', 0)))
+        gauge('locktrace.cycles').set(float(lock.get('cycles', 0)))
     snap = metrics_snapshot()
     ratio = _cache_check(snap)['hit_ratio']
     if ratio is not None:
@@ -321,6 +343,7 @@ def status_snapshot() -> dict:
         'store': _store_status(),
         'router': _router_status(),
         'fleet': _fleet_status(),
+        'locktrace': _locktrace_status(),
         'deadline_workers': deadline_workers,
         'devices': _device_inventory(),
     }

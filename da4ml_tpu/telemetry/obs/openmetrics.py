@@ -21,78 +21,14 @@ from __future__ import annotations
 
 import re
 
+from ..catalog import FOLDS, help_for
+
 #: ``dotted-prefix -> (family, label key)``: trailing name component
-#: becomes a label value instead of a per-instance metric family
-_LABEL_FOLD = {
-    'breaker.state.': ('breaker.state', 'breaker'),
-    'run.mode.': ('run.mode', 'mode'),
-}
-
-#: HELP strings for the families a dashboard will reach for first; any
-#: metric not listed gets a generic pointer at the docs catalog
-_HELP = {
-    'solve.calls': 'cmvm.api.solve invocations',
-    'solve.duration_s': 'wall clock per solve',
-    'solve.adders': 'result cost (adder count) per solve',
-    'jit.compile': 'first calls of a device compile class paying a real XLA compile',
-    'jit.cache_load': 'first calls of a device compile class served from the persistent cache',
-    'cse.device_rounds': 'greedy-CSE device calls',
-    'cse.substitutions': 'CSE substitutions materialized across lanes',
-    'sched.device_s': 'device wall clock per CMVM search rung chunk (dispatch to fetch)',
-    'sched.hbm_bytes': 'estimated device-resident bytes per CMVM search rung chunk',
-    'run.device_s': 'device wall clock per DAIS inference batch',
-    'run.hbm_bytes': 'estimated device-resident bytes per DAIS inference batch',
-    'run.samples': 'DAIS inference samples served',
-    'breaker.state': 'circuit breaker state: 0 closed, 0.5 half-open, 1 open',
-    'run.mode': 'DAIS executors constructed per resolved execution mode',
-    'campaign.heartbeat_age_s': 'seconds since the last solve_many campaign heartbeat',
-    'cache.hit_ratio': 'persistent compile cache hit ratio (jit.cache_load / first calls)',
-    'health.status': 'aggregate health: 0 ok, 1 degraded',
-    'fallback.events': 'reliability chain degradations (solve + runtime)',
-    'checkpoint.hits': 'campaign kernels restored from a checkpoint instead of re-solved',
-    'serve.requests': 'inference requests admitted to a serve queue',
-    'serve.samples': 'inference sample rows served',
-    'serve.shed': 'requests shed by admission control (HTTP 429)',
-    'serve.deadline_miss': 'requests whose deadline expired while queued (rejected before dispatch)',
-    'serve.batches': 'coalesced device batches dispatched by the serve plane',
-    'serve.batch_rows': 'rows per coalesced serve batch',
-    'serve.batch_fill': 'serve batch fill ratio (rows dispatched / row budget)',
-    'serve.latency_s': 'request latency: admission to resolution',
-    'serve.queue_wait_s': 'request queue wait before its batch dispatched',
-    'serve.queue_depth': 'admission queue depth in rows (last served model)',
-    'serve.queue_age_s': 'age of the oldest queued serve request',
-    'serve.degraded': 'serve batches answered by the bit-exact fallback chain',
-    'serve.dispatch_failures': 'device dispatch failures absorbed by the serve envelope',
-    'serve.shape_miss': 'serve batches whose padded shape was not prewarmed (new XLA compile)',
-    'serve.shape_hit': 'serve batches landing on a prewarmed canonical shape',
-    'serve.hedge_fired': 'straggler hedges launched against slow device batches',
-    'serve.hedge_won': 'hedged batches answered by the fallback chain first',
-    'serve.reloads': 'hot executor reloads',
-    'serve.executor_evictions': 'compiled executors evicted from the LRU serve cache',
-    'router.requests': 'client requests proxied by the replica router',
-    'router.samples': 'inference sample rows answered through the router',
-    'router.hedges_fired': 'hedge legs launched against slow replicas',
-    'router.hedges_won': 'requests answered by the hedge leg first',
-    'router.hedge_cancelled': 'loser legs torn down after a definitive answer',
-    'router.retries': 'retry legs after a retryable replica outcome',
-    'router.leg_failures': 'transport-level leg failures (replica died mid-request)',
-    'router.no_replica': 'requests rejected because no replica was routable',
-    'router.probes': 'active /healthz probe rounds',
-    'fleet.spawns': 'replica subprocesses spawned by the fleet driver',
-    'fleet.restarts': 'crashed replicas restarted with backoff',
-    'fleet.kills': 'replicas signalled by the chaos drill',
-    'fleet.announcements': 'replica registry slots claimed (lease + URL sidecar)',
-    'fleet.announcements_lost': 'replica slots stolen while presumed dead',
-    'store.tier.mem_hits': 'solution lookups served from the in-process LRU tier',
-    'store.tier.local_hits': 'solution lookups served from the local-disk tier',
-    'store.tier.shared_hits': 'solution lookups served from the shared-FS tier',
-    'store.tier.misses': 'solution lookups that missed every cache tier',
-    'store.tier.promotes_local': 'shared-tier entries promoted to the local-disk tier',
-    'store.tier.writethroughs': 'published solutions written through to the local tier',
-    'store.tier.mem_evictions': 'entries evicted from the in-process LRU tier',
-    'retry.hints_honored': 'retry sleeps that honored a server Retry-After hint',
-}
-
+#: becomes a label value instead of a per-instance metric family. Families
+#: come from the shared catalog (``telemetry.catalog.FOLDS``) so the
+#: encoder and the drift lint fold identically; only the label key is ours.
+_FOLD_LABEL_KEYS = {'breaker.state': 'breaker', 'run.mode': 'mode'}
+_LABEL_FOLD = {prefix: (family, _FOLD_LABEL_KEYS[family]) for prefix, family in FOLDS.items()}
 
 def _family_name(dotted: str) -> str:
     """Dotted catalog name -> OpenMetrics family name (no type suffix)."""
@@ -168,7 +104,7 @@ def render_openmetrics(snapshot: dict | None = None) -> str:
     for fam_dotted, fam in sorted(families.items()):
         name = _family_name(fam_dotted)
         kind = fam['type']
-        help_text = _HELP.get(fam_dotted, f'da4ml_tpu metric {fam_dotted} (docs/telemetry.md)')
+        help_text = help_for(fam_dotted)  # telemetry.catalog.METRICS, drift-linted
         lines.append(f'# HELP {name} {_escape_help(help_text)}')
         lines.append(f'# TYPE {name} {kind}')
         for labels, m in fam['samples']:

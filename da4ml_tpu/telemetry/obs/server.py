@@ -21,6 +21,7 @@ streaming trace instead of this process's registry.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -31,6 +32,7 @@ from .openmetrics import CONTENT_TYPE, render_openmetrics
 
 _lock = threading.Lock()
 _server: 'ObsServer | None' = None
+_atexit_registered = False
 
 
 class ObsServer:
@@ -131,7 +133,7 @@ def serve(
     ``server.port``). Enables the metrics registry — a live endpoint with
     an empty registry would be useless.
     """
-    global _server
+    global _server, _atexit_registered
     from ..metrics import enable_metrics
 
     with _lock:
@@ -150,6 +152,13 @@ def serve(
             health_provider=health_provider,
             status_provider=status_provider,
         )
+        if not _atexit_registered:
+            # drain the serving socket at interpreter exit instead of
+            # abandoning the daemon thread mid-write; _stop_at_exit checks
+            # the owning pid, so a forked child never closes its parent's
+            # socket (THREAD_TABLE['da4ml-obs-server'])
+            atexit.register(_stop_at_exit)
+            _atexit_registered = True
         return _server
 
 
@@ -160,10 +169,18 @@ def server_port() -> int | None:
 
 
 def stop_server() -> None:
-    """Shut the endpoint down (test isolation; production servers live for
-    the process)."""
+    """Shut the endpoint down (test isolation; production servers live
+    until interpreter exit, where the atexit hook drains them)."""
     global _server
     with _lock:
         s, _server = _server, None
     if s is not None:
         s.close()
+
+
+def _stop_at_exit() -> None:
+    """atexit hook: close this process's server only (fork-safe — a child
+    inherits ``_server`` but must not shut down the parent's socket)."""
+    s = _server
+    if s is not None and s._pid == os.getpid():
+        stop_server()

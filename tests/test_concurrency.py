@@ -1,0 +1,235 @@
+"""Concurrency soundness plane: static lock/thread lint, the runtime
+lock-order tracer, and the knob/metric catalog drift gates."""
+
+import pytest
+
+from da4ml_tpu._cli import main as cli_main
+from da4ml_tpu.analysis.catalogs import (
+    KNOBS,
+    lint_catalogs,
+    lint_knobs,
+    lint_metrics,
+    render_knob_table,
+    scan_metrics,
+)
+from da4ml_tpu.analysis.concurrency import _scan_source, lint_concurrency
+from da4ml_tpu.reliability import locktrace
+from da4ml_tpu.reliability.locktrace import THREAD_TABLE, ThreadSpec
+
+
+@pytest.fixture
+def tracer():
+    """Armed, clean lock tracer; restores the prior armed state."""
+    was = locktrace.locktrace_enabled()
+    locktrace.enable_locktrace()
+    locktrace.reset_locktrace()
+    yield locktrace
+    locktrace.reset_locktrace()
+    if not was:
+        locktrace.disable_locktrace()
+
+
+def _rules(result):
+    return [d.rule for d in result.diagnostics]
+
+
+# -- static lint -------------------------------------------------------------
+
+
+def test_repo_is_clean():
+    result = lint_concurrency()
+    assert result.ok, result.format_text()
+
+
+def test_raw_lock_construction_flagged():
+    s = _scan_source('da4ml_tpu/serve/engine.py', 'import threading\n_lock = threading.Lock()\n')
+    assert any(d.rule == 'X501' for d in s.diags)
+
+
+def test_unregistered_make_lock_name_flagged():
+    s = _scan_source('da4ml_tpu/serve/engine.py', "from ..reliability.locktrace import make_lock\n_l = make_lock('no.such.lock')\n")
+    assert any(d.rule == 'X501' and 'no.such.lock' in d.message for d in s.diags)
+
+
+def test_make_lock_outside_owning_module_flagged():
+    s = _scan_source('da4ml_tpu/serve/engine.py', "_l = make_lock('serve.queue')\n")
+    assert any(d.rule == 'X501' and 'serve.queue' in d.message for d in s.diags)
+
+
+def test_lexical_rank_inversion_flagged():
+    # breaker.py owns the registry lock (rank 60) and the instance lock
+    # (rank 65): acquiring the registry inside the instance descends rank
+    src = 'def f(self):\n    with self._lock:\n        with _registry_lock:\n            pass\n'
+    s = _scan_source('da4ml_tpu/reliability/breaker.py', src)
+    assert any(d.rule == 'X503' for d in s.diags)
+    ascending = 'def f(self):\n    with _registry_lock:\n        with self._lock:\n            pass\n'
+    assert not _scan_source('da4ml_tpu/reliability/breaker.py', ascending).diags
+
+
+def test_io_under_lock_flagged():
+    src = 'import time\n\ndef f(self):\n    with self._lock:\n        time.sleep(1.0)\n'
+    s = _scan_source('da4ml_tpu/reliability/breaker.py', src)
+    assert any(d.rule == 'X504' for d in s.diags)
+    # serve.fleet.slots declares io_ok: the same shape passes there
+    assert not any(
+        d.rule == 'X504' for d in _scan_source('da4ml_tpu/serve/fleet.py', src).diags
+    )
+
+
+def test_unnamed_thread_flagged():
+    s = _scan_source('da4ml_tpu/serve/engine.py', 'import threading\nt = threading.Thread(target=print)\n')
+    assert any(d.rule == 'X505' for d in s.diags)
+
+
+def test_unknown_thread_prefix_flagged():
+    src = "import threading\nt = threading.Thread(target=print, name='rogue-worker-1')\n"
+    s = _scan_source('da4ml_tpu/serve/engine.py', src)
+    assert any(d.rule == 'X505' and 'rogue-worker' in d.message for d in s.diags)
+
+
+def test_daemon_thread_without_shutdown_flagged():
+    THREAD_TABLE['da4ml-x507fixture-'] = ThreadSpec('da4ml-x507fixture-', 'da4ml_tpu/foo.py', '', 'fixture')
+    try:
+        src = "import threading\nt = threading.Thread(target=print, name='da4ml-x507fixture-0', daemon=True)\n"
+        s = _scan_source('da4ml_tpu/foo.py', src)
+        assert any(d.rule == 'X507' for d in s.diags)
+    finally:
+        del THREAD_TABLE['da4ml-x507fixture-']
+
+
+# -- runtime tracer ----------------------------------------------------------
+
+
+def test_make_lock_rejects_unregistered_name():
+    with pytest.raises(KeyError):
+        locktrace.make_lock('definitely.not.registered')
+
+
+def test_injected_rank_inversion_caught(tracer):
+    low = tracer.make_lock('reliability.breaker.registry')  # rank 60
+    high = tracer.make_lock('reliability.breaker.instance')  # rank 65
+    with high:
+        with low:  # descends 65 -> 60
+            pass
+    violations = tracer.locktrace_violations()
+    assert any(v['rule'] == 'X511' for v in violations), violations
+    diags = tracer.locktrace_diagnostics()
+    assert any(d.rule == 'X511' for d in diags)
+    assert tracer.locktrace_counters()['rank_inversions'] >= 1
+
+
+def test_injected_order_cycle_caught(tracer):
+    a = tracer.make_lock('reliability.breaker.registry')
+    b = tracer.make_lock('reliability.breaker.instance')
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # closes the a->b->a cycle
+            pass
+    assert any(v['rule'] == 'X510' for v in tracer.locktrace_violations())
+    assert tracer.locktrace_counters()['cycles'] >= 1
+
+
+def test_clean_nesting_records_no_violations(tracer):
+    a = tracer.make_lock('reliability.breaker.registry')
+    b = tracer.make_lock('reliability.breaker.instance')
+    with a:
+        with b:
+            pass
+    assert tracer.locktrace_violations() == []
+    counters = tracer.locktrace_counters()
+    assert counters['acquires'] >= 2 and counters['edges'] >= 1
+
+
+def test_locktrace_report_feeds_statusz(tracer):
+    from da4ml_tpu.telemetry.obs.health import status_snapshot
+
+    with tracer.make_lock('reliability.breaker.registry'):
+        pass
+    section = status_snapshot()['locktrace']
+    assert section is not None and section['acquires'] >= 1
+    assert section['violations'] == []
+
+
+# -- catalog drift gates -----------------------------------------------------
+
+
+def test_catalogs_are_clean():
+    result = lint_catalogs()
+    assert result.ok, result.format_text()
+
+
+def test_undocumented_knob_flagged(tmp_path):
+    pkg = tmp_path / 'da4ml_tpu'
+    pkg.mkdir()
+    (pkg / 'mod.py').write_text("import os\nX = os.environ.get('DA4ML_BOGUS_FIXTURE')\n")
+    result = lint_knobs(pkg)
+    assert any(d.rule == 'X524' and 'DA4ML_BOGUS_FIXTURE' in d.message for d in result.diagnostics)
+    # every real knob is absent from the fixture tree -> stale
+    assert any(d.rule == 'X525' and 'DA4ML_LOCKTRACE' in d.message for d in result.diagnostics)
+
+
+def test_undocumented_metric_flagged(tmp_path):
+    pkg = tmp_path / 'da4ml_tpu'
+    pkg.mkdir()
+    (pkg / 'mod.py').write_text("from . import telemetry\ntelemetry.counter('not.in.catalog').inc()\n")
+    result = lint_metrics(pkg, docs_root=tmp_path)
+    assert any(d.rule == 'X520' and 'not.in.catalog' in d.message for d in result.diagnostics)
+
+
+def test_unregistered_dynamic_metric_flagged(tmp_path):
+    pkg = tmp_path / 'da4ml_tpu'
+    pkg.mkdir()
+    (pkg / 'mod.py').write_text("from . import telemetry\ntelemetry.counter(f'thing.{x}').inc()\n")
+    result = lint_metrics(pkg, docs_root=tmp_path)
+    assert any(d.rule == 'X522' for d in result.diagnostics)
+
+
+def test_conditional_metric_names_are_scanned():
+    # counter('a' if p else 'b') must contribute BOTH literals, not slip
+    # through as unscannable (the store.hits/store.misses emission shape)
+    literal, _ = scan_metrics()
+    assert 'store.misses' in literal and 'store.hits' in literal
+
+
+def test_metric_fold_maps_variants_to_family():
+    from da4ml_tpu.telemetry.catalog import METRICS, fold_family
+
+    assert fold_family('run.mode.fused_ir') == 'run.mode'
+    assert fold_family('breaker.state.cmvm.jax') == 'breaker.state'
+    assert fold_family('serve.requests') == 'serve.requests'
+    assert 'run.mode' in METRICS and 'breaker.state' in METRICS
+
+
+def test_openmetrics_help_comes_from_catalog():
+    from da4ml_tpu.telemetry.catalog import METRICS
+    from da4ml_tpu.telemetry.obs.openmetrics import render_openmetrics, validate_openmetrics
+
+    text = render_openmetrics({'solve.calls': {'type': 'counter', 'value': 3.0}})
+    validate_openmetrics(text)
+    assert f'# HELP da4ml_solve_calls {METRICS["solve.calls"]}' in text
+
+
+def test_knob_table_renders_every_knob():
+    table = render_knob_table()
+    for name in KNOBS:
+        assert f'`{name}`' in table
+    assert table.count('\n') == len(KNOBS) + 1  # header + separator
+
+
+def test_docgen_sections_in_sync():
+    from da4ml_tpu.analysis.docgen import apply
+
+    assert apply(check=True) == []
+
+
+def test_cli_verify_concurrency(capsys):
+    assert cli_main(['verify', '--concurrency']) == 0
+    out = capsys.readouterr().out
+    assert 'concurrency: ok' in out
+    assert cli_main(['verify', '--concurrency', '--json']) == 0
+    import json
+
+    report = json.loads(capsys.readouterr().out)
+    assert report['ok'] is True and 'locktrace' in report
