@@ -18,6 +18,9 @@ src/da4ml/_cli/__init__.py:8-27):
 - ``stats`` — summarize a telemetry trace captured with ``--trace`` /
   ``DA4ML_TRACE`` (docs/telemetry.md); ``--follow`` tails a streaming
   JSONL trace live;
+- ``trace-view`` — merge N per-process JSONL traces (a fleet's replicas +
+  router) into one clock-aligned Perfetto timeline, with a per-trace-id
+  multiprocess gate (docs/observability.md#fleet-tracing);
 - ``monitor`` — serve the live ``/metrics`` / ``/healthz`` / ``/statusz``
   endpoints, optionally mirroring a followed trace
   (docs/observability.md);
@@ -86,6 +89,12 @@ def main(argv: list[str] | None = None) -> int:
     p_stats = sub.add_parser('stats', help='Summarize a telemetry trace captured with --trace / DA4ML_TRACE')
     add_stats_args(p_stats)
     p_stats.set_defaults(func=stats_main)
+
+    from .trace_view import add_trace_view_args, trace_view_main
+
+    p_tv = sub.add_parser('trace-view', help='Merge per-process JSONL traces into one Perfetto fleet timeline')
+    add_trace_view_args(p_tv)
+    p_tv.set_defaults(func=trace_view_main)
 
     from .monitor import add_monitor_args, monitor_main
 

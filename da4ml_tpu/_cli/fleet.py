@@ -38,6 +38,11 @@ def add_fleet_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument('--max-attempts', type=int, default=3, help='Max legs (primary + hedge/retries) per request')
     parser.add_argument('--duration', type=float, default=0.0, help='Run for N seconds then stop (0 = until signal)')
     parser.add_argument('--status', action='store_true', help='Print the live replica set of --fleet-dir and exit')
+    parser.add_argument(
+        '--trace',
+        action='store_true',
+        help='Arm per-replica JSONL tracing under <fleet-dir>/traces; with --chaos the drill merges one fleet timeline',
+    )
     parser.add_argument('--chaos', action='store_true', help='Run the fleet SIGKILL+reload chaos drill and exit')
     parser.add_argument('--drill-duration', type=float, default=10.0, help='--chaos: sustained load duration (s)')
     parser.add_argument('--json', action='store_true', dest='as_json', help='--chaos: print the full report as JSON')
@@ -67,6 +72,7 @@ def fleet_main(args: argparse.Namespace) -> int:
             duration_s=args.drill_duration,
             hedge_ms=args.hedge_ms,
             fleet_dir=args.fleet_dir,
+            trace=args.trace,
         )
         log.info(json.dumps(report if args.as_json else report['checks'], indent=1, default=str))
         if args.out is not None:
@@ -87,6 +93,11 @@ def fleet_main(args: argparse.Namespace) -> int:
         model_name=args.model_name,
         shared_store=args.store,
     )
+    if args.trace:
+        # resolved after construction: Fleet picks a tmp fleet_dir when none
+        # was given, and the traces ride inside it either way
+        fleet.trace_dir = fleet.fleet_dir / 'traces'
+        fleet.trace_dir.mkdir(parents=True, exist_ok=True)
     fleet.start()
     try:
         live = fleet.wait_ready(timeout_s=120.0)

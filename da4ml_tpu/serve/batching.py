@@ -110,9 +110,23 @@ class ModelNotFound(ServeRejected):
 
 class InferRequest:
     """One admitted inference request: a block of sample rows plus its
-    deadline, resolved to either a result batch or a structured error."""
+    deadline, resolved to either a result batch or a structured error.
 
-    __slots__ = ('id', 'x', 'n_rows', 'deadline', 't_enq', 't_done', 'served_by', '_done', '_result', '_error')
+    Carries the request's distributed-trace context (``trace_id`` /
+    ``parent_span_id``, adopted by the HTTP layer from an incoming
+    ``traceparent`` header) and the waterfall timestamps the batcher stamps
+    as the request moves through the pipeline: ``t_enq`` (admission),
+    ``t_open`` (its batch's coalescing window opened), ``t_deq`` (batch
+    closed), ``t_exec0``/``t_exec1`` (device dispatch bracket), ``t_done``
+    (result serialized back). :meth:`segments` folds them into the
+    queue/coalesce/dispatch/execute/serialize waterfall surfaced as the
+    access-log record and the ``Server-Timing`` header.
+    """
+
+    __slots__ = (
+        'id', 'x', 'n_rows', 'deadline', 't_enq', 't_open', 't_deq', 't_exec0', 't_exec1', 't_done',
+        'batch_rows', 'trace_id', 'parent_span_id', 'served_by', '_done', '_result', '_error',
+    )  # fmt: skip
 
     def __init__(self, x: NDArray[np.float64], deadline_s: float | None):
         self.id = next(_req_ids)
@@ -120,7 +134,14 @@ class InferRequest:
         self.n_rows = int(x.shape[0])
         now = time.monotonic()
         self.t_enq = now
+        self.t_open: float | None = None
+        self.t_deq: float | None = None
+        self.t_exec0: float | None = None
+        self.t_exec1: float | None = None
         self.t_done: float | None = None
+        self.batch_rows: int | None = None
+        self.trace_id: str | None = None
+        self.parent_span_id: int | None = None
         self.deadline = now + deadline_s if deadline_s is not None and deadline_s > 0 else None
         self.served_by: str | None = None
         self._done = threading.Event()
@@ -165,6 +186,26 @@ class InferRequest:
     def wait_s(self) -> float:
         """Queue wait + service time (enqueue -> resolution)."""
         return (self.t_done if self.t_done is not None else time.monotonic()) - self.t_enq
+
+    def segments(self) -> dict[str, float]:
+        """The per-request waterfall as ``{segment: seconds}`` — only the
+        segments whose bracketing timestamps were stamped. ``queue`` is
+        admission -> batch close, ``coalesce`` the share of that spent in
+        the open coalescing window, ``dispatch`` batch close -> device
+        call, ``execute`` the device call, ``serialize`` device return ->
+        result handed back."""
+        segs: dict[str, float] = {}
+        if self.t_deq is not None:
+            segs['queue'] = max(self.t_deq - self.t_enq, 0.0)
+            if self.t_open is not None:
+                segs['coalesce'] = max(self.t_deq - max(self.t_open, self.t_enq), 0.0)
+        if self.t_exec0 is not None and self.t_deq is not None:
+            segs['dispatch'] = max(self.t_exec0 - self.t_deq, 0.0)
+        if self.t_exec1 is not None and self.t_exec0 is not None:
+            segs['execute'] = max(self.t_exec1 - self.t_exec0, 0.0)
+        if self.t_done is not None and self.t_exec1 is not None:
+            segs['serialize'] = max(self.t_done - self.t_exec1, 0.0)
+        return segs
 
 
 class AdmissionQueue:
@@ -305,6 +346,12 @@ class AdmissionQueue:
                 if remaining <= 0:
                     break
                 self._cond.wait(min(remaining, poll_s))
+        t_deq = time.monotonic()
+        rows_total = sum(r.n_rows for r in batch)
+        for r in batch:
+            r.t_open = t_open
+            r.t_deq = t_deq
+            r.batch_rows = rows_total
         return batch
 
     # -- introspection -------------------------------------------------------

@@ -220,6 +220,32 @@ def _solve_on(url: str, kernel, timeout_s: float = 120.0) -> dict:
     return _post_json(url, '/v1/solve', {'kernel': np.asarray(kernel).tolist(), 'pipeline': False}, timeout_s)
 
 
+def _merge_drill_traces(trace_root) -> dict:
+    """Merge the drill's per-process JSONL traces into one Perfetto
+    timeline (``merged.json``) and summarize the cross-process stitching."""
+    from ..telemetry.obs.collect import merge_traces, write_merged
+
+    paths = sorted(p for p in trace_root.glob('*.jsonl'))
+    if not paths:
+        return {'n_files': 0, 'max_processes_per_trace': 0}
+    try:
+        report = merge_traces(paths)
+    except Exception as e:  # noqa: BLE001 - a bad trace must not fail the drill harder than its gate
+        return {'n_files': len(paths), 'max_processes_per_trace': 0, 'error': f'{type(e).__name__}: {e}'}
+    out = trace_root / 'merged.json'
+    write_merged(report, out)
+    multi = sum(1 for t in report['traces'].values() if len(t['pids']) >= 2)
+    return {
+        'n_files': len(paths),
+        'path': str(out),
+        'n_events': report['n_events'],
+        'n_traces': len(report['traces']),
+        'n_traces_multiprocess': multi,
+        'max_processes_per_trace': report['max_processes_per_trace'],
+        'sources': report['sources'],
+    }
+
+
 def fleet_chaos_drill(
     *,
     replicas: int = 4,
@@ -230,8 +256,16 @@ def fleet_chaos_drill(
     fleet_dir: str | None = None,
     p99_budget_ms: float = 400.0,
     speedup_floor: float = 10.0,
+    trace: bool = False,
 ) -> dict:
     """Run the replica-fleet kill + reload drill; returns a gateable report.
+
+    With ``trace=True`` every replica streams a JSONL trace (per-incarnation
+    files under ``<fleet_dir>/traces/``), the router process streams its
+    own, and after the drill the collector merges them into one Perfetto
+    timeline (``<fleet_dir>/traces/merged.json``) — the report gains a
+    ``trace`` section and a ``trace_multiprocess`` check asserting at least
+    one trace id carries spans from >= 3 distinct processes.
 
     Spawns ``replicas`` (floored at 4 — the drill assigns distinct roles)
     serve subprocesses over a freshly exported artifact and one shared
@@ -273,12 +307,24 @@ def fleet_chaos_drill(
     rng = np.random.default_rng(11)
     solve_kernel = rng.integers(-8, 8, (6, 4)).astype(np.float64)
 
+    trace_root = root / 'traces' if trace else None
+    router_sink = None
+    if trace_root is not None:
+        trace_root.mkdir(parents=True, exist_ok=True)
+        # the drill process hosts the router: stream its spans alongside the
+        # replicas' so the merged timeline shows the hedge race end to end
+        from ..telemetry.export import sink_for
+
+        router_sink = sink_for(trace_root / 'router.jsonl')
+        telemetry.add_sink(router_sink)
+
     fleet = Fleet(
         artifact,
         replicas=n,
         fleet_dir=root / 'fleet',
         model_name='default',
         shared_store=root / 'store',
+        trace_dir=trace_root,
         # host-side solves + a widened coalescing window: a single-stream
         # client pays the full window per request while concurrent load
         # amortizes it across the batch — the amortization the fleet
@@ -390,6 +436,12 @@ def fleet_chaos_drill(
         if server is not None:
             server.close()
         fleet.stop()
+        if router_sink is not None:
+            telemetry.remove_sink(router_sink)
+            try:
+                router_sink.close()
+            except Exception:
+                pass
 
     load = report_box.get('load', {})
     single = phases.get('baseline', {}).get('single_stream_samples_per_s') or 0.0
@@ -416,8 +468,13 @@ def fleet_chaos_drill(
         'reloaded_under_load': phases.get('reload', {}).get('new_version', 0) >= 2,
         'all_replicas_announced_at_end': fleet_at_end['n_announced'] >= n,
     }
+    trace_section = None
+    if trace_root is not None:
+        trace_section = _merge_drill_traces(trace_root)
+        checks['trace_multiprocess'] = trace_section.get('max_processes_per_trace', 0) >= 3
     return {
         'ok': all(checks.values()),
+        'trace': trace_section,
         'load': load,
         'speedup_vs_single_stream': speedup,
         'speedup_floor': speedup_floor,

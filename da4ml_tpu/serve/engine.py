@@ -373,6 +373,13 @@ class ServeEngine:
         if deadline_s is None and self.config.default_deadline_ms is not None:
             deadline_s = self.config.default_deadline_ms / 1e3
         req = InferRequest(x, deadline_s)
+        tb = telemetry.current_trace()
+        if tb is not None:
+            # adopt the submitting thread's trace context: the batcher
+            # thread emits this request's waterfall under it
+            req.trace_id = tb[0]
+            cur = telemetry.current_span()
+            req.parent_span_id = cur.span_id if cur is not None else tb[1]
         try:
             state.queue.push(req, rate_rows_s=self._service_rate(state))
         except ServeRejected:
@@ -544,6 +551,7 @@ class ServeEngine:
         rows = sum(r.n_rows for r in live)
         x = np.concatenate([r.x for r in live], axis=0) if len(live) > 1 else live[0].x
         t0 = time.perf_counter()
+        t_exec0 = time.monotonic()
         with telemetry.span('serve.batch', model=state.name, rows=rows, requests=len(live)) as sp:
             try:
                 y, served_by = self._dispatch(state, x)
@@ -560,12 +568,24 @@ class ServeEngine:
                 return
             sp.set(outcome=served_by)
         dt = time.perf_counter() - t0
+        t_exec1 = time.monotonic()
+        trace_on = telemetry.tracing_active()
+        waterfall_on = trace_on or telemetry.metrics_on()
         off = 0
         for r in live:
+            r.t_exec0 = t_exec0
+            r.t_exec1 = t_exec1
             r.set_result(y[off : off + r.n_rows], served_by)
             off += r.n_rows
-            telemetry.histogram('serve.latency_s').observe(r.wait_s())
+            telemetry.histogram('serve.latency_s').observe(r.wait_s(), trace_id=r.trace_id)
             telemetry.histogram('serve.queue_wait_s').observe(max(r.wait_s() - dt, 0.0))
+            if waterfall_on:
+                segs = r.segments()
+                for seg in ('queue', 'coalesce', 'execute', 'serialize'):
+                    if seg in segs:
+                        telemetry.histogram(f'request.{seg}_s').observe(segs[seg], trace_id=r.trace_id)
+                if trace_on and r.trace_id is not None:
+                    self._emit_request_waterfall(r)
         state.served_rows_total += rows
         state.served_s_total += dt
         telemetry.counter('serve.batches').inc()
@@ -574,6 +594,36 @@ class ServeEngine:
         telemetry.histogram('serve.batch_fill', FILL_BUCKETS).observe(rows / max(self.config.max_batch_rows, 1))
         telemetry.gauge('serve.queue_depth').set(state.queue.depth_rows())
         telemetry.gauge('serve.queue_age_s').set(state.queue.oldest_age_s())
+
+    def _emit_request_waterfall(self, r: InferRequest) -> None:
+        """Emit the request's queue/coalesce/dispatch/execute/serialize
+        segments as trace spans under its adopted trace context. The
+        brackets were stamped on the batcher thread while the request's own
+        handler thread blocks in ``result()``, so they go through
+        :func:`telemetry.emit_span` with explicit timing/parentage instead
+        of the thread-stack span API."""
+        from ..telemetry.core import monotonic_ts_us
+
+        brackets = (
+            ('request.queue', r.t_enq, r.t_deq),
+            ('request.coalesce', max(r.t_open, r.t_enq) if r.t_open is not None else None, r.t_deq),
+            ('request.dispatch', r.t_deq, r.t_exec0),
+            ('request.execute', r.t_exec0, r.t_exec1),
+            ('request.serialize', r.t_exec1, r.t_done),
+        )
+        for name, a, b in brackets:
+            if a is None or b is None:
+                continue
+            telemetry.emit_span(
+                name,
+                monotonic_ts_us(a),
+                max(b - a, 0.0),
+                trace_id=r.trace_id,
+                parent_id=r.parent_span_id,
+                req=r.id,
+                rows=r.n_rows,
+                batch_rows=r.batch_rows,
+            )
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -207,6 +207,7 @@ class Fleet:
         serve_args: list[str] | None = None,
         env: dict | None = None,
         replica_ttl_s: float = DEFAULT_REPLICA_TTL_S,
+        trace_dir: str | os.PathLike | None = None,
     ):
         import tempfile
 
@@ -216,6 +217,9 @@ class Fleet:
         self.fleet_dir = Path(fleet_dir) if fleet_dir is not None else Path(tempfile.mkdtemp(prefix='da4ml-fleet-'))
         self.registry_dir = self.fleet_dir / 'registry'
         self.shared_store = Path(shared_store) if shared_store is not None else None
+        self.trace_dir = Path(trace_dir) if trace_dir is not None else None
+        if self.trace_dir is not None:
+            self.trace_dir.mkdir(parents=True, exist_ok=True)
         self.serve_args = list(serve_args or [])
         self.replica_ttl_s = replica_ttl_s
         self._extra_env = dict(env or {})
@@ -237,6 +241,11 @@ class Fleet:
             local = self.fleet_dir / 'local' / slot.replica_id
             local.mkdir(parents=True, exist_ok=True)
             env['DA4ML_STORE_LOCAL_TIER'] = str(local)
+        if self.trace_dir is not None:
+            # one JSONL trace per replica *incarnation* (sinks truncate on
+            # open): a restarted replica writes a fresh file instead of
+            # clobbering its predecessor's spans; the collector merges all
+            env['DA4ML_TRACE'] = str(self.trace_dir / f'{slot.replica_id}-{slot.restarts}.jsonl')
         return env
 
     def _spawn(self, slot: _Slot) -> subprocess.Popen:

@@ -36,6 +36,7 @@ from random import random
 
 import numpy as np
 
+from .. import telemetry
 from ..reliability.errors import InvalidInputError
 from ..reliability.locktrace import make_lock
 from .batching import PayloadTooLarge, ServeRejected
@@ -61,6 +62,14 @@ def _jitter_retry_after(seconds: float) -> float:
     Applied only at the wire — internal ``retry_after_s`` values stay
     deterministic for tests and in-process callers."""
     return max(seconds, 0.0) * (0.75 + 0.5 * random())
+
+
+def _server_timing(segments: dict[str, float], total_s: float | None = None) -> str:
+    """Render a waterfall as a ``Server-Timing`` header value (ms)."""
+    parts = [f'{name};dur={dur * 1e3:.3f}' for name, dur in segments.items()]
+    if total_s is not None:
+        parts.append(f'total;dur={total_s * 1e3:.3f}')
+    return ', '.join(parts)
 
 
 class ServeServer:
@@ -204,47 +213,97 @@ class ServeServer:
                     raise InvalidInputError('request body must be a JSON object')
                 return body
 
+            @staticmethod
+            def _error_status(exc: BaseException) -> int:
+                if isinstance(exc, ServeRejected):
+                    return exc.http_status
+                if isinstance(exc, InvalidInputError):
+                    return 400
+                return 500
+
+            def _access(self, route: str, status: int, t0: float, *, model=None, segments=None, **extra):
+                """One structured access-log record per handled request
+                (JSONL sink when tracing is armed; always counted)."""
+                telemetry.counter('request.access').inc()
+                if not telemetry.tracing_active():
+                    return
+                rec: dict = {'route': route, 'status': status, 'duration_ms': round((time.monotonic() - t0) * 1e3, 3)}
+                if model is not None:
+                    rec['model'] = model
+                for seg, dur in (segments or {}).items():
+                    rec[f'{seg}_ms'] = round(dur * 1e3, 3)
+                rec.update(extra)
+                telemetry.instant('request.access', **rec)
+
             def _infer(self):
-                body = self._read_body()
-                if 'inputs' not in body:
-                    raise InvalidInputError("request body must be a JSON object with an 'inputs' field")
-                name = body.get('model', 'default')
-                deadline_ms = body.get('deadline_ms')
-                deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
-                req = srv.engine.submit(name, body['inputs'], deadline_s)
-                y = req.result(None if req.deadline is None else max(req.deadline - req.t_enq, 0.0) + 30.0)
-                self._send_json(
-                    200,
-                    {
-                        'model': name,
-                        'n': int(len(y)),
-                        'outputs': np.asarray(y).tolist(),
-                        'served_by': req.served_by,
-                        'latency_ms': round(req.wait_s() * 1e3, 3),
-                    },
-                )
+                # adopt (or mint) the caller's trace context for this leg so
+                # engine/batching/executor spans share one fleet-wide trace id
+                ctx = telemetry.parse_traceparent(self.headers.get('traceparent'))
+                t0 = time.monotonic()
+                name = None
+                with telemetry.bind_trace(*(ctx or (None, None))) as tb:
+                    try:
+                        body = self._read_body()
+                        if 'inputs' not in body:
+                            raise InvalidInputError("request body must be a JSON object with an 'inputs' field")
+                        name = body.get('model', 'default')
+                        deadline_ms = body.get('deadline_ms')
+                        deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
+                        with telemetry.span('serve.request', model=name, route='/v1/infer'):
+                            req = srv.engine.submit(name, body['inputs'], deadline_s)
+                            y = req.result(None if req.deadline is None else max(req.deadline - req.t_enq, 0.0) + 30.0)
+                        segs = req.segments()
+                        self._send_json(
+                            200,
+                            {
+                                'model': name,
+                                'n': int(len(y)),
+                                'outputs': np.asarray(y).tolist(),
+                                'served_by': req.served_by,
+                                'latency_ms': round(req.wait_s() * 1e3, 3),
+                                'trace_id': tb.trace_id,
+                            },
+                            headers={'Server-Timing': _server_timing(segs, total_s=req.wait_s())},
+                        )
+                        self._access('/v1/infer', 200, t0, model=name, segments=segs)
+                    except BaseException as e:
+                        self._access('/v1/infer', self._error_status(e), t0, model=name, error=type(e).__name__)
+                        raise
 
             def _solve(self):
-                body = self._read_body()
-                if 'kernel' not in body:
-                    raise InvalidInputError("request body must be a JSON object with a 'kernel' field")
-                deadline_ms = body.get('deadline_ms')
-                deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
-                req = srv.solve_service.submit(body['kernel'], quality=body.get('quality'), deadline_s=deadline_s)
-                doc = req.result(None if req.deadline is None else max(req.deadline - req.t_enq, 0.0) + 30.0)
-                out = {
-                    'key': doc['key'],
-                    'source': doc['source'],
-                    'cost': doc['cost'],
-                    'backend': doc['backend'],
-                    'served_by': req.served_by,
-                    'solve_ms': doc['solve_ms'],
-                    'latency_ms': round(req.wait_s() * 1e3, 3),
-                }
-                # the program can be large; ship it only when asked for
-                if body.get('pipeline', True):
-                    out['pipeline'] = doc['pipeline']
-                self._send_json(200, out)
+                ctx = telemetry.parse_traceparent(self.headers.get('traceparent'))
+                t0 = time.monotonic()
+                with telemetry.bind_trace(*(ctx or (None, None))) as tb:
+                    try:
+                        body = self._read_body()
+                        if 'kernel' not in body:
+                            raise InvalidInputError("request body must be a JSON object with a 'kernel' field")
+                        deadline_ms = body.get('deadline_ms')
+                        deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
+                        with telemetry.span('serve.request', route='/v1/solve'):
+                            req = srv.solve_service.submit(
+                                body['kernel'], quality=body.get('quality'), deadline_s=deadline_s
+                            )
+                            doc = req.result(None if req.deadline is None else max(req.deadline - req.t_enq, 0.0) + 30.0)
+                        segs = req.segments()
+                        out = {
+                            'key': doc['key'],
+                            'source': doc['source'],
+                            'cost': doc['cost'],
+                            'backend': doc['backend'],
+                            'served_by': req.served_by,
+                            'solve_ms': doc['solve_ms'],
+                            'latency_ms': round(req.wait_s() * 1e3, 3),
+                            'trace_id': tb.trace_id,
+                        }
+                        # the program can be large; ship it only when asked for
+                        if body.get('pipeline', True):
+                            out['pipeline'] = doc['pipeline']
+                        self._send_json(200, out, headers={'Server-Timing': _server_timing(segs, total_s=req.wait_s())})
+                        self._access('/v1/solve', 200, t0, segments=segs)
+                    except BaseException as e:
+                        self._access('/v1/solve', self._error_status(e), t0, error=type(e).__name__)
+                        raise
 
         class _Server(ThreadingHTTPServer):
             daemon_threads = True

@@ -33,6 +33,15 @@ connection is torn down. Transport errors and retryable statuses (429,
 ``max_attempts`` legs or the request deadline, whichever ends first.
 Samples are tallied once per *client* request (``router.samples``), never
 once per leg, no matter how many legs raced.
+
+Observability (docs/observability.md#fleet-tracing): the router adopts the
+client's ``traceparent`` (or mints a fresh 128-bit trace id) and forwards
+it on every leg, each leg a ``router.leg`` child span tagged with its leg
+index — cancelled losers included — so a merged fleet timeline shows the
+hedge race end to end. One ``request.access`` record is logged per client
+request. ``GET /metrics/fleet`` scrapes every replica's ``/metrics`` and
+serves one aggregated exposition with per-source ``{replica=}`` labels
+(:func:`federate_metrics`), exemplars passed through.
 """
 
 from __future__ import annotations
@@ -136,6 +145,13 @@ class _Leg(threading.Thread):
         self.outcomes = outcomes
         self.conn: http.client.HTTPConnection | None = None
         self.cancelled = False
+        # trace context, stamped by Router.forward before start(): every leg
+        # is a distinct child span of the router.request span, so a merged
+        # timeline shows the race — winner and cancelled losers side by side
+        self.index = 0
+        self.trace_id: str | None = None
+        self.parent_span_id: int | None = None
+        self.span_id: int | None = None
 
     def _transport(self) -> dict:
         """One HTTP attempt against the replica. Split out from :meth:`run`
@@ -145,6 +161,10 @@ class _Leg(threading.Thread):
         r = self.replica
         self.conn = http.client.HTTPConnection(r.host, r.port, timeout=self.timeout_s)
         headers = {'Content-Type': 'application/json'} if self.body is not None else {}
+        if self.trace_id is not None and self.span_id is not None:
+            # forward the fleet-wide context: the replica adopts this leg's
+            # span as the remote parent of its serve.request subtree
+            headers['traceparent'] = telemetry.format_traceparent(self.trace_id, self.span_id)
         self.conn.request(self.method, self.path, body=self.body, headers=headers)
         resp = self.conn.getresponse()
         data = resp.read()
@@ -156,6 +176,7 @@ class _Leg(threading.Thread):
         with r.lock:
             r.inflight += 1
         t0 = time.perf_counter()
+        t0_mono = time.monotonic()
         try:
             out = {'leg': self, **self._transport()}
         except Exception as e:  # noqa: BLE001 - transport failure is an outcome
@@ -177,7 +198,29 @@ class _Leg(threading.Thread):
                     r.breaker.record_success()
             else:
                 r.breaker.record_failure()
+        self._emit_leg_span(t0_mono, time.perf_counter() - t0, out)
         self.outcomes.put(out)
+
+    def _emit_leg_span(self, t0_mono: float, duration_s: float, out: dict) -> None:
+        """One ``router.leg`` span per leg, cancelled losers included."""
+        if self.trace_id is None or not telemetry.tracing_active():
+            return
+        from ..telemetry.core import monotonic_ts_us
+
+        attrs: dict = {'replica': self.replica.id, 'leg': self.index, 'cancelled': self.cancelled}
+        if 'status' in out:
+            attrs['status'] = out['status']
+        if 'error' in out:
+            attrs['error'] = type(out['error']).__name__
+        telemetry.emit_span(
+            'router.leg',
+            monotonic_ts_us(t0_mono),
+            duration_s,
+            trace_id=self.trace_id,
+            parent_id=self.parent_span_id,
+            span_id=self.span_id,
+            **attrs,
+        )
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -296,7 +339,17 @@ class Router:
         429/5xx) rotate to the next-best replica until ``max_attempts``
         legs were fired or the deadline passed. ``hedge_ms`` after the
         first leg with no answer, a second leg races on another replica.
+
+        Trace context: adopts the calling thread's binding (the HTTP face
+        binds the client's ``traceparent``) or mints a fresh trace id, and
+        forwards it on every leg — each leg a child span with its leg index.
         """
+        ctx = telemetry.current_trace() or (None, None)
+        with telemetry.bind_trace(*ctx) as tb:
+            with telemetry.span('router.request', path=path):
+                return self._forward(method, path, body, deadline_s, tb.trace_id)
+
+    def _forward(self, method: str, path: str, body: bytes | None, deadline_s: float | None, trace_id: str):
         deadline_t = time.monotonic() + deadline_s if deadline_s is not None else None
         outcomes: 'queue.Queue[dict]' = queue.Queue()
         legs: list[_Leg] = []
@@ -316,6 +369,11 @@ class Router:
                 return False
             tried.add(rep.id)
             leg = _Leg(rep, method, path, body, timeout_s=max(remaining(), 0.05) + 5.0, outcomes=outcomes)
+            leg.index = len(legs)
+            leg.trace_id = trace_id
+            cur = telemetry.current_span()
+            leg.parent_span_id = cur.span_id if cur is not None else None
+            leg.span_id = telemetry.new_span_id()
             legs.append(leg)
             leg.start()
             return True
@@ -371,6 +429,43 @@ class Router:
             f'no replica answered within {len(legs)} attempts', retry_after_s=0.5 + random() * 0.5
         )
 
+    # -- metrics federation --------------------------------------------------
+
+    def scrape_fleet(self, timeout_s: float = 2.0) -> str:
+        """Scrape every known replica's ``/metrics`` and return one
+        aggregated OpenMetrics exposition, every sample labeled with its
+        origin ``{replica="<id>"}`` (the router's own metrics ride along as
+        ``replica="router"``). Exemplar suffixes pass through untouched, so
+        a fleet-wide latency histogram still links back to trace ids.
+        Unreachable replicas are skipped (``router.scrape.errors``)."""
+        from ..telemetry.obs.openmetrics import render_openmetrics
+
+        t0 = time.perf_counter()
+        with self._lock:
+            reps = list(self._replicas.values())
+        sources: dict[str, str] = {}
+        for rep in reps:
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(rep.host, rep.port, timeout=timeout_s)
+                conn.request('GET', '/metrics')
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise OSError(f'/metrics answered {resp.status}')
+                sources[rep.id] = resp.read().decode('utf-8', 'replace')
+            except Exception:  # noqa: BLE001 - a dead replica must not break the scrape
+                telemetry.counter('router.scrape.errors').inc()
+            finally:
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:
+                    pass
+        telemetry.gauge('router.scrape.replicas').set(len(sources))
+        telemetry.histogram('router.scrape.duration_s').observe(time.perf_counter() - t0)
+        sources['router'] = render_openmetrics()
+        return federate_metrics(sources)
+
     # -- introspection -------------------------------------------------------
 
     def replicas(self) -> list[dict]:
@@ -391,6 +486,64 @@ class Router:
         self._stop.set()
         self._prober.join(timeout=2.0)
         _ROUTERS.discard(self)
+
+
+# -------------------------------------------------------------- federation
+
+
+def _inject_label(sample: str, label: str) -> str:
+    """Insert one ``key="value"`` label pair into a sample line's label set,
+    leaving the value/timestamp/exemplar suffix untouched."""
+    name_end = len(sample)
+    for i, ch in enumerate(sample):
+        if ch in '{ ':
+            name_end = i
+            break
+    name, rest = sample[:name_end], sample[name_end:]
+    if rest.startswith('{'):
+        return f'{name}{{{label},{rest[1:]}'
+    return f'{name}{{{label}}}{rest}'
+
+
+def federate_metrics(sources: dict[str, str]) -> str:
+    """Merge N OpenMetrics expositions into one aggregated view.
+
+    Each source's samples gain a ``replica="<source key>"`` label; HELP/TYPE
+    metadata is emitted once per family (first writer wins), with samples
+    from every source grouped under it so the result still satisfies
+    :func:`~..telemetry.obs.openmetrics.validate_openmetrics` (no family
+    interleaving, no duplicate HELP)."""
+    fam_meta: dict[str, dict[str, str]] = {}
+    fam_samples: dict[str, list[str]] = {}
+    order: list[str] = []
+    for source in sorted(sources):
+        current: str | None = None
+        for line in sources[source].splitlines():
+            if not line.strip() or line == '# EOF':
+                continue
+            if line.startswith('# HELP ') or line.startswith('# TYPE '):
+                kind, _, rest = line[2:].partition(' ')
+                name = rest.split(' ', 1)[0]
+                if name not in fam_meta:
+                    fam_meta[name] = {}
+                    fam_samples[name] = []
+                    order.append(name)
+                fam_meta[name].setdefault(kind, line)
+                current = name
+                continue
+            if line.startswith('#') or current is None:
+                continue  # unknown comment, or a sample before any metadata
+            fam_samples[current].append(_inject_label(line, f'replica="{source}"'))
+    out: list[str] = []
+    for name in order:
+        meta = fam_meta[name]
+        if 'HELP' in meta:
+            out.append(meta['HELP'])
+        if 'TYPE' in meta:
+            out.append(meta['TYPE'])
+        out.extend(fam_samples[name])
+    out.append('# EOF')
+    return '\n'.join(out) + '\n'
 
 
 # ----------------------------------------------------------------- http face
@@ -461,6 +614,10 @@ class RouterServer:
 
                         refresh_computed_gauges()
                         self._send(200, render_openmetrics().encode(), CONTENT_TYPE)
+                    elif path == '/metrics/fleet':
+                        from ..telemetry.obs.openmetrics import CONTENT_TYPE
+
+                        self._send(200, srv.router.scrape_fleet().encode(), CONTENT_TYPE)
                     elif path == '/healthz':
                         from ..telemetry.obs.health import health_snapshot
 
@@ -478,46 +635,63 @@ class RouterServer:
                 except Exception:
                     pass
 
-            def do_POST(self):
-                try:
-                    path = self.path.split('?', 1)[0]
-                    if path not in ('/v1/infer', '/v1/solve'):
-                        self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
-                        return
-                    try:
-                        length = int(self.headers.get('Content-Length', '0') or 0)
-                    except ValueError:
-                        length = 0
-                    from .batching import PayloadTooLarge
-                    from .http import _max_body_bytes
+            def _access(self, route: str, status: int, t0: float, **extra):
+                """Exactly one access-log record per *client* request,
+                however many hedge/retry legs raced underneath
+                (tests/test_fleet.py)."""
+                telemetry.counter('request.access').inc()
+                if not telemetry.tracing_active():
+                    return
+                rec: dict = {'route': route, 'status': status, 'duration_ms': round((time.monotonic() - t0) * 1e3, 3)}
+                rec.update(extra)
+                telemetry.instant('request.access', **rec)
 
-                    if length > _max_body_bytes():
-                        # reject before buffering — same ceiling the replicas
-                        # enforce, but the router must not buffer it either
-                        raise PayloadTooLarge(
-                            f'request body of {length} bytes exceeds the {_max_body_bytes()}-byte ceiling'
-                        )
-                    raw = self.rfile.read(length) if length > 0 else b''
-                    deadline_s, n_rows = _peek_request(raw, srv.router.default_deadline_ms)
-                    status, body, headers = srv.router.forward('POST', path, raw, deadline_s)
-                    if status == 200 and path == '/v1/infer':
-                        # one client request = one sample tally, however many
-                        # legs raced (tests/test_fleet.py)
-                        telemetry.counter('router.samples').inc(n_rows)
-                    self._send(status, body, headers=headers)
-                except ServeRejected as e:
-                    doc = e.to_doc()
-                    headers = {}
-                    if e.retry_after_s is not None:
-                        headers['Retry-After'] = f'{max(e.retry_after_s, 0.0):.3f}'
-                    self._send_json(e.http_status, {'error': doc}, headers=headers)
-                except Exception as e:  # noqa: BLE001 - a broken proxy must answer something
+            def do_POST(self):
+                path = self.path.split('?', 1)[0]
+                ctx = telemetry.parse_traceparent(self.headers.get('traceparent'))
+                t0 = time.monotonic()
+                with telemetry.bind_trace(*(ctx or (None, None))):
                     try:
-                        self._send_json(
-                            502, {'error': {'type': type(e).__name__, 'message': str(e), 'http_status': 502}}
-                        )
-                    except Exception:
-                        pass
+                        if path not in ('/v1/infer', '/v1/solve'):
+                            self._send_json(404, {'error': {'type': 'NotFound', 'message': path, 'http_status': 404}})
+                            return
+                        try:
+                            length = int(self.headers.get('Content-Length', '0') or 0)
+                        except ValueError:
+                            length = 0
+                        from .batching import PayloadTooLarge
+                        from .http import _max_body_bytes
+
+                        if length > _max_body_bytes():
+                            # reject before buffering — same ceiling the replicas
+                            # enforce, but the router must not buffer it either
+                            raise PayloadTooLarge(
+                                f'request body of {length} bytes exceeds the {_max_body_bytes()}-byte ceiling'
+                            )
+                        raw = self.rfile.read(length) if length > 0 else b''
+                        deadline_s, n_rows = _peek_request(raw, srv.router.default_deadline_ms)
+                        status, body, headers = srv.router.forward('POST', path, raw, deadline_s)
+                        if status == 200 and path == '/v1/infer':
+                            # one client request = one sample tally, however many
+                            # legs raced (tests/test_fleet.py)
+                            telemetry.counter('router.samples').inc(n_rows)
+                        self._send(status, body, headers=headers)
+                        self._access(path, status, t0, replica=headers.get('X-DA4ML-Replica'))
+                    except ServeRejected as e:
+                        doc = e.to_doc()
+                        headers = {}
+                        if e.retry_after_s is not None:
+                            headers['Retry-After'] = f'{max(e.retry_after_s, 0.0):.3f}'
+                        self._send_json(e.http_status, {'error': doc}, headers=headers)
+                        self._access(path, e.http_status, t0, error=type(e).__name__)
+                    except Exception as e:  # noqa: BLE001 - a broken proxy must answer something
+                        try:
+                            self._send_json(
+                                502, {'error': {'type': type(e).__name__, 'message': str(e), 'http_status': 502}}
+                            )
+                        except Exception:
+                            pass
+                        self._access(path, 502, t0, error=type(e).__name__)
 
         class _Server(ThreadingHTTPServer):
             daemon_threads = True
@@ -559,4 +733,12 @@ def _peek_request(raw: bytes, default_deadline_ms: float) -> tuple[float | None,
     return (deadline_ms / 1e3 if deadline_ms > 0 else None), n_rows
 
 
-__all__ = ['DEFAULT_HEDGE_MS', 'NoReplicaAvailable', 'Router', 'RouterServer', 'router_health', 'router_status']
+__all__ = [
+    'DEFAULT_HEDGE_MS',
+    'NoReplicaAvailable',
+    'Router',
+    'RouterServer',
+    'federate_metrics',
+    'router_health',
+    'router_status',
+]

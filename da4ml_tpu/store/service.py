@@ -93,6 +93,13 @@ class SolveService:
         if not np.all(np.isfinite(k)):
             raise InvalidInputError('kernel contains non-finite (NaN/inf) values')
         req = SolveRequest(k, deadline_s if deadline_s is not None else self.default_deadline_s, quality)
+        tb = telemetry.current_trace()
+        if tb is not None:
+            # adopt the submitting thread's trace context so the worker's
+            # store-tier spans join the request's fleet-wide trace
+            req.trace_id = tb[0]
+            cur = telemetry.current_span()
+            req.parent_span_id = cur.span_id if cur is not None else tb[1]
         try:
             self.queue.push(req)
         except ServeRejected:
@@ -119,7 +126,14 @@ class SolveService:
                     req.set_error(DeadlineExpired(f'solve request {req.id} expired before dispatch'))
                     continue
                 try:
-                    req.set_result(self._solve_one(req), served_by=f'solve[{self.backend}]')
+                    if req.trace_id is not None:
+                        # rebind the request's trace on this worker thread so
+                        # the store-tier spans carry the same trace id
+                        with telemetry.bind_trace(req.trace_id, req.parent_span_id):
+                            doc = self._solve_one(req)
+                    else:
+                        doc = self._solve_one(req)
+                    req.set_result(doc, served_by=f'solve[{self.backend}]')
                 except BaseException as e:  # noqa: BLE001 - resolved into the request
                     req.set_error(e)
 

@@ -30,11 +30,19 @@ from .core import (
     add_sink,
     beat,
     beat_age_s,
+    bind_trace,
     collect_phases,
     current_span,
+    current_trace,
+    current_trace_id,
     disable,
+    emit_span,
     enable,
+    format_traceparent,
     instant,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
     remove_sink,
     reset,
     span,
@@ -79,9 +87,17 @@ __all__ = [
     'Span',
     'span',
     'instant',
+    'emit_span',
     'collect_phases',
     'current_span',
     'active_spans',
+    'bind_trace',
+    'current_trace',
+    'current_trace_id',
+    'new_trace_id',
+    'new_span_id',
+    'format_traceparent',
+    'parse_traceparent',
     'beat',
     'beat_age_s',
     'serve',

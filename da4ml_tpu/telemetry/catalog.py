@@ -168,6 +168,14 @@ METRICS: dict[str, str] = {
     'router.leg_failures': 'transport-level leg failures (replica died mid-request)',
     'router.no_replica': 'requests rejected because no replica was routable',
     'router.probes': 'active /healthz probe rounds',
+    'router.scrape.errors': 'replica /metrics scrapes that failed during federation',
+    'router.scrape.duration_s': 'wall clock per fleet-wide /metrics/fleet scrape round',
+    'router.scrape.replicas': 'replicas answering the last federation scrape',
+    'request.access': 'structured access-log records emitted (one per client request)',
+    'request.queue_s': 'per-request queue-wait segment (admission to batch dequeue)',
+    'request.coalesce_s': 'per-request coalesce-window segment (batch open to dequeue)',
+    'request.execute_s': 'per-request device-execute segment',
+    'request.serialize_s': 'per-request serialize segment (execute done to resolution)',
     'fleet.spawns': 'replica subprocesses spawned by the fleet driver',
     'fleet.restarts': 'crashed replicas restarted with backoff',
     'fleet.kills': 'replicas signalled by the chaos drill',
@@ -203,6 +211,12 @@ DYNAMIC_SITES: dict[str, tuple[str, ...]] = {
     'da4ml_tpu/telemetry/obs/health.py': ('breaker.state',),
     'da4ml_tpu/cmvm/jax_search.py': ('jit.compile', 'jit.compile_s', 'jit.cache_load', 'jit.cache_load_s'),
     'da4ml_tpu/store/service.py': ('serve.solve_hits', 'serve.solve_misses'),
+    'da4ml_tpu/serve/engine.py': (
+        'request.queue_s',
+        'request.coalesce_s',
+        'request.execute_s',
+        'request.serialize_s',
+    ),
 }
 
 

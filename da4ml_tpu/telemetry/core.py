@@ -12,7 +12,14 @@ Design constraints (docs/telemetry.md):
   GIL).
 - **Fork-safe.** Sinks record their creating pid and drop events from
   forked children (the host dc-sweep uses a fork pool), so a child's atexit
-  can never corrupt the parent's trace file.
+  can never corrupt the parent's trace file. Span ids are re-seeded in
+  forked children (``os.register_at_fork``) so a merged fleet timeline
+  never aliases two spans from different processes.
+- **Fleet-unique identity.** Span ids carry a per-process random epoch in
+  their high bits; requests crossing process boundaries share a 128-bit
+  trace id propagated via a W3C ``traceparent``-style header
+  (:func:`bind_trace` / :func:`parse_traceparent` /
+  :func:`format_traceparent` — docs/observability.md#fleet-tracing).
 
 Spans deliver Chrome trace-event ``"X"`` (complete) events to every
 registered sink; :func:`instant` delivers ``"i"`` events. Phase collectors
@@ -30,12 +37,40 @@ import time
 
 _T0 = time.perf_counter()
 _PID = os.getpid()
-_ids = itertools.count(1)
+
+
+def _span_id_source() -> 'itertools.count[int]':
+    """Per-process-seeded span ids: a 31-bit pid+random epoch in the high
+    bits over a 32-bit in-process counter. Two processes (or a parent and
+    its forked child) can then never mint the same span id, so merged
+    multi-replica timelines keep span/parent links unambiguous."""
+    epoch = (os.getpid() ^ int.from_bytes(os.urandom(4), 'big')) & 0x7FFFFFFF
+    return itertools.count((epoch << 32) | 1)
+
+
+_ids = _span_id_source()
+
+
+def _after_fork_child() -> None:
+    global _PID, _ids
+    _PID = os.getpid()
+    _ids = _span_id_source()
+
+
+if hasattr(os, 'register_at_fork'):  # pragma: no branch
+    os.register_at_fork(after_in_child=_after_fork_child)
 
 
 def _now_us() -> float:
     """Microseconds since the telemetry epoch (module import)."""
     return (time.perf_counter() - _T0) * 1e6
+
+
+def monotonic_ts_us(t_mono: float) -> float:
+    """Map a ``time.monotonic`` stamp onto the trace ``ts`` epoch — for
+    emitting spans (:func:`emit_span`) whose brackets were recorded with the
+    monotonic clock (the serve queue's waterfall timestamps)."""
+    return (time.perf_counter() - _T0 - (time.monotonic() - t_mono)) * 1e6
 
 
 class _State:
@@ -86,6 +121,85 @@ def current_span() -> 'Span | None':
     return st[-1] if st else None
 
 
+# ---------------------------------------------------------------------------
+# trace context: fleet-unique identity + traceparent-style propagation
+# ---------------------------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    """A fresh 128-bit trace id as 32 lowercase hex chars."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> int:
+    """Mint a fleet-unique span id without opening a span — used by callers
+    that must hand a child span id to a remote party (the router's hedge
+    legs) before the span's duration is known."""
+    return next(_ids)
+
+
+def format_traceparent(trace_id: str, span_id: int | None = None) -> str:
+    """Render a W3C ``traceparent``-style header value:
+    ``00-<32 hex trace id>-<16 hex parent span id>-01``."""
+    return f'00-{trace_id}-{(span_id or 0) & 0xFFFFFFFFFFFFFFFF:016x}-01'
+
+
+def parse_traceparent(header: 'str | None') -> 'tuple[str, int | None] | None':
+    """Parse a ``traceparent`` header into ``(trace_id, parent_span_id)``.
+    Returns None for anything malformed (wrong version, lengths, non-hex,
+    all-zero trace id); an all-zero parent id maps to ``None`` parent."""
+    if not header:
+        return None
+    parts = header.strip().lower().split('-')
+    if len(parts) < 4 or parts[0] != '00' or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        t_val = int(parts[1], 16)
+        s_val = int(parts[2], 16)
+    except ValueError:
+        return None
+    if t_val == 0:
+        return None
+    return parts[1], (s_val or None)
+
+
+class bind_trace:
+    """Bind a trace context to the calling thread for the ``with`` block.
+
+    Spans opened inside the block carry ``trace_id`` in their emitted args,
+    and a root span (no in-thread parent) adopts ``parent_span_id`` as its
+    parent — stitching this process's subtree under the remote caller's
+    span in a merged timeline. Mints a fresh 128-bit trace id when none is
+    given. Bindings nest; the previous context is restored on exit.
+    """
+
+    __slots__ = ('trace_id', 'parent_span_id', '_prev')
+
+    def __init__(self, trace_id: 'str | None' = None, parent_span_id: 'int | None' = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.parent_span_id = parent_span_id
+
+    def __enter__(self) -> 'bind_trace':
+        self._prev = getattr(_tls, 'trace', None)
+        _tls.trace = (self.trace_id, self.parent_span_id)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _tls.trace = self._prev
+        return False
+
+
+def current_trace() -> 'tuple[str, int | None] | None':
+    """The calling thread's bound ``(trace_id, parent_span_id)``, or None."""
+    return getattr(_tls, 'trace', None)
+
+
+def current_trace_id() -> 'str | None':
+    """The calling thread's bound trace id, or None."""
+    tb = getattr(_tls, 'trace', None)
+    return tb[0] if tb is not None else None
+
+
 def active_spans() -> list[dict]:
     """Snapshot of every span currently open in the process (any thread),
     oldest first: ``{span_id, parent_id, name, age_s, attrs}``."""
@@ -133,13 +247,14 @@ def _emit(event: dict) -> None:
 class Span:
     """One timed region. Context manager; nests via the per-thread stack."""
 
-    __slots__ = ('name', 'attrs', 'span_id', 'parent_id', 't0', 'ts_us', 'duration_s')
+    __slots__ = ('name', 'attrs', 'span_id', 'parent_id', 'trace_id', 't0', 'ts_us', 'duration_s')
 
     def __init__(self, name: str, attrs: dict):
         self.name = name
         self.attrs = attrs
         self.span_id = next(_ids)
         self.parent_id: int | None = None
+        self.trace_id: str | None = None
         self.t0 = 0.0
         self.ts_us = 0.0
         self.duration_s = 0.0
@@ -155,6 +270,11 @@ class Span:
     def __enter__(self) -> 'Span':
         st = _stack()
         self.parent_id = st[-1].span_id if st else None
+        tb = getattr(_tls, 'trace', None)
+        if tb is not None:
+            self.trace_id = tb[0]
+            if self.parent_id is None:
+                self.parent_id = tb[1]
         st.append(self)
         self.t0 = time.perf_counter()
         self.ts_us = (self.t0 - _T0) * 1e6
@@ -176,6 +296,8 @@ class Span:
             args['span_id'] = self.span_id
             if self.parent_id is not None:
                 args['parent_id'] = self.parent_id
+            if self.trace_id is not None:
+                args['trace_id'] = self.trace_id
             _emit(
                 {
                     'name': self.name,
@@ -198,6 +320,7 @@ class _NoopSpan:
     __slots__ = ()
     span_id = None
     parent_id = None
+    trace_id = None
     duration_s = 0.0
 
     def set(self, **attrs) -> '_NoopSpan':
@@ -227,9 +350,14 @@ def span(name: str, /, **attrs):
 
 
 def instant(name: str, /, **attrs) -> None:
-    """A point-in-time event (campaign heartbeats, breaker transitions)."""
+    """A point-in-time event (campaign heartbeats, breaker transitions).
+    Carries the thread's bound trace id (:class:`bind_trace`) so log
+    mirrors and access-log records correlate with their request trace."""
     if not _state.sinks:
         return
+    tb = getattr(_tls, 'trace', None)
+    if tb is not None and 'trace_id' not in attrs:
+        attrs['trace_id'] = tb[0]
     _emit(
         {
             'name': name,
@@ -241,6 +369,48 @@ def instant(name: str, /, **attrs) -> None:
             'args': attrs,
         }
     )
+
+
+def emit_span(
+    name: str,
+    ts_us: float,
+    duration_s: float,
+    *,
+    trace_id: 'str | None' = None,
+    parent_id: 'int | None' = None,
+    span_id: 'int | None' = None,
+    **attrs,
+) -> int:
+    """Emit a completed span event directly, bypassing the thread stack.
+
+    For cross-thread waterfall segments whose begin and end are observed on
+    a different thread than the owning request (the serve engine's batcher
+    recording per-request queue/execute/serialize segments, the router's
+    hedge legs): the caller supplies explicit timing and parentage instead
+    of inheriting the emitting thread's stack. Returns the span id used
+    (minted when not supplied), or 0 when no sink is registered.
+    """
+    if not _state.sinks:
+        return 0
+    sid = span_id if span_id is not None else next(_ids)
+    args = dict(attrs)
+    args['span_id'] = sid
+    if parent_id is not None:
+        args['parent_id'] = parent_id
+    if trace_id is not None:
+        args['trace_id'] = trace_id
+    _emit(
+        {
+            'name': name,
+            'ph': 'X',
+            'ts': round(ts_us, 1),
+            'dur': round(duration_s * 1e6, 1),
+            'pid': _PID,
+            'tid': _tid(),
+            'args': args,
+        }
+    )
+    return sid
 
 
 class _PhaseCollector:

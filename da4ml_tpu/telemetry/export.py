@@ -13,6 +13,14 @@ trace-event schema: ``name``, ``ph``, ``ts``/``dur`` in microseconds,
   a killed process still leaves a readable prefix; the metrics snapshot is
   appended as a final ``ph: "M"`` record at close.
 
+Both sinks record a **clock anchor** at open — one ``(unix_time_us, ts)``
+pair sampled back-to-back — that maps the process-local ``ts`` epoch
+(``time.perf_counter`` at telemetry import) onto the shared wall clock.
+The fleet trace merger (:mod:`.obs.collect`) uses it to align N replica
+traces onto one timeline; JSONL traces carry it as a first
+``ph: "M"``/``name: "clock_sync"`` record, Chrome traces under
+``otherData.clock_sync``.
+
 Both are fork-safe (events from a forked child are dropped — the child
 inherited the parent's buffer/handle and must not corrupt its file) and
 registered with ``atexit`` so an unclosed trace still flushes.
@@ -38,12 +46,21 @@ def _json_default(obj):
     return str(obj)
 
 
+def _clock_anchor() -> dict:
+    """One ``(unix wall clock, process-local ts)`` pair sampled back-to-back:
+    ``unix_time_us - ts`` is this process's offset onto the shared clock."""
+    from .core import _now_us
+
+    return {'unix_time_us': time.time() * 1e6, 'ts': round(_now_us(), 1)}
+
+
 class ChromeTraceSink:
     def __init__(self, path: 'str | os.PathLike'):
         self.path = Path(path)
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        self._anchor = _clock_anchor()
         self._closed = False
         atexit.register(self.close)
 
@@ -70,6 +87,7 @@ class ChromeTraceSink:
                 'producer': 'da4ml_tpu.telemetry',
                 'pid': self._pid,
                 'unix_time': time.time(),
+                'clock_sync': self._anchor,
                 'metrics': metrics_snapshot(),
             },
         }
@@ -90,6 +108,21 @@ class JsonlSink:
         self._lock = threading.Lock()
         self._pid = os.getpid()
         self._closed = False
+        anchor = _clock_anchor()
+        self._fh.write(
+            json.dumps(
+                {
+                    'name': 'clock_sync',
+                    'ph': 'M',
+                    'ts': anchor['ts'],
+                    'pid': self._pid,
+                    'tid': 0,
+                    'args': {'unix_time_us': anchor['unix_time_us']},
+                }
+            )
+            + '\n'
+        )
+        self._fh.flush()
         atexit.register(self.close)
 
     def emit(self, event: dict) -> None:
@@ -154,17 +187,24 @@ def load_trace(path: 'str | os.PathLike') -> tuple[list[dict], dict]:
         if isinstance(doc, list):
             return doc, {}
     events: list[dict] = []
-    metrics: dict = {}
+    metrics_by_pid: dict = {}
     for line in text.splitlines():
         line = line.strip()
         if not line:
             continue
         ev = json.loads(line)
         if ev.get('ph') == 'M' and ev.get('name') == 'metrics':
-            metrics = ev.get('args', {}).get('metrics', {})
+            # latest mirror per producing process: a merged multi-process
+            # trace must aggregate across pids, never double-count one
+            # process's repeated snapshots
+            metrics_by_pid[ev.get('pid', 0)] = ev.get('args', {}).get('metrics', {})
         else:
             events.append(ev)
-    return events, metrics
+    if len(metrics_by_pid) > 1:
+        from .obs.collect import merge_metrics
+
+        return events, merge_metrics(metrics_by_pid)
+    return events, next(iter(metrics_by_pid.values()), {})
 
 
 def validate_trace(events: list[dict]) -> None:

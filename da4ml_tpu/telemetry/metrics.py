@@ -100,9 +100,15 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket histogram (cumulative counts, Prometheus-style)."""
+    """Fixed-bucket histogram (cumulative counts, Prometheus-style).
 
-    __slots__ = ('name', 'bounds', '_counts', '_sum', '_count', '_min', '_max', '_lock')
+    ``observe(v, trace_id=...)`` additionally retains the most recent
+    ``(trace_id, value, unix time)`` triple per bucket as an OpenMetrics
+    **exemplar**, so a bad latency bucket on ``/metrics`` links straight to
+    the trace that landed in it (docs/observability.md#fleet-tracing).
+    """
+
+    __slots__ = ('name', 'bounds', '_counts', '_sum', '_count', '_min', '_max', '_exemplars', '_lock')
 
     def __init__(self, name: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS):
         self.name = name
@@ -112,9 +118,10 @@ class Histogram:
         self._count = 0
         self._min = float('inf')
         self._max = float('-inf')
+        self._exemplars: dict[int, tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: 'str | None' = None) -> None:
         v = float(v)
         with self._lock:
             i = 0
@@ -129,6 +136,8 @@ class Histogram:
                 self._min = v
             if v > self._max:
                 self._max = v
+            if trace_id is not None:
+                self._exemplars[i] = (trace_id, v, time.time())
 
     @property
     def count(self) -> int:
@@ -151,6 +160,8 @@ class Histogram:
                 d['min'] = round(self._min, 6)
                 d['max'] = round(self._max, 6)
                 d['mean'] = round(self._sum / self._count, 6)
+            if self._exemplars:
+                d['exemplars'] = {str(i): [t, v, round(ts, 3)] for i, (t, v, ts) in sorted(self._exemplars.items())}
             return d
 
 
@@ -169,7 +180,7 @@ class _NoopMetric:
     def set(self, v: float) -> None:
         pass
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: 'str | None' = None) -> None:
         pass
 
     def to_dict(self) -> dict:

@@ -23,6 +23,7 @@ the telemetry package never pulls in the HTTP server or jax.
 """
 
 from .bench_diff import diff_metrics, load_bench_metrics, load_budgets
+from .collect import merge_metrics, merge_traces, trace_index, write_merged
 from .health import health_snapshot, status_snapshot
 from .openmetrics import render_openmetrics, validate_openmetrics
 from .server import serve, server_port, stop_server
@@ -31,6 +32,10 @@ from .tailer import TraceTailer
 __all__ = [
     'render_openmetrics',
     'validate_openmetrics',
+    'merge_traces',
+    'merge_metrics',
+    'trace_index',
+    'write_merged',
     'health_snapshot',
     'status_snapshot',
     'serve',

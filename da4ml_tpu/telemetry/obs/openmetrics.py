@@ -10,11 +10,16 @@ unbounded family namespace:
 - ``breaker.state.<name>``  -> ``da4ml_breaker_state{breaker="<name>"}``
 - ``run.mode.<mode>``       -> ``da4ml_run_mode{mode="<mode>"}``
 
+Histogram buckets carry **exemplars** when the registry recorded one
+(``Histogram.observe(v, trace_id=...)``): the OpenMetrics
+``# {trace_id="..."} <value> <timestamp>`` suffix that links a latency
+bucket to the most recent trace that landed in it.
+
 :func:`validate_openmetrics` is a line-by-line grammar checker for the
 exposition format (HELP/TYPE ordering, name/label syntax, label-value
-escaping, cumulative bucket monotonicity, ``# EOF`` terminator) shared by
-the tests and the CI obs-smoke job; it returns the parsed samples so
-callers can assert on values.
+escaping, cumulative bucket monotonicity, exemplar syntax and placement,
+``# EOF`` terminator) shared by the tests and the CI obs-smoke job; it
+returns the parsed samples so callers can assert on values.
 """
 
 from __future__ import annotations
@@ -74,6 +79,14 @@ def _labels_str(labels: dict[str, str]) -> str:
     return '{' + inner + '}'
 
 
+def _exemplar_str(ex) -> str:
+    """Render a registry exemplar triple as the OpenMetrics suffix."""
+    if not ex:
+        return ''
+    trace_id, value, ts = ex
+    return f' # {{trace_id="{_escape_label(str(trace_id))}"}} {_fmt(float(value))} {_fmt(float(ts))}'
+
+
 def render_openmetrics(snapshot: dict | None = None) -> str:
     """Render a metrics snapshot (default: the live registry, with health
     gauges refreshed) as OpenMetrics text ending in ``# EOF``."""
@@ -116,14 +129,15 @@ def render_openmetrics(snapshot: dict | None = None) -> str:
             else:  # histogram: registry buckets are per-bin -> cumulate
                 bounds = m.get('bounds', [])
                 counts = m.get('buckets', [])
+                exemplars = m.get('exemplars') or {}
                 cum = 0
-                for bound, c in zip(bounds, counts):
+                for bi, (bound, c) in enumerate(zip(bounds, counts)):
                     cum += c
                     bl = dict(labels, le=_fmt(float(bound)))
-                    lines.append(f'{name}_bucket{_labels_str(bl)} {cum}')
+                    lines.append(f'{name}_bucket{_labels_str(bl)} {cum}{_exemplar_str(exemplars.get(str(bi)))}')
                 total = m.get('count', 0)
                 bl = dict(labels, le='+Inf')
-                lines.append(f'{name}_bucket{_labels_str(bl)} {total}')
+                lines.append(f'{name}_bucket{_labels_str(bl)} {total}{_exemplar_str(exemplars.get(str(len(bounds))))}')
                 lines.append(f'{name}_sum{ls} {_fmt(float(m.get("sum", 0.0)))}')
                 lines.append(f'{name}_count{ls} {total}')
     lines.append('# EOF')
@@ -135,10 +149,15 @@ def render_openmetrics(snapshot: dict | None = None) -> str:
 # ---------------------------------------------------------------------------
 
 _NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+_NUM = r'-?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN)'
 _SAMPLE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
     r'(?:\{(?P<labels>[^{}]*)\})?'
-    r' (?P<value>-?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN))$'
+    rf' (?P<value>{_NUM})'
+    # optional OpenMetrics exemplar: " # {labels} value [timestamp]"
+    r'(?: # \{(?P<ex_labels>[^{}]*)\}'
+    rf' (?P<ex_value>{_NUM})'
+    r'(?: (?P<ex_ts>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?))?)?$'
 )
 _LABEL_RE = re.compile(r'^(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\["\\n])*)"$')
 
@@ -248,6 +267,22 @@ def validate_openmetrics(text: str) -> dict[str, dict]:
                 raise ValueError(f'line {i}: histogram bucket without le label')
         else:
             raise ValueError(f'line {i}: sample for family {current} with no TYPE')
+        ex_labels_raw = m.group('ex_labels')
+        if ex_labels_raw is not None:
+            # exemplars are only legal on counter _total and histogram
+            # _bucket samples (OpenMetrics 1.0 §exemplars)
+            if kind == 'histogram':
+                if not sname.endswith('_bucket'):
+                    raise ValueError(f'line {i}: exemplar on histogram sample {sname} (only _bucket may carry one)')
+            elif kind != 'counter':
+                raise ValueError(f'line {i}: exemplar on {kind} sample {sname}')
+            try:
+                ex_labels = _split_labels(ex_labels_raw)
+            except ValueError as e:
+                raise ValueError(f'line {i}: bad exemplar labels: {e}') from None
+            if sum(len(k) + len(v) for k, v in ex_labels.items()) > 128:
+                raise ValueError(f'line {i}: exemplar label set exceeds 128 characters')
+            _parse_value(m.group('ex_value'))
         key = sname + _labels_str({k: v for k, v in labels.items()})
         if key in fam['samples']:
             raise ValueError(f'line {i}: duplicate sample {key}')

@@ -9,6 +9,12 @@ the mirrored metrics over ``/metrics``.
 :class:`TraceTailer` keeps a byte offset and a partial-line buffer, so
 each :meth:`poll` parses only the newly appended complete lines; a
 truncated/rotated file (size shrank) resets the reader.
+
+Merged multi-process traces (``da4ml-tpu trace-view`` output, or a file
+several replicas append metrics mirrors into) are handled without
+double-counting: metrics records are kept *per pid* — a process's newer
+mirror replaces its older one — and :attr:`metrics` aggregates across the
+distinct pids (:func:`..obs.collect.merge_metrics`).
 """
 
 from __future__ import annotations
@@ -23,7 +29,7 @@ class TraceTailer:
     def __init__(self, path: 'str | os.PathLike'):
         self.path = Path(path)
         self.events: list[dict] = []
-        self.metrics: dict = {}
+        self.metrics_by_pid: dict[int, dict] = {}
         self.n_bad_lines = 0
         self._pos = 0
         self._buf = ''
@@ -40,7 +46,7 @@ class TraceTailer:
             self._pos = 0
             self._buf = ''
             self.events.clear()
-            self.metrics = {}
+            self.metrics_by_pid.clear()
         if size == self._pos:
             return 0
         with open(self.path) as fh:
@@ -61,13 +67,28 @@ class TraceTailer:
                 self.n_bad_lines += 1
                 continue
             if ev.get('ph') == 'M' and ev.get('name') == 'metrics':
-                self.metrics = ev.get('args', {}).get('metrics', {})
+                # latest mirror per producing process — merged multi-pid
+                # traces must replace per pid, never accumulate blindly
+                self.metrics_by_pid[ev.get('pid', 0)] = ev.get('args', {}).get('metrics', {})
             else:
                 self.events.append(ev)
                 n_new += 1
         if n_new:
             self._last_new = time.monotonic()
         return n_new
+
+    @property
+    def metrics(self) -> dict:
+        """The latest metrics, aggregated across producing processes (one
+        process: its snapshot verbatim; several: counters/histograms summed
+        per distinct pid, each pid contributing only its newest mirror)."""
+        if not self.metrics_by_pid:
+            return {}
+        if len(self.metrics_by_pid) == 1:
+            return next(iter(self.metrics_by_pid.values()))
+        from .collect import merge_metrics
+
+        return merge_metrics(self.metrics_by_pid)
 
     @property
     def staleness_s(self) -> float:

@@ -92,6 +92,11 @@ class _FakeReplica:
         self.status = status
         self.health = health
         self.infers = 0
+        self.traceparents: list[str | None] = []
+        self.metrics_text = (
+            '# HELP da4ml_serve_requests total\n# TYPE da4ml_serve_requests counter\n'
+            'da4ml_serve_requests_total 7 # {trace_id="feedface"} 1 1700000000.0\n# EOF\n'
+        )
         self._lock = threading.Lock()
         fake = self
 
@@ -110,8 +115,16 @@ class _FakeReplica:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path.split('?', 1)[0] == '/healthz':
+                path = self.path.split('?', 1)[0]
+                if path == '/healthz':
                     self._send(200, {'status': fake.health})
+                elif path == '/metrics':
+                    body = fake.metrics_text.encode()
+                    self.send_response(200)
+                    self.send_header('Content-Type', 'application/openmetrics-text; version=1.0.0; charset=utf-8')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {'error': 'not found'})
 
@@ -120,6 +133,7 @@ class _FakeReplica:
                 self.rfile.read(length)
                 with fake._lock:
                     fake.infers += 1
+                    fake.traceparents.append(self.headers.get('traceparent'))
                 if fake.delay_s:
                     time.sleep(fake.delay_s)
                 if fake.status == 200:
@@ -179,6 +193,102 @@ def test_hedge_wins_cancels_straggler_and_tallies_once():
         server.close()
         slow.close()
         fast.close()
+
+
+def test_hedged_request_logs_one_access_record_and_cancelled_leg_span(tmp_path):
+    """One ``request.access`` record per *client* request however many legs
+    raced; the losing leg appears as a cancelled ``router.leg`` child span;
+    both replicas saw the same forwarded trace id with distinct leg span ids."""
+    trace = tmp_path / 'router.jsonl'
+    telemetry.enable(trace)
+    slow = _FakeReplica(delay_s=0.6)
+    fast = _FakeReplica(delay_s=0.0)
+    # max_attempts=2 pins the leg count: under a loaded machine the default
+    # third hedge timer can expire before the winner's answer lands
+    router = Router(
+        replicas={'slow': slow.url, 'fast': fast.url}, hedge_ms=30.0, max_attempts=2, default_deadline_ms=5000.0
+    )
+    server = RouterServer(router)
+    client_tid = telemetry.new_trace_id()
+    try:
+        router._replicas['fast'].ewma_s = 0.05  # steer leg one to the straggler
+        req = urllib.request.Request(
+            server.url + '/v1/infer',
+            data=json.dumps({'model': 'default', 'inputs': [[0.0]] * 2, 'deadline_ms': 5000}).encode(),
+            headers={'Content-Type': 'application/json', 'traceparent': f'00-{client_tid}-00000000000000aa-01'},
+            method='POST',
+        )
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            assert resp.status == 200
+
+        # the cancelled leg emits its span when its socket unblocks (the
+        # straggler answers ~0.6s in) — wait for both leg records to land;
+        # key on OUR trace id: a straggler leg from an earlier test can land
+        # in this sink too (emission checks tracing_active at unblock time)
+        def _my_legs():
+            events = [json.loads(ln) for ln in trace.read_text().splitlines()]
+            return [
+                e for e in events if e.get('name') == 'router.leg' and e['args'].get('trace_id') == client_tid
+            ]
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(_my_legs()) < 2:
+            time.sleep(0.05)
+    finally:
+        server.close()
+        slow.close()
+        fast.close()
+        telemetry.disable()
+    events = [json.loads(ln) for ln in trace.read_text().splitlines()]
+    access = [e for e in events if e.get('name') == 'request.access']
+    assert len(access) == 1, 'exactly one access-log record per client request'
+    assert access[0]['args']['status'] == 200 and access[0]['args']['route'] == '/v1/infer'
+    assert access[0]['args']['trace_id'] == client_tid
+    legs = [e for e in events if e.get('name') == 'router.leg' and e['args'].get('trace_id') == client_tid]
+    assert len(legs) == 2
+    cancelled = [e for e in legs if e['args'].get('cancelled')]
+    winners = [e for e in legs if not e['args'].get('cancelled')]
+    assert len(cancelled) == 1 and cancelled[0]['args']['replica'] == 'slow'
+    assert len(winners) == 1 and winners[0]['args']['replica'] == 'fast'
+    # both legs hang off the same router.request span
+    parents = {e['args'].get('parent_id') for e in legs}
+    assert len(parents) == 1
+    # ...and forwarded the adopted trace id with distinct per-leg span ids
+    seen = [telemetry.parse_traceparent(tp) for tp in slow.traceparents + fast.traceparents if tp]
+    assert len(seen) == 2
+    assert {p[0] for p in seen} == {client_tid}
+    assert seen[0][1] != seen[1][1]
+
+
+def test_metrics_fleet_federates_replica_scrapes():
+    """``GET /metrics/fleet`` aggregates every replica's ``/metrics`` plus
+    the router's own registry into one valid OpenMetrics document with
+    ``replica=``-labeled samples and exemplars passed through intact."""
+    from da4ml_tpu.telemetry.obs import validate_openmetrics
+
+    r0 = _FakeReplica()
+    r1 = _FakeReplica()
+    router = Router(replicas={'r0': r0.url, 'r1': r1.url}, default_deadline_ms=5000.0)
+    server = RouterServer(router)
+    try:
+        with urllib.request.urlopen(server.url + '/metrics/fleet', timeout=10.0) as resp:
+            assert resp.status == 200
+            assert 'openmetrics' in resp.headers['Content-Type']
+            fed = resp.read().decode()
+    finally:
+        server.close()
+        r0.close()
+        r1.close()
+    validate_openmetrics(fed)
+    assert fed.count('da4ml_serve_requests_total{replica=') == 2
+    for rid in ('r0', 'r1', 'router'):
+        assert f'replica="{rid}"' in fed
+    # exemplars survive federation (one per scraped replica)
+    assert fed.count('# {trace_id="feedface"}') == 2
+    # the scrape is itself metered, and those families ride the same doc
+    assert _counter('router.scrape.errors') == 0
+    assert telemetry.metrics_snapshot()['router.scrape.replicas']['value'] == 2
+    assert 'da4ml_router_scrape_replicas{replica="router"} 2' in fed
 
 
 def test_retryable_status_rotates_to_next_replica():

@@ -571,3 +571,151 @@ def test_budgets_defaults_and_rule_matching():
     assert budgets.rule_for('configs.c3.jax_rate') == {'max_drop_pct': 5.0}
     assert budgets.rule_for('configs.c3.other') is None
     assert budgets.defaults['rate_drop_pct'] == 50.0
+
+
+# ---------------------------------------------------------------------------
+# exemplars + federation + fleet trace merge (docs/observability.md#fleet-tracing)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exemplar_renders_and_validates():
+    from da4ml_tpu.telemetry.metrics import enable_metrics
+
+    enable_metrics()
+    tid = telemetry.new_trace_id()
+    telemetry.histogram('serve.latency_s').observe(0.011, trace_id=tid)
+    telemetry.histogram('serve.latency_s').observe(0.012)  # no exemplar
+    text = render_openmetrics()
+    assert ('# {trace_id="%s"} 0.011' % tid) in text
+    fams = validate_openmetrics(text)  # exemplar suffix passes the grammar
+    assert fams['da4ml_serve_latency_seconds']['type'] == 'histogram'
+
+
+@pytest.mark.parametrize(
+    'bad',
+    [
+        # exemplar on a gauge sample
+        '# HELP da4ml_g g\n# TYPE da4ml_g gauge\nda4ml_g 1 # {trace_id="x"} 1 1\n# EOF\n',
+        # exemplar on a histogram _sum sample (only _bucket may carry one)
+        '# HELP da4ml_h h\n# TYPE da4ml_h histogram\n'
+        'da4ml_h_bucket{le="+Inf"} 1\nda4ml_h_sum 1 # {trace_id="x"} 1\nda4ml_h_count 1\n# EOF\n',
+        # exemplar label set beyond the 128-char OpenMetrics bound
+        '# HELP da4ml_c c\n# TYPE da4ml_c counter\nda4ml_c_total 1 # {trace_id="' + 'a' * 130 + '"} 1\n# EOF\n',
+        # malformed exemplar label pair
+        '# HELP da4ml_c c\n# TYPE da4ml_c counter\nda4ml_c_total 1 # {notquoted} 1\n# EOF\n',
+    ],
+)
+def test_validator_rejects_bad_exemplars(bad):
+    with pytest.raises(ValueError):
+        validate_openmetrics(bad)
+
+
+def test_validator_accepts_counter_exemplar():
+    ok = '# HELP da4ml_c c\n# TYPE da4ml_c counter\nda4ml_c_total 5 # {trace_id="ab12"} 1 1700000000.5\n# EOF\n'
+    fams = validate_openmetrics(ok)
+    assert fams['da4ml_c']['samples']['da4ml_c_total'] == 5.0
+
+
+def test_federate_metrics_labels_sources_and_validates():
+    from da4ml_tpu.serve.router import federate_metrics
+    from da4ml_tpu.telemetry.metrics import enable_metrics
+
+    enable_metrics()
+    tid = telemetry.new_trace_id()
+    telemetry.counter('solve.calls').inc(2)
+    telemetry.histogram('serve.latency_s').observe(0.02, trace_id=tid)
+    text = render_openmetrics()
+    fed = federate_metrics({'r0': text, 'r1': text, 'router': text})
+    fams = validate_openmetrics(fed)  # one HELP/TYPE per family, no interleaving
+    # every source's samples survive, labeled with their origin
+    assert fed.count('da4ml_solve_calls_total{replica=') == 3
+    for rid in ('r0', 'r1', 'router'):
+        assert f'replica="{rid}"' in fed
+    # exemplars pass through federation intact
+    assert fed.count('# {trace_id="%s"}' % tid) == 3
+    assert fams['da4ml_solve_calls']['samples']['da4ml_solve_calls_total{replica="r0"}'] == 2.0
+
+
+def _write_trace(path, pid, unix_time_us, events):
+    lines = [{'name': 'clock_sync', 'ph': 'M', 'ts': 0.0, 'pid': pid, 'tid': 0, 'args': {'unix_time_us': unix_time_us}}]
+    lines += [dict(ev, pid=pid, tid=ev.get('tid', 0)) for ev in events]
+    path.write_text('\n'.join(json.dumps(ln) for ln in lines) + '\n')
+
+
+def test_merge_traces_aligns_clocks_and_indexes_by_trace_id(tmp_path):
+    from da4ml_tpu.telemetry.obs.collect import merge_traces, write_merged
+
+    tid = 'ab' * 16
+    # same local ts=10us in both files, but process 2's wall clock anchor is
+    # 1s later: after alignment its span must land 1s later on the shared axis
+    _write_trace(
+        tmp_path / 'r0-0.jsonl', 101, 5_000_000.0,
+        [{'name': 'serve.request', 'ph': 'X', 'ts': 10.0, 'dur': 50.0, 'args': {'span_id': 1, 'trace_id': tid}}],
+    )
+    _write_trace(
+        tmp_path / 'router.jsonl', 202, 6_000_000.0,
+        [{'name': 'router.leg', 'ph': 'X', 'ts': 10.0, 'dur': 30.0, 'args': {'span_id': 2, 'trace_id': tid}},
+         {'name': 'unrelated', 'ph': 'X', 'ts': 1.0, 'dur': 1.0, 'args': {'span_id': 3}}],
+    )
+    report = merge_traces(sorted(tmp_path.glob('*.jsonl')))
+    assert report['max_processes_per_trace'] == 2
+    t = report['traces'][tid]
+    assert t['n_spans'] == 2 and t['pids'] == [101, 202]
+    assert set(t['names']) == {'serve.request', 'router.leg'}
+    evs = {e['args']['span_id']: e for e in report['doc']['traceEvents'] if e.get('ph') == 'X'}
+    assert evs[2]['ts'] - evs[1]['ts'] == pytest.approx(1_000_000.0)  # clock offset applied
+    names = [e['args']['name'] for e in report['doc']['traceEvents'] if e.get('name') == 'process_name']
+    assert any('r0-0' in n for n in names) and any('router' in n for n in names)
+    out = tmp_path / 'merged.json'
+    write_merged(report, out)
+    doc = json.loads(out.read_text())
+    assert doc['otherData']['sources'][0]['aligned'] is True
+    # the merged document round-trips through the standard loader
+    events, _ = telemetry.load_trace(out)
+    assert len(events) == report['n_events']
+
+
+def test_load_trace_merges_multiprocess_metrics_without_double_count(tmp_path):
+    """A merged / multi-writer JSONL trace: latest snapshot per pid, then
+    summed across pids — repeated mirrors from one process never double."""
+    path = tmp_path / 'merged.jsonl'
+    lines = []
+    for pid in (11, 22):
+        for v in (1.0, 3.0):  # two mirrors per process: only the last counts
+            lines.append(
+                {'name': 'metrics', 'ph': 'M', 'ts': 2.0, 'pid': pid, 'tid': 0,
+                 'args': {'metrics': {'c.x': {'type': 'counter', 'value': v}}}}
+            )
+    path.write_text('\n'.join(json.dumps(ln) for ln in lines) + '\n')
+    _, metrics = telemetry.load_trace(path)
+    assert metrics['c.x']['value'] == 6.0
+
+
+def test_tailer_merges_multi_pid_metrics(tmp_path):
+    path = tmp_path / 'fleet.jsonl'
+    recs = [
+        {'name': 'metrics', 'ph': 'M', 'ts': 1.0, 'pid': 1, 'tid': 0,
+         'args': {'metrics': {'c.x': {'type': 'counter', 'value': 2.0}}}},
+        {'name': 'metrics', 'ph': 'M', 'ts': 2.0, 'pid': 1, 'tid': 0,
+         'args': {'metrics': {'c.x': {'type': 'counter', 'value': 5.0}}}},  # replaces pid 1's first mirror
+        {'name': 'metrics', 'ph': 'M', 'ts': 2.0, 'pid': 2, 'tid': 0,
+         'args': {'metrics': {'c.x': {'type': 'counter', 'value': 7.0}}}},
+    ]
+    path.write_text('\n'.join(json.dumps(r) for r in recs) + '\n')
+    tailer = TraceTailer(path)
+    tailer.poll()
+    assert tailer.metrics['c.x']['value'] == 12.0  # 5 (pid 1, latest) + 7 (pid 2)
+
+
+def test_merge_metrics_histograms_and_exemplars():
+    from da4ml_tpu.telemetry.obs.collect import merge_metrics
+
+    h1 = {'type': 'histogram', 'count': 2, 'sum': 0.3, 'bounds': [0.1, 1.0], 'buckets': [1, 1],
+          'min': 0.05, 'max': 0.25, 'exemplars': {'0': ['t-old', 0.05, 100.0]}}
+    h2 = {'type': 'histogram', 'count': 1, 'sum': 0.05, 'bounds': [0.1, 1.0], 'buckets': [1, 0],
+          'min': 0.05, 'max': 0.05, 'exemplars': {'0': ['t-new', 0.04, 200.0]}}
+    merged = merge_metrics({1: {'h': h1}, 2: {'h': h2}})['h']
+    assert merged['count'] == 3 and merged['buckets'] == [2, 1]
+    assert merged['sum'] == pytest.approx(0.35)
+    assert merged['min'] == 0.05 and merged['max'] == 0.25
+    assert merged['exemplars']['0'][0] == 't-new'  # newest exemplar wins

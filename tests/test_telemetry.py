@@ -145,7 +145,9 @@ def test_jsonl_sink_streams_and_appends_metrics(tmp_path):
     telemetry.counter('c.x').inc(2)
     telemetry.disable()
     lines = [json.loads(ln) for ln in path.read_text().splitlines()]
-    assert lines[0]['name'] == 'one' and lines[0]['ph'] == 'X'
+    # line 0 is the clock anchor the fleet collector aligns processes with
+    assert lines[0]['name'] == 'clock_sync' and lines[0]['args']['unix_time_us'] > 0
+    assert lines[1]['name'] == 'one' and lines[1]['ph'] == 'X'
     assert lines[-1]['ph'] == 'M' and lines[-1]['args']['metrics']['c.x']['value'] == 2.0
     events, metrics = telemetry.load_trace(path)
     telemetry.validate_trace(events)
@@ -422,3 +424,101 @@ def test_log_records_mirrored_into_trace(tmp_path):
     events, _ = telemetry.load_trace(path)
     warn = [e for e in events if e['name'] == 'log.warning']
     assert warn and warn[0]['args']['message'] == 'breaker opened'
+
+
+# ---------------------------------------------------------------------------
+# fleet trace context (docs/observability.md#fleet-tracing)
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_rejects():
+    tid = telemetry.new_trace_id()
+    sid = telemetry.new_span_id()
+    assert len(tid) == 32 and int(tid, 16) != 0
+    assert isinstance(sid, int) and sid > 0
+    hdr = telemetry.format_traceparent(tid, sid)
+    assert hdr == f'00-{tid}-{sid:016x}-01'
+    assert telemetry.parse_traceparent(hdr) == (tid, sid)
+    # malformed inputs all map to None (caller mints a fresh context)
+    assert telemetry.parse_traceparent(None) is None
+    assert telemetry.parse_traceparent('') is None
+    assert telemetry.parse_traceparent('not-a-header') is None
+    assert telemetry.parse_traceparent('01-' + 'a' * 32 + '-' + 'b' * 16 + '-01') is None  # unknown version
+    assert telemetry.parse_traceparent('00-' + '0' * 32 + '-' + 'b' * 16 + '-01') is None  # all-zero trace id
+    assert telemetry.parse_traceparent('00-' + 'a' * 30 + '-' + 'b' * 16 + '-01') is None  # short trace id
+    assert telemetry.parse_traceparent('00-' + 'g' * 32 + '-' + 'b' * 16 + '-01') is None  # non-hex
+    # all-zero parent span id -> valid context with no remote parent
+    assert telemetry.parse_traceparent('00-' + 'a' * 32 + '-' + '0' * 16 + '-01') == ('a' * 32, None)
+
+
+def test_bind_trace_attaches_trace_id_and_remote_parent(tmp_path):
+    path = tmp_path / 'trace.jsonl'
+    telemetry.enable(path)
+    tid = 'ab' * 16
+    with telemetry.bind_trace(tid, 0xBEEF):
+        assert telemetry.current_trace_id() == tid
+        with telemetry.span('root_here'):
+            with telemetry.span('child'):
+                pass
+        telemetry.instant('tick')
+    assert telemetry.current_trace() is None  # restored on exit
+    telemetry.disable()
+    events, _ = telemetry.load_trace(path)
+    by = {e['name']: e for e in events}
+    # the in-process root adopts the remote caller's span as parent
+    assert by['root_here']['args']['trace_id'] == tid
+    assert by['root_here']['args']['parent_id'] == 0xBEEF
+    # nested spans keep in-thread parentage but share the trace id
+    assert by['child']['args']['trace_id'] == tid
+    assert by['child']['args']['parent_id'] == by['root_here']['args']['span_id']
+    # instants under a binding are taggable too
+    assert by['tick']['args']['trace_id'] == tid
+
+
+def test_bind_trace_mints_when_unset_and_span_ids_are_ints():
+    with telemetry.bind_trace() as tb:
+        assert len(tb.trace_id) == 32 and int(tb.trace_id, 16) != 0
+        assert tb.parent_span_id is None
+    d = json.loads(json.dumps({'trace_span_id': telemetry.new_span_id()}))
+    assert isinstance(d['trace_span_id'], int)  # span ids stay ints on the wire
+
+
+def test_fork_reseeds_span_id_epoch():
+    """Regression: a forked child must not mint span ids colliding with the
+    parent's sequence — the per-process epoch is re-seeded after fork."""
+    if not hasattr(os, 'fork'):
+        pytest.skip('platform has no fork')
+    import multiprocessing
+
+    ctx = multiprocessing.get_context('fork')
+    q = ctx.SimpleQueue()
+
+    def child(out):
+        out.put((os.getpid(), [telemetry.new_span_id() for _ in range(4)]))
+
+    parent_ids = [telemetry.new_span_id() for _ in range(4)]
+    p = ctx.Process(target=child, args=(q,))
+    p.start()
+    child_pid, child_ids = q.get()
+    p.join(10)
+    assert child_pid != os.getpid()
+    assert (child_ids[0] >> 32) != (parent_ids[0] >> 32), 'child kept the parent epoch'
+    assert not set(parent_ids) & set(child_ids)
+
+
+def test_emit_span_and_monotonic_mapping(tmp_path):
+    from da4ml_tpu.telemetry.core import monotonic_ts_us
+
+    path = tmp_path / 'trace.jsonl'
+    telemetry.enable(path)
+    t0 = time.monotonic()
+    sid = telemetry.emit_span('seg', monotonic_ts_us(t0), 0.002, trace_id='cd' * 16, parent_id=7, rows=3)
+    telemetry.disable()
+    assert sid > 0
+    events, _ = telemetry.load_trace(path)
+    seg = next(e for e in events if e['name'] == 'seg')
+    assert seg['ph'] == 'X' and seg['dur'] == pytest.approx(2000.0)
+    assert seg['args']['trace_id'] == 'cd' * 16 and seg['args']['parent_id'] == 7
+    assert seg['args']['span_id'] == sid and seg['args']['rows'] == 3
+    # disabled path: no sink -> no event, sentinel 0 id
+    assert telemetry.emit_span('seg', 0.0, 0.1) == 0
