@@ -269,10 +269,13 @@ def _run_inference_micro(limited: bool):
     mode_data = data[:mode_n]
     host_ref = out_host[:mode_n]
     modes = {}
-    for m in ('unroll', 'scan', 'level'):
+    for m in ('unroll', 'scan', 'level', 'pallas'):
         try:
             t0 = time.perf_counter()
             exm = DaisExecutor(prog, mode=m)
+            if m == 'pallas' and exm.mode != 'pallas':
+                modes[m] = {'skipped': 'pallas unavailable (fell back to level)'}
+                continue
             out_m = exm(mode_data)  # first call pays the compile
             compile_s = time.perf_counter() - t0
             t0 = time.perf_counter()
@@ -419,6 +422,29 @@ def _run_fusion_workloads(limited: bool) -> dict:
             t0 = time.perf_counter()
             outs[key] = fn()
             timed[key] = time.perf_counter() - t0
+
+        # pallas column: the same IR-fused program through ONE mega-kernel
+        # (interpret mode on CPU runners — the rate only means much on an
+        # accelerator, but bit_exact is gated everywhere)
+        from da4ml_tpu.runtime.jax_backend import fused_executor_for_binaries
+
+        pallas_entry = None
+        try:
+            ex_p = fused_executor_for_binaries(chain, mode='pallas')
+            if ex_p.mode == 'pallas':
+                ex_p(data)  # first call pays the compile
+                t0 = time.perf_counter()
+                outs['pallas'] = ex_p(data)
+                timed['pallas'] = time.perf_counter() - t0
+                pallas_entry = {
+                    'pallas_rate': round(n_samples / timed['pallas'], 1),
+                    'pallas_vs_level': round(timed['fused_ir'] / timed['pallas'], 3),
+                    'pallas_bit_exact': bool(np.array_equal(outs['pallas'], golden)),
+                }
+            else:
+                pallas_entry = {'pallas_skipped': 'pallas unavailable (fell back to level)'}
+        except Exception as e:
+            pallas_entry = {'pallas_error': f'{type(e).__name__}: {e}'[:160]}
         entries[wname] = {
             'stages': len(pipe.stages),
             'n_in': n_in,
@@ -431,6 +457,7 @@ def _run_fusion_workloads(limited: bool) -> dict:
             'hostloop_rate': round(n_samples / timed['hostloop'], 1),
             'fused_ir_vs_chained': round(timed['chained'] / timed['fused_ir'], 3),
             'bit_exact': bool(all(np.array_equal(outs[k], golden) for k in outs)),
+            **(pallas_entry or {}),
         }
     return entries
 

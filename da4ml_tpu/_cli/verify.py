@@ -63,7 +63,7 @@ def add_verify_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument('--seed', type=int, default=0, help='base seed for --fuzz / --conformance inputs')
     parser.add_argument('--samples', type=int, default=64, help='input samples per program for conformance runs')
     parser.add_argument(
-        '--modes', default=None, help='comma-separated backend modes for conformance (default: numpy,unroll,scan,level)'
+        '--modes', default=None, help='comma-separated backend modes for conformance (default: numpy,unroll,scan,level,pallas)'
     )
     parser.add_argument('--out', type=Path, default=None, help='write the --fuzz JSON report to this path')
 
@@ -98,7 +98,15 @@ def _schedule_stats(program) -> list[dict]:
     per = []
     for st in stages:
         s = levelize_comb(st)
-        per.append({'n_ops': len(st.ops), 'depth': s.depth, 'width_max': s.width_max, 'width_mean': round(s.width_mean, 1)})
+        per.append(
+            {
+                'n_ops': len(st.ops),
+                'depth': s.depth,
+                'width_max': s.width_max,
+                'width_mean': round(s.width_mean, 1),
+                'peak_live': s.peak_live,
+            }
+        )
     return per
 
 
@@ -120,6 +128,7 @@ def _fused_stats(program) -> dict | None:
         'depth_chained': rep.depth_before,
         'width_max': s.width_max,
         'width_mean': round(s.width_mean, 1),
+        'peak_live': s.peak_live,
     }
 
 
@@ -258,7 +267,10 @@ def verify_main(args: argparse.Namespace) -> int:
         if not args.as_json:
             print(result.format_text(show_warnings=not args.no_warnings))
             for i, s in enumerate(entry.get('schedule', [])):
-                print(f'  stage {i}: {s["n_ops"]} ops, schedule depth {s["depth"]}, mean level width {s["width_mean"]}')
+                print(
+                    f'  stage {i}: {s["n_ops"]} ops, schedule depth {s["depth"]}, '
+                    f'mean level width {s["width_mean"]}, peak live window {s["peak_live"]}'
+                )
             if fused_stats is not None:
                 f = fused_stats
                 fd = entry['fused']

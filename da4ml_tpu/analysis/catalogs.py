@@ -60,6 +60,9 @@ KNOBS: dict[str, str] = {
     'DA4ML_LOG_LEVEL': 'library log level (`debug`/`info`/`warning`/...)',
     'DA4ML_METRICS_PORT': 'start the observability endpoint on this port (`0` = ephemeral)',
     'DA4ML_NO_NATIVE_BUILD': '`1` skips building the native extension (pure-python/jax only)',
+    'DA4ML_PALLAS_AUTOTUNE': '`1` forces the pallas candidate into autotune races even on interpret-only platforms',
+    'DA4ML_PALLAS_INTERPRET': 'force (`1`) / forbid (`0`) pallas interpret mode instead of auto-detecting by platform',
+    'DA4ML_PALLAS_VMEM': 'VMEM budget (bytes) the pallas mega-kernel sizes its sample block against',
     'DA4ML_PROFILE': 'arm `jax.profiler` and write device profiles to this directory',
     'DA4ML_RUN_AUTOTUNE': '`0` disables runtime execution-mode autotuning',
     'DA4ML_RUN_AUTOTUNE_BATCH': 'sample rows per autotune probe',

@@ -2,7 +2,7 @@
 table-generated reference interpreter.
 
 Every runtime backend (numpy oracle, jax ``unroll`` / ``scan`` / ``level``
-modes) promises bit-exactness with the DAIS v1 semantics. This pass makes
+/ ``pallas`` modes) promises bit-exactness with the DAIS v1 semantics. This pass makes
 that promise checkable: it executes a program through each backend and
 compares outputs bit-wise against ``runtime.reference`` — the interpreter
 generated from the declarative opcode table (``ir/optable.py``). A
@@ -32,8 +32,9 @@ from ..ir.optable import DAIS_V1_OPCODES, OPCODE_TO_SPEC, family_of
 from ..ir.synth import random_inputs, random_program
 from .diagnostics import Diagnostic
 
-#: execution targets differentially checked against the reference
-CONFORMANCE_MODES = ('numpy', 'unroll', 'scan', 'level')
+#: execution targets differentially checked against the reference;
+#: pallas runs interpret mode on CPU and compiled on TPU/GPU
+CONFORMANCE_MODES = ('numpy', 'unroll', 'scan', 'level', 'pallas')
 
 
 def _as_prog(program) -> DaisProgram:
@@ -79,6 +80,11 @@ def check_conformance(
     for mode in modes:
         if mode == 'unroll' and prog.n_ops > DaisExecutor.UNROLL_LIMIT:
             continue  # unroll refuses by design; not a conformance failure
+        if mode == 'pallas':
+            from ..runtime.pallas_backend import unavailable_reason
+
+            if unavailable_reason(prog) is not None:
+                continue  # pallas/jaxlib absent or family unlowered; fallback, not a failure
         try:
             got, got_buf = _run_mode(prog, mode, data)
         except Exception as e:  # a backend crash on a valid program is a divergence

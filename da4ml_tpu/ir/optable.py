@@ -16,6 +16,10 @@ Each :class:`OpSpec` row describes one opcode family completely:
   (``shift_of``) consumed by ``analysis.wellformed``;
 - **vectorization class**: the branch id the scan/level runtime kernels
   group by (``runtime.jax_backend``);
+- **pallas lowering**: the in-kernel emitter name the generated Pallas
+  mega-kernel backend dispatches the row by
+  (``runtime.pallas_backend.LOWERINGS``; its import-time audit fails on a
+  row without a registered emitter);
 - **cost/latency model** and **payload layout** notes (rendered into
   ``docs/dais.md`` by ``analysis.docgen``);
 - **fuzz coverage**: the ``ir.synth`` generator family that emits the row
@@ -659,6 +663,7 @@ class OpSpec(NamedTuple):
     cond_in_data: bool  # low 32 bits of ``data`` name an earlier slot
     defines_container: bool  # annotation is trusted as the result interval
     vector_class: int  # runtime dispatch branch (scan switch / level group)
+    pallas_lower: str  # runtime/pallas_backend.LOWERINGS emitter for this row
     synth_family: str | None  # ir/synth.py generator family (None: implicit)
     semantics: str  # docs: concrete semantics
     payload: str  # docs: layout of ``data``
@@ -682,6 +687,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=True,
         vector_class=0,
+        pallas_lower='copy',
         synth_family=None,  # every synth program emits one copy per input
         semantics='copy from input lane `id0` (implies quantization to the slot kif)',
         payload='unused',
@@ -703,6 +709,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=False,
         vector_class=1,
+        pallas_lower='addsub',
         synth_family='add',
         semantics='`buf[id0] ± buf[id1] * 2**data`',
         payload='`data` = power-of-two shift of the second operand',
@@ -727,6 +734,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=True,
         vector_class=2,
+        pallas_lower='relu',
         synth_family='relu',
         semantics='`quantize(relu(±buf[id0]))`',
         payload='unused',
@@ -754,6 +762,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=True,
         vector_class=3,
+        pallas_lower='quantize',
         synth_family='quant',
         semantics='`quantize(±buf[id0])` (arithmetic shift + modular wrap)',
         payload='unused',
@@ -781,6 +790,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=False,
         vector_class=4,
+        pallas_lower='const_add',
         synth_family='cadd',
         semantics='`buf[id0] + data * qint.step` (constant add)',
         payload='`data` = signed constant in result-step units',
@@ -808,6 +818,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=False,
         vector_class=5,
+        pallas_lower='const',
         synth_family='const',
         semantics='constant definition: `data * qint.step`',
         payload='`data` = signed constant in step units',
@@ -835,6 +846,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=True,
         defines_container=True,
         vector_class=6,
+        pallas_lower='msb_mux',
         synth_family='mux',
         semantics='MSB mux: `msb(buf[cond]) ? buf[id0] : (±buf[id1]) << shift`',
         payload='`data` packs `shift[63:32]` (signed) and `cond[31:0]` (slot index)',
@@ -856,6 +868,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=False,
         vector_class=7,
+        pallas_lower='mul',
         synth_family='mul',
         semantics='`buf[id0] * buf[id1]` (explicit multiplier, e.g. offloaded weights)',
         payload='unused',
@@ -883,6 +896,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=True,
         vector_class=8,
+        pallas_lower='lookup',
         synth_family='lookup',
         semantics='`lookup_tables[data][index(buf[id0])]`',
         payload='`data` = table index (binary stream adds `pad_left[63:32]`)',
@@ -904,6 +918,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=True,
         vector_class=9,
+        pallas_lower='bit_unary',
         synth_family='bitu',
         semantics='unary bitwise on `±buf[id0]`; `data`: 0 = NOT, 1 = OR-reduce, 2 = AND-reduce',
         payload='`data` = sub-opcode (0/1/2)',
@@ -925,6 +940,7 @@ OP_TABLE: tuple[OpSpec, ...] = (
         cond_in_data=False,
         defines_container=True,
         vector_class=10,
+        pallas_lower='bit_binary',
         synth_family='bitb',
         semantics='binary bitwise AND/OR/XOR on aligned operands',
         payload='`data` packs `subop[63:56]`, `neg1[33]`, `neg0[32]`, `shift[31:0]` (signed)',
@@ -953,6 +969,12 @@ COPY_OPCODES = frozenset(oc for oc, spec in OPCODE_TO_SPEC.items() if spec.id0 =
 
 #: opcode -> runtime vectorization class (scan switch branch / level group)
 VECTOR_CLASS: dict[int, int] = {oc: spec.vector_class for oc, spec in OPCODE_TO_SPEC.items()}
+
+#: opcode -> pallas mega-kernel lowering emitter name: the registry key the
+#: generated backend (``runtime.pallas_backend.LOWERINGS``) dispatches each
+#: (level, family) group by — a table row without a registered emitter fails
+#: that module's import-time audit, exactly like a row without synth coverage
+PALLAS_LOWER: dict[int, str] = {oc: spec.pallas_lower for oc, spec in OPCODE_TO_SPEC.items()}
 
 
 def spec_of(opcode: int) -> OpSpec | None:
@@ -998,6 +1020,7 @@ __all__ = [
     'BINARY_OPCODES',
     'COPY_OPCODES',
     'VECTOR_CLASS',
+    'PALLAS_LOWER',
     'SHIFT_LIMIT',
     'OpSpec',
     'MutationSpec',

@@ -13,8 +13,11 @@ Consumers:
   family) group as a handful of vectorized primitives instead of one op at
   a time — compile cost O(depth × families), runtime vectorized over
   ops × samples;
-- ``da4ml-tpu verify`` reports the schedule depth / mean level width per
-  program (a quick read on how parallel a program is);
+- ``runtime/pallas_backend`` (``mode='pallas'``) walks the same packed
+  groups inside ONE Pallas mega-kernel and sizes its VMEM operand block
+  from the schedule's ``peak_live`` operand-liveness window;
+- ``da4ml-tpu verify`` reports the schedule depth / mean level width /
+  peak live window per program (a quick read on how parallel a program is);
 - codegen pipelining can cut stages on level boundaries (levels are exactly
   the combinational rank of each op).
 
@@ -39,11 +42,19 @@ class LevelSchedule(NamedTuple):
     ``order`` is a permutation of op indices sorted by (level, sort_key,
     index); ``starts`` bounds each level within ``order`` so level ``l``
     occupies ``order[starts[l]:starts[l+1]]``.
+
+    ``first_use`` / ``last_use`` carry per-slot operand liveness: the
+    earliest / latest op index that *reads* slot ``i`` (-1 when no op reads
+    it — dead code, or a slot only consumed by the program's outputs, which
+    this graph-level view cannot see and which the runtime keeps live to the
+    end regardless).
     """
 
     level: NDArray[np.int32]  # (n_ops,) dependency depth per op
     order: NDArray[np.int32]  # (n_ops,) packed execution order
     starts: NDArray[np.int64]  # (depth+1,) level boundaries within `order`
+    first_use: NDArray[np.int32]  # (n_ops,) first consumer op index (-1: none)
+    last_use: NDArray[np.int32]  # (n_ops,) last consumer op index (-1: none)
 
     @property
     def depth(self) -> int:
@@ -61,6 +72,23 @@ class LevelSchedule(NamedTuple):
     @property
     def width_mean(self) -> float:
         return float(len(self.level) / self.depth) if self.depth else 0.0
+
+    @property
+    def peak_live(self) -> int:
+        """Peak operand-liveness window: the most slots simultaneously live
+        across any level — slot ``i`` is live from its defining level through
+        the level of its last consumer (its own level when never read). The
+        pallas mega-kernel backend sizes its VMEM operand block from this
+        footprint, and ``da4ml-tpu verify`` reports it next to depth/width.
+        """
+        if not self.depth:
+            return 0
+        lvl = self.level.astype(np.int64)
+        end = np.where(self.last_use >= 0, lvl[np.maximum(self.last_use, 0)], lvl)
+        delta = np.zeros(self.depth + 1, dtype=np.int64)
+        np.add.at(delta, lvl, 1)
+        np.add.at(delta, end + 1, -1)
+        return int(np.cumsum(delta[:-1]).max())
 
 
 def levelize(
@@ -115,7 +143,26 @@ def levelize(
     depth = int(level.max()) + 1 if n else 0
     counts = np.bincount(level, minlength=depth) if n else np.zeros(0, dtype=np.int64)
     starts = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
-    return LevelSchedule(level=level, order=order, starts=starts)
+
+    # per-slot operand liveness: every (consumer, operand) edge, vectorized
+    d0a = np.asarray(id0, dtype=np.int64)
+    d1a = np.asarray(id1, dtype=np.int64)
+    dca = np.asarray(cond, dtype=np.int64) if cond is not None else np.zeros(n, dtype=np.int64)
+    readers = np.concatenate([np.flatnonzero(uses0), np.flatnonzero(uses1), np.flatnonzero(usesc if cond is not None else np.zeros(n, bool))])
+    operands = np.concatenate([d0a[uses0], d1a[uses1], dca[usesc] if cond is not None else np.zeros(0, np.int64)])
+    first_use = np.full(n, n, dtype=np.int64)
+    last_use = np.full(n, -1, dtype=np.int64)
+    if len(operands):
+        np.minimum.at(first_use, operands, readers)
+        np.maximum.at(last_use, operands, readers)
+    first_use[first_use == n] = -1
+    return LevelSchedule(
+        level=level,
+        order=order,
+        starts=starts,
+        first_use=first_use.astype(np.int32),
+        last_use=last_use.astype(np.int32),
+    )
 
 
 def levelize_program(prog, sort_key: NDArray | None = None) -> LevelSchedule:

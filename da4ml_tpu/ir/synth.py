@@ -39,6 +39,17 @@ _stale = [f for f in FAMILIES if f not in {spec.synth_family for spec in OP_TABL
 if _stale:
     raise RuntimeError(f'ir.synth families without an opcode-table row: {_stale}')
 
+# backend-lowering audit (same spirit): every table row must name its pallas
+# emitter so the fuzz corpus above actually exercises the mega-kernel backend.
+# runtime/pallas_backend re-checks the name against its LOWERINGS registry at
+# its own import; this gate catches a blank column without importing jax.
+_unlowered = [spec.key for spec in OP_TABLE if not spec.pallas_lower]
+if _unlowered:
+    raise RuntimeError(
+        f'opcode table rows without a pallas_lower emitter name: {_unlowered}; '
+        f'add a lowering to runtime/pallas_backend.LOWERINGS and name it in the table'
+    )
+
 # fusion coverage audit (same spirit): every opcode this generator can emit
 # must be one ir.fuse knows how to rebase across a stage boundary, or the
 # multi-stage corpus would fuzz pipelines the fuse pass rejects at runtime.

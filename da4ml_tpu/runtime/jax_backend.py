@@ -58,7 +58,7 @@ from ..ir.schedule import levelize_program
 from ..telemetry.obs import profile as _prof
 
 #: concrete execution modes (``'auto'`` resolves to one of these)
-MODES = ('unroll', 'scan', 'level')
+MODES = ('unroll', 'scan', 'level', 'pallas')
 
 
 def _shl(v, s: int):
@@ -231,13 +231,15 @@ def _wrap_packed(raw, n_in: int, n_out: int, in_g: int, out_g: int, dtype):
 # digest next to the PR-4 persistent XLA compile cache
 # ---------------------------------------------------------------------------
 
-_MODE_DECISIONS: dict[str, str] = {}
+_MODE_DECISIONS: dict[tuple[str, str], str] = {}
 
 
 def mode_decisions() -> dict[str, str]:
-    """In-process autotune decisions (program digest -> mode), as shown by
-    the ``/statusz`` endpoint (docs/observability.md)."""
-    return dict(_MODE_DECISIONS)
+    """In-process autotune decisions (``digest@platform`` -> mode), as shown
+    by the ``/statusz`` endpoint (docs/observability.md). Decisions are keyed
+    by (program digest, backend platform): a mode measured on cpu must never
+    shadow the right answer on tpu."""
+    return {f'{d}@{p}': mode for (d, p), mode in _MODE_DECISIONS.items()}
 
 
 def _mode_cache_dir() -> str | None:
@@ -259,34 +261,49 @@ def _mode_cache_dir() -> str | None:
     return path
 
 
-def _load_mode_decision(digest: str) -> str | None:
-    mode = _MODE_DECISIONS.get(digest)
+def _platform() -> str:
+    """Backend platform half of the decision-cache key (cpu/gpu/tpu)."""
+    try:
+        return str(jax.default_backend())
+    except Exception:  # pragma: no cover - backend probing failed
+        return 'unknown'
+
+
+def _decision_path(d: str, digest: str, platform: str) -> str:
+    # platform is an explicit key component, not folded into the digest: a
+    # decision measured on cpu must never answer for the same program on tpu
+    return os.path.join(d, f'{digest}.{platform}.json')
+
+
+def _load_mode_decision(digest: str, platform: str) -> str | None:
+    mode = _MODE_DECISIONS.get((digest, platform))
     if mode:
         return mode
     d = _mode_cache_dir()
     if not d:
         return None
     try:
-        with open(os.path.join(d, digest + '.json')) as fh:
-            mode = json.load(fh).get('mode')
+        with open(_decision_path(d, digest, platform)) as fh:
+            blob = json.load(fh)
     except (OSError, ValueError):
         return None
-    if mode in MODES:
-        _MODE_DECISIONS[digest] = mode
+    mode = blob.get('mode')
+    if mode in MODES and blob.get('platform', platform) == platform:
+        _MODE_DECISIONS[(digest, platform)] = mode
         return mode
     return None
 
 
-def _store_mode_decision(digest: str, mode: str, info: dict) -> None:
-    _MODE_DECISIONS[digest] = mode
+def _store_mode_decision(digest: str, platform: str, mode: str, info: dict) -> None:
+    _MODE_DECISIONS[(digest, platform)] = mode
     d = _mode_cache_dir()
     if not d:
         return
-    path = os.path.join(d, digest + '.json')
+    path = _decision_path(d, digest, platform)
     tmp = f'{path}.tmp{os.getpid()}'
     try:
         with open(tmp, 'w') as fh:
-            json.dump({'mode': mode, **info}, fh)
+            json.dump({'mode': mode, 'platform': platform, **info}, fh)
         os.replace(tmp, path)
     except OSError:  # pragma: no cover - unwritable cache dir
         pass
@@ -378,10 +395,12 @@ class DaisExecutor:
         self.use_i64 = wide if force_i64 is None else force_i64
         self.dtype = jnp.int64 if self.use_i64 else jnp.int32
         if mode not in ('auto', *MODES):
-            raise ValueError(f"mode must be 'auto', 'unroll', 'scan' or 'level', got {mode!r}")
+            raise ValueError(f"mode must be 'auto', 'unroll', 'scan', 'level' or 'pallas', got {mode!r}")
         env_mode = os.environ.get('DA4ML_RUN_MODE', '').strip().lower()
         if mode == 'auto' and env_mode in MODES:
             mode = env_mode
+        if mode == 'pallas':
+            mode = self._pallas_or_fallback(prog)
         prejit = None
         with self._x64():
             self._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in prog.tables)
@@ -422,7 +441,31 @@ class DaisExecutor:
         return _x64_scope() if self.use_i64 else nullcontext()
 
     def _builders(self):
-        return {'unroll': self._build, 'scan': self._build_scan, 'level': self._build_level}
+        return {'unroll': self._build, 'scan': self._build_scan, 'level': self._build_level, 'pallas': self._build_pallas}
+
+    @staticmethod
+    def _pallas_or_fallback(prog) -> str:
+        """Resolve an explicit/env/cached ``'pallas'`` request against the
+        fallback ladder (docs/runtime.md#pallas-backend): missing pallas or
+        an unlowered family degrades to ``'level'`` with a one-time warning
+        and a ``run.pallas.fallbacks`` count instead of raising."""
+        from . import pallas_backend
+
+        reason = pallas_backend.unavailable_reason(prog)
+        if reason is None:
+            return 'pallas'
+        telemetry.counter('run.pallas.fallbacks').inc()
+        telemetry.warn_once(
+            'runtime.pallas_fallback',
+            f"mode='pallas' unavailable ({reason}); falling back to mode='level'",
+            logger='runtime.jax',
+        )
+        return 'level'
+
+    def _build_pallas(self):
+        from . import pallas_backend
+
+        return pallas_backend.build_pallas_fn(self)
 
     def _digest(self) -> str:
         """Program+environment digest keying the autotune decision cache."""
@@ -435,7 +478,10 @@ class DaisExecutor:
             h.update(np.ascontiguousarray(a, dtype=np.int64).tobytes())
         for t in prog.tables:
             h.update(np.ascontiguousarray(t, dtype=np.int64).tobytes())
-        env = f'|{prog.n_in}|{prog.n_out}|{self.use_i64}|{jax.__version__}|{jax.default_backend()}|{jax.local_device_count()}'
+        # NB: the backend platform is deliberately NOT part of the digest —
+        # it is the explicit second half of the decision-cache key
+        # (``_load_mode_decision``), so per-platform answers stay separate
+        env = f'|{prog.n_in}|{prog.n_out}|{self.use_i64}|{jax.__version__}|{jax.local_device_count()}'
         h.update(env.encode())
         return h.hexdigest()
 
@@ -458,16 +504,23 @@ class DaisExecutor:
         if os.environ.get('DA4ML_RUN_AUTOTUNE', '1').strip().lower() in ('0', 'off', 'false'):
             return ('unroll' if n_ops <= self.UNROLL_LIMIT else 'level'), None
         digest = self._digest()
-        cached = _load_mode_decision(digest)
+        platform = _platform()
+        cached = _load_mode_decision(digest, platform)
+        if cached == 'pallas':
+            # re-walk the fallback ladder: the decision may have been made on
+            # a host where pallas was importable / the row set fully lowered
+            cached = self._pallas_or_fallback(self.prog)
         if cached is not None:
             telemetry.counter('run.mode_cache_hit').inc()
             return cached, None
-        return self._autotune(digest)
+        return self._autotune(digest, platform)
 
-    def _autotune(self, digest: str):
+    def _autotune(self, digest: str, platform: str):
         """Compile the cheap candidate modes, time one warm synthetic batch
         each, pick the winner; the decision persists next to the XLA
-        compile cache keyed by the program digest."""
+        compile cache keyed by (program digest, backend platform)."""
+        from . import pallas_backend
+
         prog = self.prog
         if prog.n_ops <= self.UNROLL_LIMIT:
             # scan earns its compile on deep-but-narrow programs (e.g. IR-fused
@@ -480,6 +533,10 @@ class DaisExecutor:
                 # chain-shaped program: levels are nearly singletons, so the
                 # level build would degenerate into an unroll-sized jaxpr
                 candidates = ['scan']
+        if pallas_backend.autotune_candidate(prog):
+            # measured like any other candidate: pallas is picked only when
+            # the mega-kernel actually beats the clock on this platform
+            candidates.append('pallas')
         try:
             bsz = int(os.environ.get('DA4ML_RUN_AUTOTUNE_BATCH', '') or 4096)
         except ValueError:
@@ -492,9 +549,25 @@ class DaisExecutor:
         with telemetry.span('run.autotune', n_ops=prog.n_ops, candidates=','.join(candidates)):
             for m in candidates:
                 t0 = time.perf_counter()
-                raw = builders[m]()
-                jitted = jax.jit(raw)
-                jax.block_until_ready(jitted(x))
+                try:
+                    raw = builders[m]()
+                    jitted = jax.jit(raw)
+                    jax.block_until_ready(jitted(x))
+                except Exception as e:
+                    if m != 'pallas':
+                        raise
+                    # a pallas candidate that fails to build/compile (Mosaic
+                    # refusal, int64-on-TPU, ...) loses the race instead of
+                    # failing the executor — the other candidates still run
+                    telemetry.counter('run.pallas.fallbacks').inc()
+                    telemetry.warn_once(
+                        'runtime.pallas_autotune',
+                        f'pallas autotune candidate failed to build ({type(e).__name__}: {e}); '
+                        f'continuing with the other modes',
+                        logger='runtime.jax',
+                    )
+                    info['pallas_error'] = f'{type(e).__name__}: {e}'[:200]
+                    continue
                 compile_s = time.perf_counter() - t0
                 run_s = float('inf')  # best-of-2: one noisy sample can invert the ranking
                 for _ in range(2):
@@ -508,7 +581,7 @@ class DaisExecutor:
                     best = (run_s, m, (raw, jitted))
         _, mode, prejit = best
         telemetry.counter('run.autotune').inc()
-        _store_mode_decision(digest, mode, info)
+        _store_mode_decision(digest, platform, mode, info)
         return mode, prejit
 
     # -- kernel builders ---------------------------------------------------

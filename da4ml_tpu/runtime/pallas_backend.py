@@ -1,0 +1,577 @@
+"""Pallas mega-kernel backend for DAIS programs (``mode='pallas'``).
+
+The level-packed ``mode='level'`` executor lowers each (level, family)
+group to a chain of generic lax ops — gathers, pow2 multiplies, wrap
+tables, one ``dynamic_update_slice`` per group — and leaves XLA to fuse
+hundreds of tiny kernels, forcing the operand buffer through HBM between
+levels. This module instead *generates ONE Pallas kernel per program*:
+
+- the whole level schedule (``ir.schedule.levelize_program``) executes
+  inside a single kernel body, group by group;
+- the operand buffer is a VMEM scratch ref of shape ``(n_ops, block)`` —
+  intermediate values never round-trip HBM between levels;
+- wrap/quantize lower to in-kernel shift/mask bit ops (the same
+  shift-by-multiply + modular-wrap identities the level builder uses,
+  evaluated on VMEM-resident blocks);
+- samples tile across the grid: each grid step processes a ``block``-row
+  slab of the batch, with the block size picked from the operand-buffer
+  footprint (``DA4ML_PALLAS_VMEM`` budget, ``peak_live``-aware stats in
+  ``run.pallas.vmem_bytes``).
+
+Kernel emission is driven by the declarative opcode table: every
+:class:`~..ir.optable.OpSpec` row names its emitter via ``pallas_lower``
+and the import-time audit below fails on a row without a registered
+:data:`LOWERINGS` entry — exactly the discipline ``ir/synth.py`` applies
+to fuzz coverage. There is no per-opcode dispatch outside the table.
+
+Pallas kernels cannot close over array constants, so all per-group
+constant vectors (operand positions, pow2 multipliers, wrap moduli,
+flattened LUTs, output gather/sign vectors) are packed into one flat
+"const pool" array passed as a kernel operand; each emitter records
+slices into the pool at build time and reads them back inside the kernel.
+
+Execution is compiled on TPU and *interpreted* elsewhere
+(``interpret=True`` — bit-exact, CPU-speed; ``DA4ML_PALLAS_INTERPRET``
+overrides). The fallback ladder (docs/runtime.md#pallas-backend): missing
+``jax.experimental.pallas`` or a family without a lowering degrades a
+``mode='pallas'`` request to ``mode='level'`` with a one-time warning and
+a ``run.pallas.fallbacks`` count; the autotuner only measures the pallas
+candidate where it can compile for real.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..ir.optable import OP_TABLE
+from ..ir.schedule import levelize_program
+
+__all__ = [
+    'LOWERINGS',
+    'PallasUnavailable',
+    'build_pallas_fn',
+    'is_available',
+    'unavailable_reason',
+    'autotune_candidate',
+]
+
+#: default VMEM budget for the operand buffer + io blocks (bytes); a real
+#: TPU core has ~16 MiB of VMEM and the kernel needs headroom for the
+#: compiler's own spills, so the operand footprint targets a quarter of it
+_DEFAULT_VMEM_BUDGET = 4 * 1024 * 1024
+
+#: sample-block quantum: TPU lanes are 128 wide, so the batch tile is a
+#: multiple of 128 rows (the batch is padded up to the tile on the host)
+_BLOCK_QUANTUM = 128
+
+_MAX_BLOCK = 2048
+
+
+class PallasUnavailable(RuntimeError):
+    """``mode='pallas'`` cannot serve this program/host (fallback ladder)."""
+
+
+@lru_cache(maxsize=1)
+def _pallas_modules():
+    """(pl, pltpu) modules, or None when jax ships without pallas."""
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pl, pltpu
+    except Exception:  # pragma: no cover - jax built without pallas
+        return None
+
+
+def is_available() -> bool:
+    """Whether ``jax.experimental.pallas`` imports on this host."""
+    return _pallas_modules() is not None
+
+
+def unavailable_reason(prog) -> str | None:
+    """Why ``mode='pallas'`` cannot execute ``prog`` (None when it can).
+
+    The two rungs of the fallback ladder a caller must survive *before*
+    compiling: pallas missing from the jax build, or the program using an
+    opcode family whose table row names an unregistered lowering (drift
+    guard — the import audit makes this unreachable for in-tree rows).
+    """
+    if not is_available():
+        return 'jax.experimental.pallas is unavailable in this jax build'
+    present = np.unique(np.abs(np.asarray(prog.opcode, dtype=np.int64)))
+    for spec in OP_TABLE:
+        if spec.pallas_lower in LOWERINGS:
+            continue
+        if any(abs(oc) in present for oc in spec.opcodes):  # pragma: no cover - audit keeps this dead
+            return f'opcode family {spec.key!r} has no pallas lowering ({spec.pallas_lower!r} unregistered)'
+    return None
+
+
+def _interpret_mode() -> bool:
+    """Interpret (CPU-exact emulation) vs compile: TPU compiles, everything
+    else interprets; ``DA4ML_PALLAS_INTERPRET=0/1`` forces."""
+    env = os.environ.get('DA4ML_PALLAS_INTERPRET', '').strip().lower()
+    if env in ('1', 'on', 'true'):
+        return True
+    if env in ('0', 'off', 'false'):
+        return False
+    try:
+        return jax.default_backend() != 'tpu'
+    except Exception:  # pragma: no cover - backend probing failed
+        return True
+
+
+def autotune_candidate(prog) -> bool:
+    """Whether the measured autotuner should time a pallas candidate.
+
+    Interpret mode executes the kernel through the pallas emulator — orders
+    of magnitude slower than any compiled mode — so measuring it would only
+    burn the tuning budget to learn a foregone conclusion; the candidate
+    joins the race where it compiles for real (TPU), or when
+    ``DA4ML_PALLAS_AUTOTUNE=1`` forces the measurement (how CI demonstrates
+    the tuner never picks a slower pallas).
+    """
+    if unavailable_reason(prog) is not None:
+        return False
+    if os.environ.get('DA4ML_PALLAS_AUTOTUNE', '').strip().lower() in ('1', 'on', 'true'):
+        return True
+    return not _interpret_mode()
+
+
+# ---------------------------------------------------------------------------
+# const pool: build-time registration of per-group constant vectors
+# ---------------------------------------------------------------------------
+
+
+class _Handle:
+    """A slice of the flat const-pool operand, readable inside the kernel."""
+
+    __slots__ = ('a', 'b')
+
+    def __init__(self, a: int, b: int):
+        self.a, self.b = a, b
+
+    def of(self, c):
+        """(g,) vector view of the traced pool array."""
+        return c[self.a : self.b]
+
+    def col(self, c):
+        """(g, 1) column view — broadcasts against (g, block) value slabs."""
+        return c[self.a : self.b][:, None]
+
+
+class _ConstPool:
+    """Accumulates every constant vector the kernel needs into one flat
+    array (pallas kernels may not capture array constants — they must
+    arrive as operands)."""
+
+    def __init__(self, np_dt):
+        self._np_dt = np_dt
+        self._chunks: list[np.ndarray] = []
+        self._n = 0
+
+    def vec(self, arr) -> _Handle:
+        a = np.ascontiguousarray(np.asarray(arr).reshape(-1)).astype(self._np_dt)
+        h = _Handle(self._n, self._n + len(a))
+        self._chunks.append(a)
+        self._n += len(a)
+        return h
+
+    def array(self) -> np.ndarray:
+        if not self._chunks:
+            return np.zeros(1, self._np_dt)
+        return np.concatenate(self._chunks)
+
+
+class _Group:
+    """Build-time context handed to each row's lowering emitter: the op-meta
+    arrays (``DaisExecutor._op_meta``), the group's original op indices, the
+    const pool, and the packed-position helpers shared with the level
+    builder."""
+
+    __slots__ = ('m', 'idxs', 'pool', 'np_dt', 'dtype', 'pos', 'n_ops')
+
+    def __init__(self, m, idxs, pool, np_dt, dtype, pos, n_ops):
+        self.m = m
+        self.idxs = idxs
+        self.pool = pool
+        self.np_dt = np_dt
+        self.dtype = dtype
+        self.pos = pos
+        self.n_ops = n_ops
+
+    def pow2(self, s):
+        # two's-complement multiply ≡ left shift mod 2^width, so the wrapped
+        # pow2 constant is exact even at the top bit (same trick as level)
+        return (np.int64(1) << np.asarray(s, np.int64)).astype(self.np_dt)
+
+    def shift_consts(self, s):
+        """(multiplier, right-shift) handle pair implementing shift-by-``s``."""
+        return self.pool.vec(self.pow2(np.maximum(s, 0))), self.pool.vec(np.maximum(-s, 0))
+
+    def wrap_consts(self):
+        """(modulus, int_min) handle pair for the group's two's-complement wrap."""
+        w = self.m['w'][self.idxs].astype(np.int64)
+        sg = self.m['sg'][self.idxs].astype(np.int64)
+        mod = self.pool.vec(np.int64(1) << w)
+        imin = self.pool.vec(np.where(sg != 0, -(np.int64(1) << np.maximum(w - 1, 0)), 0))
+        return mod, imin
+
+    def sign_of(self, flags) -> _Handle:
+        return self.pool.vec(np.where(np.asarray(flags) != 0, -1, 1))
+
+    def safe_pos(self, ids):
+        """Packed buffer rows of original op ids (clipped: garbage lanes)."""
+        return self.pos[np.clip(ids, 0, max(self.n_ops - 1, 0))]
+
+    def positions(self, which: str) -> _Handle:
+        return self.pool.vec(self.safe_pos(self.m[which][self.idxs]))
+
+
+# ---------------------------------------------------------------------------
+# per-family lowering emitters, dispatched by OpSpec.pallas_lower
+#
+# Each emitter runs at build time: it registers the group's constants with
+# the pool and returns ``body(b, xT, c) -> (g, block)`` evaluated inside
+# the kernel, where ``b`` is the VMEM operand buffer read as an array,
+# ``xT`` the (n_in, block) input slab and ``c`` the traced const pool.
+# Semantics mirror DaisExecutor._build_level group for group — the
+# conformance suite holds them bit-exact against runtime/reference.py.
+# ---------------------------------------------------------------------------
+
+
+def _emit_copy(g: _Group):
+    src = g.pool.vec(g.m['id0'][g.idxs])
+    mod, imin = g.wrap_consts()
+
+    def body(b, xT, c):
+        v = jnp.take(xT, src.of(c), axis=0)
+        return ((v - imin.col(c)) % mod.col(c)) + imin.col(c)
+
+    return body
+
+
+def _emit_addsub(g: _Group):
+    p0, p1 = g.positions('id0'), g.positions('id1')
+    a = g.m['a_shift'][g.idxs]
+    l0 = g.pool.vec(g.pow2(np.maximum(-a, 0)))
+    l1 = g.pool.vec(g.pow2(np.maximum(a, 0)))
+    gs = g.pool.vec(np.maximum(g.m['g_shift'][g.idxs], 0))
+    sub = g.sign_of(g.m['issub'][g.idxs])
+
+    def body(b, xT, c):
+        x0 = jnp.take(b, p0.of(c), axis=0)
+        x1 = jnp.take(b, p1.of(c), axis=0)
+        return (x0 * l0.col(c) + x1 * sub.col(c) * l1.col(c)) >> gs.col(c)
+
+    return body
+
+
+def _shift_wrap_emitter(relu: bool):
+    def emit(g: _Group):
+        p0 = g.positions('id0')
+        neg = g.sign_of(g.m['neg'][g.idxs])
+        ql, qr = g.shift_consts(g.m['f'][g.idxs].astype(np.int64) - g.m['f0'][g.idxs].astype(np.int64))
+        mod, imin = g.wrap_consts()
+
+        def body(b, xT, c):
+            v = jnp.take(b, p0.of(c), axis=0) * neg.col(c)
+            q = ((((v * ql.col(c)) >> qr.col(c)) - imin.col(c)) % mod.col(c)) + imin.col(c)
+            return jnp.where(v < 0, jnp.zeros_like(q), q) if relu else q
+
+        return body
+
+    return emit
+
+
+def _emit_const_add(g: _Group):
+    p0 = g.positions('id0')
+    ql, qr = g.shift_consts(g.m['f'][g.idxs].astype(np.int64) - g.m['f0'][g.idxs].astype(np.int64))
+    cst = g.pool.vec(g.m['const'][g.idxs])
+
+    def body(b, xT, c):
+        x0 = jnp.take(b, p0.of(c), axis=0)
+        return ((x0 * ql.col(c)) >> qr.col(c)) + cst.col(c)
+
+    return body
+
+
+def _emit_const(g: _Group):
+    cst = g.pool.vec(g.m['const'][g.idxs])
+
+    def body(b, xT, c):
+        return jnp.broadcast_to(cst.col(c), (len(g.idxs), xT.shape[1]))
+
+    return body
+
+
+def _emit_msb_mux(g: _Group):
+    m, idxs = g.m, g.idxs
+    p0, p1 = g.positions('id0'), g.positions('id1')
+    pc = g.pool.vec(g.safe_pos(m['dlo'][idxs]))
+    neg = g.sign_of(m['neg'][idxs])
+    sgc = g.pool.vec(m['sgc'][idxs])
+    thr = g.pool.vec(g.pow2(np.maximum(m['wc'][idxs].astype(np.int64) - 1, 0)))
+    l0v, r0v = g.shift_consts(m['mux_s0'][idxs])
+    l1v, r1v = g.shift_consts(m['mux_s1'][idxs])
+    mod, imin = g.wrap_consts()
+
+    def body(b, xT, c):
+        xc = jnp.take(b, pc.of(c), axis=0)
+        cond = jnp.where(sgc.col(c) != 0, xc < 0, xc >= thr.col(c))
+        x0 = jnp.take(b, p0.of(c), axis=0)
+        v1 = jnp.take(b, p1.of(c), axis=0) * neg.col(c)
+        r0 = ((((x0 * l0v.col(c)) >> r0v.col(c)) - imin.col(c)) % mod.col(c)) + imin.col(c)
+        r1 = ((((v1 * l1v.col(c)) >> r1v.col(c)) - imin.col(c)) % mod.col(c)) + imin.col(c)
+        return jnp.where(cond, r0, r1)
+
+    return body
+
+
+def _emit_mul(g: _Group):
+    p0, p1 = g.positions('id0'), g.positions('id1')
+
+    def body(b, xT, c):
+        return jnp.take(b, p0.of(c), axis=0) * jnp.take(b, p1.of(c), axis=0)
+
+    return body
+
+
+def _emit_lookup(g: _Group):
+    m, idxs = g.m, g.idxs
+    p0 = g.positions('id0')
+    lz = g.pool.vec(m['lut_zero'][idxs])
+    dh = g.pool.vec(m['dhi'][idxs])
+    to = g.pool.vec(m['tab_off'][idxs])
+    te = g.pool.vec(m['tab_end'][idxs])
+    ft = g.pool.vec(m['flat_tab'])  # tables ride the pool into VMEM too
+
+    def body(b, xT, c):
+        x0 = jnp.take(b, p0.of(c), axis=0)
+        index = jnp.clip(x0 - lz.col(c) - dh.col(c) + to.col(c), to.col(c), te.col(c))
+        return jnp.take(ft.of(c), index, mode='clip')
+
+    return body
+
+
+def _emit_bit_unary(g: _Group):
+    m, idxs = g.m, g.idxs
+    p0 = g.positions('id0')
+    neg = g.sign_of(m['neg'][idxs])
+    mask = g.pool.vec(m['mask0'][idxs])
+    sgo = g.pool.vec(m['sg'][idxs])
+    d = m['dlo'][idxs]
+    is0 = g.pool.vec(d == 0)
+    is1 = g.pool.vec(d == 1)
+    dtype = g.dtype
+
+    def body(b, xT, c):
+        v = jnp.take(b, p0.of(c), axis=0) * neg.col(c)
+        r_not = jnp.where(sgo.col(c) != 0, ~v, (~v) & mask.col(c))
+        r_any = (v != 0).astype(dtype)
+        r_all = ((v & mask.col(c)) == mask.col(c)).astype(dtype)
+        return jnp.where(is0.col(c) != 0, r_not, jnp.where(is1.col(c) != 0, r_any, r_all))
+
+    return body
+
+
+def _emit_bit_binary(g: _Group):
+    m, idxs = g.m, g.idxs
+    p0, p1 = g.positions('id0'), g.positions('id1')
+    s0 = g.sign_of(m['bb_neg0'][idxs])
+    s1 = g.sign_of(m['bb_neg1'][idxs])
+    a = m['a_shift'][idxs]
+    apos = g.pool.vec(a > 0)
+    l1v = g.pool.vec(g.pow2(np.maximum(a, 0)))
+    l0v = g.pool.vec(g.pow2(np.maximum(-a, 0)))
+    so = m['bb_subop'][idxs]
+    so0 = g.pool.vec(so == 0)
+    so1 = g.pool.vec(so == 1)
+
+    def body(b, xT, c):
+        v1 = jnp.take(b, p0.of(c), axis=0) * s0.col(c)
+        v2 = jnp.take(b, p1.of(c), axis=0) * s1.col(c)
+        v2 = jnp.where(apos.col(c) != 0, v2 * l1v.col(c), v2)
+        v1 = jnp.where(apos.col(c) != 0, v1, v1 * l0v.col(c))
+        return jnp.where(so0.col(c) != 0, v1 & v2, jnp.where(so1.col(c) != 0, v1 | v2, v1 ^ v2))
+
+    return body
+
+
+#: emitter registry, keyed by ``OpSpec.pallas_lower`` — THE dispatch table;
+#: rows may share an emitter factory but each names its own contract key
+LOWERINGS: dict[str, object] = {
+    'copy': _emit_copy,
+    'addsub': _emit_addsub,
+    'relu': _shift_wrap_emitter(relu=True),
+    'quantize': _shift_wrap_emitter(relu=False),
+    'const_add': _emit_const_add,
+    'const': _emit_const,
+    'msb_mux': _emit_msb_mux,
+    'mul': _emit_mul,
+    'lookup': _emit_lookup,
+    'bit_unary': _emit_bit_unary,
+    'bit_binary': _emit_bit_binary,
+}
+
+# coverage audit (mirrors ir/synth.py): every opcode-table row must name a
+# registered lowering, and every registered lowering must be named by a row
+# — a new opcode without a pallas emitter, or a stale emitter after a table
+# edit, fails at import instead of in some later CI job.
+_unlowered = [spec.key for spec in OP_TABLE if spec.pallas_lower not in LOWERINGS]
+if _unlowered:
+    raise RuntimeError(
+        f'opcode table rows without a pallas lowering: {_unlowered}; '
+        f'register an emitter in runtime/pallas_backend.LOWERINGS and name it in the row'
+    )
+_stale_lowerings = [k for k in LOWERINGS if k not in {spec.pallas_lower for spec in OP_TABLE}]
+if _stale_lowerings:
+    raise RuntimeError(f'pallas lowerings without an opcode-table row: {_stale_lowerings}')
+
+
+# ---------------------------------------------------------------------------
+# kernel assembly
+# ---------------------------------------------------------------------------
+
+
+def _vmem_budget() -> int:
+    try:
+        return int(os.environ.get('DA4ML_PALLAS_VMEM', '') or _DEFAULT_VMEM_BUDGET)
+    except ValueError:
+        return _DEFAULT_VMEM_BUDGET
+
+
+def _pick_block(rows: int, n_in: int, n_out: int, pool_len: int, itemsize: int, peak_live: int) -> tuple[int, int]:
+    """Sample rows per grid step, sized from the operand-buffer footprint.
+
+    Each grid step holds the full ``(rows, block)`` operand buffer plus the
+    input/output slabs and the const pool in VMEM; the block is the largest
+    lane-quantum multiple that keeps that footprint inside the budget.
+    ``peak_live`` bounds the truly-live fraction of the buffer — when even
+    the minimum block busts the budget the kernel still runs (interpret
+    mode does not care), but the estimate is surfaced so a compiled-TPU
+    caller sees why Mosaic might refuse.
+
+    Returns ``(block, vmem_bytes_estimate)``.
+    """
+    per_row = (rows + n_in + n_out) * itemsize
+    budget = max(_vmem_budget() - pool_len * itemsize, per_row * _BLOCK_QUANTUM)
+    block = max((budget // max(per_row, 1)) // _BLOCK_QUANTUM * _BLOCK_QUANTUM, _BLOCK_QUANTUM)
+    block = min(block, _MAX_BLOCK)
+    est = per_row * block + pool_len * itemsize
+    if per_row * _BLOCK_QUANTUM + pool_len * itemsize > _vmem_budget():
+        telemetry.warn_once(
+            'runtime.pallas_vmem',
+            f'pallas operand buffer ({rows} rows, peak live window {peak_live}) exceeds the '
+            f'DA4ML_PALLAS_VMEM budget ({_vmem_budget()} B) even at the minimum {_BLOCK_QUANTUM}-sample '
+            f'block; interpret mode is unaffected but a compiled TPU build may refuse',
+            logger='runtime.pallas',
+        )
+    return int(block), int(est)
+
+
+def build_pallas_fn(ex):
+    """Generate the mega-kernel callable for a :class:`DaisExecutor`.
+
+    Returns ``fn(x) -> (batch, n_out)`` over integer arrays in the
+    executor's dtype — the same contract as the other ``_build_*`` methods,
+    so jit/packing/donation wrapping applies unchanged. Raises
+    :class:`PallasUnavailable` when the fallback ladder says no.
+    """
+    reason = unavailable_reason(ex.prog)
+    if reason is not None:
+        raise PallasUnavailable(reason)
+    pl, pltpu = _pallas_modules()
+
+    t_build = time.perf_counter()
+    prog = ex.prog
+    dtype = ex.dtype
+    np_dt = np.int64 if ex.use_i64 else np.int32
+    n_ops = prog.n_ops
+    m = ex._op_meta()
+
+    fam = m['branch'].astype(np.int64)
+    sched = levelize_program(prog, sort_key=fam)
+    order = sched.order.astype(np.int64)
+    pos = np.zeros(max(n_ops, 1), dtype=np.int64)
+    pos[order] = np.arange(n_ops, dtype=np.int64)
+
+    # contiguous (level, family) groups in packed order — identical grouping
+    # to the level builder, but emitted into one kernel body
+    if n_ops:
+        key = sched.level[order].astype(np.int64) * 16 + fam[order]
+        cuts = (np.flatnonzero(np.diff(key)) + 1).tolist()
+        bounds = [0, *cuts, n_ops]
+    else:
+        bounds = [0]
+
+    pool = _ConstPool(np_dt)
+    emits = []  # (packed start, packed end, body(b, xT, c) -> (g, block))
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        idxs = order[s:e]
+        spec = OP_TABLE[int(fam[idxs[0]])]  # vector classes are dense row ids
+        emitter = LOWERINGS[spec.pallas_lower]
+        g = _Group(m, idxs, pool, np_dt, dtype, pos, n_ops)
+        emits.append((int(s), int(e), emitter(g)))
+
+    out_idx = prog.out_idxs.astype(np.int64)
+    if not len(out_idx):  # degenerate: keep the out slab one real column wide
+        out_idx = np.array([-1], dtype=np.int64)
+    pos_out = np.where(out_idx >= 0, pos[np.clip(out_idx, 0, max(n_ops - 1, 0))], 0)
+    osign = np.where(out_idx < 0, 0, np.where(np.resize(prog.out_negs, out_idx.shape) != 0, -1, 1)).astype(np_dt)
+    h_out = pool.vec(pos_out)
+    h_osign = pool.vec(osign)
+
+    consts = pool.array()
+    rows = max(n_ops, 1)
+    n_in, n_out = max(prog.n_in, 1), max(prog.n_out, 1)
+    block, vmem_est = _pick_block(rows, n_in, n_out, len(consts), consts.dtype.itemsize, sched.peak_live)
+    interpret = _interpret_mode()
+
+    def kernel(c_ref, x_ref, o_ref, buf_ref):
+        c = c_ref[...]
+        xT = x_ref[...].T.astype(dtype)
+        for s, e, body in emits:
+            b = buf_ref[...]
+            buf_ref[s:e, :] = body(b, xT, c).astype(dtype)
+        outs = jnp.take(buf_ref[...], h_out.of(c), axis=0) * h_osign.col(c)
+        o_ref[...] = outs.T
+
+    pool_len = len(consts)
+
+    def fn(x, _consts=consts):
+        batch = x.shape[0]
+        n_blocks = max(-(-batch // block), 1)
+        padded = n_blocks * block
+        xp = x.astype(dtype)
+        if xp.shape[1] != n_in:  # n_in==0 edge: feed one dummy lane
+            xp = jnp.zeros((batch, n_in), dtype=dtype)
+        if padded != batch:
+            xp = jnp.pad(xp, ((0, padded - batch), (0, 0)))
+        call = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((padded, n_out), dtype),
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec((pool_len,), lambda i: (0,)),
+                pl.BlockSpec((block, n_in), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((block, n_out), lambda i: (i, 0)),
+            scratch_shapes=[pltpu.VMEM((rows, block), dtype)],
+            interpret=interpret,
+        )
+        out = call(jnp.asarray(_consts, dtype=dtype), xp)
+        out = out[:, : prog.n_out]
+        return out[:batch] if padded != batch else out
+
+    if telemetry.metrics_on():
+        telemetry.histogram('run.pallas.compile_s').observe(time.perf_counter() - t_build)
+        telemetry.histogram('run.pallas.vmem_bytes', telemetry.BYTES_BUCKETS).observe(vmem_est)
+    return fn

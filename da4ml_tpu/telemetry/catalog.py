@@ -80,6 +80,9 @@ METRICS: dict[str, str] = {
     'run.batch_s': 'wall clock per inference batch',
     'run.batch_samples': 'samples per inference batch',
     'run.compile_s': 'runtime executor compile wall clock',
+    'run.pallas.compile_s': 'pallas mega-kernel build + first-compile wall clock',
+    'run.pallas.vmem_bytes': 'estimated VMEM footprint per pallas mega-kernel grid step',
+    'run.pallas.fallbacks': "mode='pallas' requests degraded to 'level' (pallas missing, unlowered family, or build failure)",
     'run.device_s': 'device wall clock per DAIS inference batch',
     'run.hbm_bytes': 'estimated device-resident bytes per DAIS inference batch',
     'runtime.samples': 'samples served by the legacy runtime entry point',

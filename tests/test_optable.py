@@ -67,8 +67,21 @@ def test_every_row_is_complete():
         assert callable(spec.sample)
         assert spec.mutations, f'{spec.key}: every row must ship a mutation family'
         assert spec.semantics and spec.payload and spec.cost_model
+        assert spec.pallas_lower, f'{spec.key}: every row must name its pallas emitter'
         if spec.synth_family is not None:
             assert spec.synth_family in FAMILIES
+
+
+def test_pallas_lowering_registry_covers_table():
+    """Every row's `pallas_lower` name resolves in the backend registry and
+    the registry carries no stale names — the import-time audit, asserted."""
+    pytest.importorskip('jax')
+    from da4ml_tpu.runtime.pallas_backend import LOWERINGS
+
+    for spec in OP_TABLE:
+        assert spec.pallas_lower in LOWERINGS, f'{spec.key}: no LOWERINGS[{spec.pallas_lower!r}]'
+    table_names = {spec.pallas_lower for spec in OP_TABLE}
+    assert set(LOWERINGS) == table_names
 
 
 def test_synth_coverage_audit():

@@ -2,11 +2,12 @@
 
 Randomized DAIS programs (ir.synth) covering every opcode family — LUT ops,
 negative shifts, muxes, bitwise ops, the int64 wide path, packed int8/int16
-I/O — must run bit-exactly identical through the numpy oracle and all three
-device execution modes (unroll / scan / level). Plus: the level scheduler's
-invariants, the mode autotuner's cached decision and env override, the
-bytes-adaptive chunking, and the sharded-by-default batch path (conftest
-provides the virtual 8-device CPU mesh).
+I/O — must run bit-exactly identical through the numpy oracle and all four
+device execution modes (unroll / scan / level / pallas, the last in interpret
+mode on CPU). Plus: the level scheduler's invariants, the mode autotuner's
+cached (digest, platform)-keyed decision and env override, the pallas
+fallback ladder, the bytes-adaptive chunking, and the sharded-by-default
+batch path (conftest provides the virtual 8-device CPU mesh).
 """
 
 import numpy as np
@@ -59,6 +60,37 @@ def test_levelize_invariants():
         assert (lvl[sched.ops_at(level)] == level).all()
     assert sched.starts[-1] == prog.n_ops
     assert sched.width_max >= 1 and sched.width_mean > 0
+
+
+def test_levelize_operand_liveness():
+    """first_use/last_use track every (consumer, operand) edge; peak_live
+    bounds the level-concurrent live-slot window the pallas backend sizes
+    VMEM against."""
+    rng = np.random.default_rng(9)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    sched = levelize_program(prog)
+    first, last = sched.first_use, sched.last_use
+    # oracle: per-slot min/max reader via a plain op walk
+    lo = np.full(prog.n_ops, prog.n_ops, dtype=np.int64)
+    hi = np.full(prog.n_ops, -1, dtype=np.int64)
+    for i in range(prog.n_ops):
+        oc = int(prog.opcode[i])
+        deps = []
+        if oc not in (-1, 5):
+            deps.append(int(prog.id0[i]))
+        if oc in (0, 1, 6, -6, 7, 10):
+            deps.append(int(prog.id1[i]))
+        if abs(oc) == 6:
+            deps.append(int(prog.data_lo[i]))
+        for d in deps:
+            lo[d] = min(lo[d], i)
+            hi[d] = max(hi[d], i)
+    lo[lo == prog.n_ops] = -1
+    np.testing.assert_array_equal(first, lo)
+    np.testing.assert_array_equal(last, hi)
+    assert (first[first >= 0] > np.flatnonzero(first >= 0)).all(), 'consumers come after definitions'
+    assert 1 <= sched.peak_live <= prog.n_ops
+    assert sched.peak_live >= sched.width_max, 'a level is at least as live as its own width'
 
 
 def test_levelize_comb_matches_program(rng):
@@ -347,3 +379,155 @@ def test_donate_env_knob(monkeypatch, env):
         import jax
 
         assert dn == (() if jax.default_backend() == 'cpu' else (0,))
+
+
+# ---------------------------------------------------------------------------
+# pallas mega-kernel backend (docs/runtime.md#pallas-backend)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('family', FAMILIES)
+def test_pallas_parity_per_family(family):
+    """One single-family program per opcode family through the mega-kernel
+    (interpret mode on CPU), bit-exact vs the numpy oracle."""
+    rng = np.random.default_rng(50_000 + FAMILIES.index(family))
+    prog = random_program(rng, n_ops=160, n_in=5, n_out=4, families=(family,))
+    data = random_inputs(rng, prog, 33)  # odd batch: exercises block padding
+    ex = DaisExecutor(prog, mode='pallas')
+    assert ex.mode == 'pallas'
+    np.testing.assert_array_equal(ex(data), run_program(prog, data), err_msg=f'family={family}')
+
+
+def test_pallas_parity_packed_io():
+    """Packed int8/int16 host<->device lanes wrap the pallas kernel too."""
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace
+
+    rng = np.random.default_rng(12)
+    inp = FixedVariableArrayInput(6, hwconf=HWConfig(1, -1, -1))
+    x = inp.quantize(np.ones(6), np.full(6, 2), np.full(6, 1))
+    w = rng.integers(-4, 4, (6, 3)).astype(np.float64)
+    comb = comb_trace(inp, (x @ w).relu(i=np.full(3, 5), f=np.full(3, 1)))
+    ex = DaisExecutor(decode(comb.to_binary()), mode='pallas')
+    assert ex.mode == 'pallas' and ex._in_group in (2, 4) and ex._out_group in (2, 4)
+    data = rng.uniform(-4, 4, (64, 6))
+    np.testing.assert_array_equal(ex(data), comb.predict(data, backend='numpy'))
+
+
+def _fusion_workload(name, rng):
+    """The bench.py fusion workloads (limited dims): a separable conv stack
+    and a relu-attention transformer block, as stage pipelines."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+    from da4ml_tpu.trace.ops import conv2d, depthwise_conv2d, einsum, relu
+    from da4ml_tpu.trace.ops.quantization import quantize
+
+    if name == 'conv_stack':
+        shape = (5, 5, 2)
+        inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, 6))
+        x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+        h = relu(depthwise_conv2d(x, rng.integers(-3, 4, (3, 3, 2, 1)).astype(np.float64)), i=3, f=0)
+        h = relu(conv2d(h, rng.integers(-3, 4, (1, 1, 2, 3)).astype(np.float64)), i=3, f=0)
+        out = conv2d(h, rng.integers(-3, 4, (1, 1, 3, 2)).astype(np.float64))
+        return to_pipeline(comb_trace(inp, out), 6, retiming=False), int(np.prod(shape))
+    T, D, F = 4, 4, 8
+    inp = FixedVariableArrayInput((T, D), hwconf=HWConfig(1, -1, 8))
+    x = inp.quantize(np.ones((T, D)), np.full((T, D), 2), np.zeros((T, D), np.int64))
+    wq, wk, wv = (rng.integers(-2, 3, (D, D)).astype(np.float64) for _ in range(3))
+    q = quantize(einsum('td,df->tf', x, wq), 1, 3, 0)
+    k = quantize(einsum('td,df->tf', x, wk), 1, 3, 0)
+    v = quantize(einsum('td,df->tf', x, wv), 1, 3, 0)
+    scores = relu(einsum('td,sd->ts', q, k), i=3, f=0)  # relu-attention, no softmax
+    h = quantize(x + quantize(einsum('ts,sd->td', scores, v), 1, 3, 0), 1, 3, 0)
+    w1 = rng.integers(-2, 3, (D, F)).astype(np.float64)
+    w2 = rng.integers(-2, 3, (F, D)).astype(np.float64)
+    ffn = quantize(einsum('tf,fd->td', relu(einsum('td,df->tf', h, w1), i=3, f=0), w2), 1, 3, 0)
+    return to_pipeline(comb_trace(inp, quantize(h + ffn, 1, 3, 0)), 8, retiming=False), T * D
+
+
+@pytest.mark.parametrize('workload', ['conv_stack', 'transformer_block'])
+def test_pallas_fused_workload_bit_exact(workload):
+    """The IR-fused bench workloads run whole through ONE pallas kernel."""
+    rng = np.random.default_rng(23)
+    pipe, n_in = _fusion_workload(workload, rng)
+    chain = [s.to_binary() for s in pipe.stages]
+    data = rng.integers(-4, 4, (257, n_in)).astype(np.float64)
+    golden = pipe.predict(data, backend='numpy')
+    ex = jb.fused_executor_for_binaries(chain, mode='pallas')
+    assert ex.mode == 'pallas'
+    np.testing.assert_array_equal(ex(data), golden, err_msg=f'workload={workload}')
+
+
+def test_pallas_env_force(tuner_env, monkeypatch):
+    monkeypatch.setenv('DA4ML_RUN_MODE', 'pallas')
+    rng = np.random.default_rng(26)
+    prog = random_program(rng, n_ops=200, n_in=5, n_out=4)
+    ex = DaisExecutor(prog, mode='auto')
+    assert ex.mode == 'pallas'
+    data = random_inputs(rng, prog, 65)
+    np.testing.assert_array_equal(ex(data), run_program(prog, data))
+
+
+def test_pallas_fallback_warns_and_counts(monkeypatch):
+    """mode='pallas' degrades to 'level' (warn_once + counter) when the
+    backend reports itself unavailable, instead of raising."""
+    from da4ml_tpu.runtime import pallas_backend
+    from da4ml_tpu.telemetry.log import _warned_once
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot
+
+    enable_metrics()
+    monkeypatch.setattr(pallas_backend, 'unavailable_reason', lambda prog: 'jax.experimental.pallas is unavailable')
+    _warned_once.discard('runtime.pallas_fallback')
+    before = metrics_snapshot().get('run.pallas.fallbacks', {}).get('value', 0)
+    prog = random_program(np.random.default_rng(3), n_ops=80, n_in=4, n_out=3)
+    ex = DaisExecutor(prog, mode='pallas')
+    assert ex.mode == 'level'
+    assert metrics_snapshot().get('run.pallas.fallbacks', {}).get('value', 0) == before + 1
+    data = random_inputs(np.random.default_rng(4), prog, 16)
+    np.testing.assert_array_equal(ex(data), run_program(prog, data))
+
+
+def test_autotune_decision_platform_keyed(tuner_env, monkeypatch):
+    """Decisions persist under (digest, platform): a cpu decision must not
+    answer for the same program on another backend platform."""
+    import jax
+
+    from da4ml_tpu.telemetry.metrics import enable_metrics, metrics_snapshot
+
+    enable_metrics()
+    rng = np.random.default_rng(29)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    ex1 = DaisExecutor(prog, mode='auto')
+    platform = str(jax.default_backend())
+    files = list((tuner_env / 'da4ml-run-modes').glob('*.json'))
+    assert len(files) == 1 and files[0].name.endswith(f'.{platform}.json')
+    assert any(k.endswith(f'@{platform}') for k in jb.mode_decisions())
+
+    # same digest, different platform: both the memory and the file cache miss
+    jb._MODE_DECISIONS.clear()
+    monkeypatch.setattr(jb, '_platform', lambda: 'tpu-imaginary')
+    n_before = metrics_snapshot().get('run.autotune', {}).get('value', 0)
+    ex2 = DaisExecutor(prog, mode='auto')
+    assert ex2.mode in MODES
+    assert metrics_snapshot().get('run.autotune', {}).get('value', 0) == n_before + 1, 'cross-platform decision reuse'
+    assert len(list((tuner_env / 'da4ml-run-modes').glob('*.json'))) == 2
+    assert ex1.mode in MODES
+
+
+def test_autotune_pallas_measured_never_favored_when_slower(tuner_env, monkeypatch):
+    """DA4ML_PALLAS_AUTOTUNE=1 forces the pallas candidate into the race even
+    on an interpret-only platform; the tuner measures it and must only pick
+    it when it actually won the clock."""
+    import json
+
+    monkeypatch.setenv('DA4ML_PALLAS_AUTOTUNE', '1')
+    rng = np.random.default_rng(37)
+    prog = random_program(rng, n_ops=300, n_in=6, n_out=4)
+    ex = DaisExecutor(prog, mode='auto')
+    assert ex.mode in MODES
+    files = list((tuner_env / 'da4ml-run-modes').glob('*.json'))
+    assert len(files) == 1
+    blob = json.loads(files[0].read_text())
+    assert blob['mode'] == ex.mode
+    assert 'pallas_samples_per_s' in blob or 'pallas_error' in blob, 'pallas must have been measured'
+    if ex.mode != 'pallas' and 'pallas_samples_per_s' in blob:
+        assert blob['pallas_samples_per_s'] <= blob[f'{ex.mode}_samples_per_s']
