@@ -352,7 +352,54 @@ def _run_inference_micro(limited: bool):
             np.array_equal(out_f, out_host) and np.array_equal(out_c, out_host) and np.array_equal(out_h, out_host)
         ),
         'pipeline_fused_ir_bit_exact': bool(np.array_equal(out_ir, out_host)),
+        'model_shard': _run_model_shard_probe([comb.to_binary()], mode_data, host_ref),
         'fusion_workloads': _run_fusion_workloads(limited),
+    }
+
+
+def _run_model_shard_probe(chain, data, golden) -> dict:
+    """Model-axis partition vs single-device on the fused program
+    (docs/runtime.md#model-parallel-execution). The rate comparison only
+    means much on a real multi-chip mesh — on a virtual CPU mesh the gate
+    is bit-exactness, mirroring the autotuner's own contract (sharded is
+    only ever *picked* when it wins the measured race)."""
+    import jax
+
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.ir.fuse import fuse_binaries
+    from da4ml_tpu.ir.partition import partition_program
+    from da4ml_tpu.parallel import model_mesh
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+
+    n_dev = jax.local_device_count()
+    k = 4 if n_dev % 4 == 0 else n_dev
+    if model_mesh(k) is None:
+        return {'skipped': f'no {k}-way model mesh ({n_dev} local devices)'}
+    prog = decode(fuse_binaries(chain) if len(chain) > 1 else chain[0])
+    plan = partition_program(prog, k)
+    single = DaisExecutor(prog, model_shard=False)
+    sharded = DaisExecutor(prog, partition_plan=plan, model_shard=True)
+    if sharded.model_shards != k:
+        return {'skipped': 'sharded build fell back to single-device'}
+    timed = {}
+    outs = {}
+    for key, ex in (('sharded', sharded), ('single', single)):
+        ex(data)  # first call pays the compile
+        t0 = time.perf_counter()
+        outs[key] = ex(data)
+        timed[key] = time.perf_counter() - t0
+    build = sharded._shard_build
+    itemsize = 8 if sharded.use_i64 else 4
+    n = len(data)
+    return {
+        'k': k,
+        'segments': plan.n_segments,
+        'sharded_rate': round(n / timed['sharded'], 1),
+        'single_rate': round(n / timed['single'], 1),
+        'vs_single_device': round(timed['single'] / timed['sharded'], 3),
+        'exchange_bytes': int(sum(build.exchange_rows(g) for g in range(build.n_segments)) * itemsize),
+        'imbalance': round(build.imbalance, 3),
+        'bit_exact': bool(np.array_equal(outs['sharded'], golden) and np.array_equal(outs['single'], golden)),
     }
 
 
@@ -457,6 +504,7 @@ def _run_fusion_workloads(limited: bool) -> dict:
             'hostloop_rate': round(n_samples / timed['hostloop'], 1),
             'fused_ir_vs_chained': round(timed['chained'] / timed['fused_ir'], 3),
             'bit_exact': bool(all(np.array_equal(outs[k], golden) for k in outs)),
+            'model_shard': _run_model_shard_probe(chain, data, golden),
             **(pallas_entry or {}),
         }
     return entries

@@ -1,0 +1,145 @@
+"""CI gate for model-axis sharding (docs/runtime.md#model-parallel-execution).
+
+Partitions the ir.synth corpus plus the fused conv-stack / transformer
+bench workloads 4-way (one case 8-way), then gates two things on the
+8-device virtual CPU mesh:
+
+1. **Bit-exactness** — the forced model-sharded executor must match the
+   numpy oracle exactly, in level mode and with one pallas mega-kernel
+   per shard (interpret mode on CPU runners);
+2. **Conformance of every partition cell** — each (segment, shard) cell
+   program is differentially executed through every runtime mode against
+   the table-generated reference interpreter (`analysis.check_conformance`,
+   the same C401 gate `da4ml-tpu verify --conformance` applies to saved
+   kernels).
+
+Exits non-zero on any mismatch. Run from the repo root:
+
+    python ci/shard_parity.py
+"""
+
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+if '--xla_force_host_platform_device_count' not in os.environ.get('XLA_FLAGS', ''):
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') + ' --xla_force_host_platform_device_count=8').strip()
+os.environ.setdefault('DA4ML_PALLAS_INTERPRET', '1')
+os.environ.setdefault('DA4ML_RUN_AUTOTUNE', '0')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _fusion_workloads():
+    """The fused bench workloads as per-stage binary chains — the same
+    traces and seeds bench.py's `fusion_workloads` section commits, so this
+    gate covers exactly what the committed baselines measure."""
+    from da4ml_tpu.trace import FixedVariableArrayInput, HWConfig, comb_trace, to_pipeline
+    from da4ml_tpu.trace.ops import conv2d, depthwise_conv2d, einsum, relu
+    from da4ml_tpu.trace.ops.quantization import quantize
+
+    rng = np.random.default_rng(23)
+
+    def conv_stack():
+        shape = (5, 5, 2)
+        inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, 6))
+        x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+        h = relu(depthwise_conv2d(x, rng.integers(-3, 4, (3, 3, 2, 1)).astype(np.float64)), i=3, f=0)
+        h = relu(conv2d(h, rng.integers(-3, 4, (1, 1, 2, 3)).astype(np.float64)), i=3, f=0)
+        h = relu(depthwise_conv2d(h, rng.integers(-2, 3, (2, 2, 3, 1)).astype(np.float64)), i=3, f=0)
+        out = conv2d(h, rng.integers(-3, 4, (1, 1, 3, 2)).astype(np.float64))
+        return to_pipeline(comb_trace(inp, out), 6, retiming=False)
+
+    def transformer_block():
+        T, D, F = 4, 4, 8
+        shape = (T, D)
+        inp = FixedVariableArrayInput(shape, hwconf=HWConfig(1, -1, 8))
+        x = inp.quantize(np.ones(shape), np.full(shape, 2), np.zeros(shape, np.int64))
+        wq, wk, wv = (rng.integers(-2, 3, (D, D)).astype(np.float64) for _ in range(3))
+        q = quantize(einsum('td,df->tf', x, wq), 1, 3, 0)
+        k = quantize(einsum('td,df->tf', x, wk), 1, 3, 0)
+        v = quantize(einsum('td,df->tf', x, wv), 1, 3, 0)
+        scores = relu(einsum('td,sd->ts', q, k), i=3, f=0)  # relu-attention
+        h = quantize(x + quantize(einsum('ts,sd->td', scores, v), 1, 3, 0), 1, 3, 0)
+        w1 = rng.integers(-2, 3, (D, F)).astype(np.float64)
+        w2 = rng.integers(-2, 3, (F, D)).astype(np.float64)
+        ffn = quantize(einsum('tf,fd->td', relu(einsum('td,df->tf', h, w1), i=3, f=0), w2), 1, 3, 0)
+        return to_pipeline(comb_trace(inp, quantize(h + ffn, 1, 3, 0)), 8, retiming=False)
+
+    for name, build in (('conv_stack', conv_stack), ('transformer_block', transformer_block)):
+        yield name, [s.to_binary() for s in build().stages]
+
+
+def main() -> int:
+    import jax
+
+    from da4ml_tpu.analysis.conformance import check_conformance
+    from da4ml_tpu.ir import synth
+    from da4ml_tpu.ir.dais_binary import decode
+    from da4ml_tpu.ir.fuse import fuse_binaries
+    from da4ml_tpu.ir.partition import build_shards, partition_program, validate_plan
+    from da4ml_tpu.runtime import numpy_backend as nb
+    from da4ml_tpu.runtime.jax_backend import DaisExecutor
+
+    if jax.local_device_count() < 8:
+        print(f'FATAL: need the 8-device virtual mesh, got {jax.local_device_count()}')
+        return 2
+
+    cases = []
+    for seed, kwargs, k in (
+        (11, dict(n_ops=200, n_in=8, n_out=6), 4),
+        (12, dict(n_ops=260, n_in=12, n_out=9, wide=True, n_levels=10), 4),
+        (13, dict(n_ops=220, n_in=6, n_out=5, n_levels=25), 8),
+    ):
+        cases.append((f'synth[{seed}]', synth.random_program(np.random.default_rng(seed), **kwargs), k))
+    for name, chain in _fusion_workloads():
+        cases.append((name, decode(fuse_binaries(chain)), 4))
+
+    failures = 0
+    for name, prog, k in cases:
+        plan = partition_program(prog, k)
+        validate_plan(prog, plan)
+        build = build_shards(prog, plan)
+        data = synth.random_inputs(np.random.default_rng(99), prog, 64)
+        ref, buf = nb.run_program(prog, data, return_buf=True)
+        ref = np.asarray(ref)
+        # conformance per cell, on the cell's ACTUAL upstream carries: raw
+        # input lanes pre-scaled by the program's inp_shift, received values
+        # as their float codes (cells declare inp_shift=0; a receive lane's
+        # wrap is an identity on in-range carries by construction)
+        lane_scale = np.exp2(prog.inp_shifts.astype(np.float64))
+        op_scale = np.exp2(-prog.fractionals.astype(np.float64))
+        n_cells = 0
+        for seg in build.shards:
+            for cell in seg:
+                if cell.prog.n_ops == 0:
+                    continue
+                n_cells += 1
+                cols = [
+                    data[:, -1 - int(src)] * lane_scale[-1 - int(src)]
+                    if src < 0
+                    else np.asarray(buf[int(src)], dtype=np.float64) * op_scale[int(src)]
+                    for src in cell.in_ops
+                ]
+                cell_data = np.stack(cols, axis=1) if cols else np.zeros((len(data), cell.prog.n_in))
+                for d in check_conformance(cell.prog, data=cell_data):
+                    print(f'FAIL {name}: cell conformance: {d}')
+                    failures += 1
+        for mode in ('level', 'pallas'):
+            ex = DaisExecutor(prog, mode=mode, partition_plan=plan, model_shard=True)
+            if ex.model_shards != k:
+                print(f'FAIL {name}: mode={mode}: sharded build fell back (model_shards={ex.model_shards})')
+                failures += 1
+                continue
+            ok = np.array_equal(np.asarray(ex(data)), ref)
+            print(f'{"ok  " if ok else "FAIL"} {name}: k={k} mode={mode} segments={plan.n_segments} cells={n_cells}')
+            if not ok:
+                failures += 1
+    print(f'{"FAILED" if failures else "PASSED"}: {len(cases)} programs, {failures} failures')
+    return 1 if failures else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -69,6 +69,7 @@ KNOBS: dict[str, str] = {
     'DA4ML_RUN_AUTOTUNE_MIN_OPS': 'minimum program size before autotune probes run',
     'DA4ML_RUN_DONATE': '`0` disables input-buffer donation on dispatch',
     'DA4ML_RUN_MODE': 'force the DAIS execution mode instead of resolving it',
+    'DA4ML_RUN_MODEL_SHARD': 'model-axis sharding policy: `0`/`off`, `auto` (race anywhere), `on`/`1` or an integer K>=2 (force); default races on TPU only',
     'DA4ML_RUN_SHARD': '`0` disables sample-axis sharding across the mesh',
     'DA4ML_SEARCH_TRACE_DIR': 'write beam solve traces here (learned-ranker training data)',
     'DA4ML_SERVE_MAX_BODY_BYTES': 'HTTP request-body ceiling (rejected 413 before buffering)',

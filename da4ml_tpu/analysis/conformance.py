@@ -65,15 +65,26 @@ def check_conformance(
     n_samples: int = 64,
     seed: int = 0,
     stage: int | None = None,
+    data: np.ndarray | None = None,
 ) -> list[Diagnostic]:
     """Differentially execute ``program`` through each backend vs the
-    reference interpreter; bit-mismatches become C401 diagnostics."""
+    reference interpreter; bit-mismatches become C401 diagnostics.
+
+    ``data`` overrides the synthetic input batch — for programs whose input
+    lanes carry narrower-than-declared upstream values (e.g. partition cells
+    receiving another shard's lookup index), the caller supplies realistic
+    carries instead of the full-width random sweep.
+    """
     from ..runtime import reference
     from ..runtime.jax_backend import DaisExecutor
 
     prog = _as_prog(program)
-    rng = np.random.default_rng(seed)
-    data = random_inputs(rng, prog, n_samples)
+    if data is None:
+        rng = np.random.default_rng(seed)
+        data = random_inputs(rng, prog, n_samples)
+    else:
+        data = np.asarray(data, dtype=np.float64)
+        n_samples = len(data)
     ref, ref_buf = reference.run_program(prog, data, return_buf=True)
 
     diags: list[Diagnostic] = []

@@ -33,6 +33,7 @@ ALLOWLIST: dict[str, str] = {
     'da4ml_tpu/ir/dais_binary.py': 'binary stream causality validator (struct-of-arrays fast path)',
     'da4ml_tpu/ir/fuse.py': 'pipeline fuser: seam lowering replaces boundary copies; binary round-trip pads opcode-8 tables (fused output conformance-checked vs staged execution)',
     'da4ml_tpu/ir/schedule.py': 'levelizer: dependency-field usage via table-exported sets',
+    'da4ml_tpu/ir/partition.py': 'model-axis partitioner: seam lowering re-emits boundary copies and carries const/lookup metadata across shards (cells conformance-checked vs the reference)',
     'da4ml_tpu/runtime/numpy_backend.py': 'vectorized interpreter backend (conformance-checked vs the reference)',
     'da4ml_tpu/runtime/jax_backend.py': 'XLA kernel builders (conformance-checked vs the reference)',
     'da4ml_tpu/trace/tracer.py': 'IR producer: encodes traced ops into opcodes',

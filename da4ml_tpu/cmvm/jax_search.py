@@ -277,19 +277,13 @@ def _auto_mesh():
 
 @lru_cache(maxsize=4)
 def _auto_mesh_for(env: str):
-    if env == '0':
-        return None
-    if jax.default_backend() != 'tpu' and env != '1':
-        return None
-    try:
-        devs = jax.local_devices()
-        if len(devs) < 2:
-            return None
-        from jax.sharding import Mesh
+    # `env` keys the cache (the policy itself re-reads the environment);
+    # the resolution rules live in parallel.resolve_mesh, shared with the
+    # runtime's model-shard path so both planes agree on DA4ML_JAX_MESH
+    del env
+    from ..parallel import resolve_mesh
 
-        return Mesh(np.asarray(devs), ('batch',))
-    except Exception:
-        return None
+    return resolve_mesh('batch', tpu_only=True)
 
 
 def _select() -> str:

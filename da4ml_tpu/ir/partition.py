@@ -1,0 +1,588 @@
+"""Model-axis partitioner: split a level-packed DAIS program across K shards.
+
+A fused serving program can outgrow one chip — the pallas mega-kernel's
+operand ring buffer + const pool are bounded by ``DA4ML_PALLAS_VMEM``
+(docs/runtime.md#pallas-backend) — but the program is a static SSA dataflow
+graph, so it can be cut the way DNNVM cuts accelerator graphs
+(arXiv:1902.07463): assign ops to per-device partitions and schedule the
+boundaries explicitly.
+
+The cut reuses the level schedule (:mod:`.schedule`): ops at the same ASAP
+level are mutually independent, so levels are grouped into *segments* and
+ops within each segment are assigned to shards such that every
+intra-segment operand edge stays shard-local (assignment is by connected
+component of the intra-segment dependency graph — closure by construction).
+Each (segment, shard) cell then re-expresses as a standalone
+:class:`~.dais_binary.DaisProgram` whose inputs are *receive lanes* (values
+produced in earlier segments) and whose outputs are the cell's *exported*
+(read later by another shard, or a program output) and *private* (read
+later only by the owning shard) values. The runtime lowers each cell
+through the ordinary per-mode builders — including one pallas mega-kernel
+per cell — and stitches segments with one ``all_gather`` of each shard's
+contiguous exported slab per level-group boundary
+(docs/runtime.md#model-parallel-execution).
+
+The plan itself (:class:`PartitionPlan`) is tiny and deterministic — shard
+assignment per op plus the segment level boundaries — so it serializes into
+the export artifact (digest-covered, ``serve/export.py``) and a serving
+replica rebuilds the exact same cells without re-partitioning: the TVM-style
+compile/serve split (arXiv:1802.04799) applied to the partition decision.
+
+Numpy-only on purpose: importable by the serve plane and the CLI without
+touching jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import NamedTuple
+
+import numpy as np
+from numpy.typing import NDArray
+
+from .dais_binary import DaisProgram, encode
+from .schedule import LevelSchedule, levelize_program
+
+#: serialized plan format version (``plan_to_dict``)
+PLAN_VERSION = 1
+
+#: opcodes whose id1 slot is a live dependency (mirrors schedule._USES_ID1)
+_USES_ID1 = (0, 1, 6, -6, 7, 10)
+
+
+def program_plan_digest(prog: DaisProgram) -> str:
+    """SHA-256 of the canonically re-encoded program (version word zeroed).
+
+    This is the digest a :class:`PartitionPlan` pins: computed from the
+    decoded program, it is stable across encode round-trips regardless of
+    the firmware-version word of the binary the program arrived in.
+    """
+    return hashlib.sha256(np.ascontiguousarray(encode(prog), dtype='<i4').tobytes()).hexdigest()
+
+
+class PartitionPlan(NamedTuple):
+    """A K-way model-axis cut of one DAIS program.
+
+    ``assign`` maps each op to its shard; ``seg_levels`` bounds the level
+    groups (segment ``g`` covers ASAP levels ``seg_levels[g]`` to
+    ``seg_levels[g+1]``). Everything else — per-cell sub-programs, receive
+    lanes, the exchange layout — is derived deterministically by
+    :func:`build_shards`, so this is all that needs to travel in an export
+    artifact.
+    """
+
+    k: int
+    n_ops: int
+    program_digest: str
+    assign: NDArray[np.int32]  # (n_ops,) op -> shard
+    seg_levels: NDArray[np.int64]  # (n_segments+1,) level boundaries
+
+    @property
+    def n_segments(self) -> int:
+        return max(len(self.seg_levels) - 1, 0)
+
+
+class SegmentShard(NamedTuple):
+    """One (segment, shard) cell of a built partition.
+
+    ``prog`` computes the cell's ops; its input lanes are described by
+    ``in_src`` — row in the replicated public carry when ``>= 0`` (rows
+    ``0..n_in-1`` are the program's input lanes, then each segment's
+    gathered slabs), or ``-(1 + row)`` into the owning shard's private
+    carry. Outputs are ordered ``[exported..., pad, private..., pad]`` so
+    every shard's slab has the segment's uniform ``(export_pad +
+    private_pad)`` height (pad lanes are output holes, ``out_idx = -1``).
+    """
+
+    prog: DaisProgram
+    in_src: NDArray[np.int64]
+    n_export: int
+    n_private: int
+    #: provenance per input lane: ``-(1 + raw_lane)`` for the program's own
+    #: input lanes, else the original op id whose value is received — lets a
+    #: harness feed a cell its *actual* upstream carries (ci/shard_parity.py
+    #: conformance-checks every cell on realistic data; random full-width
+    #: inputs could e.g. drive a received lookup index out of its table)
+    in_ops: NDArray[np.int64] = np.zeros(0, np.int64)
+
+
+class ShardBuild(NamedTuple):
+    """A fully derived partition: per-cell programs + exchange layout."""
+
+    plan: PartitionPlan
+    shards: list[list[SegmentShard]]  # [segment][shard]
+    export_pad: list[int]  # slab height m_g gathered per shard at boundary g
+    private_pad: list[int]  # private slab height kept per shard at boundary g
+    out_src: NDArray[np.int64]  # (n_out,) public-carry row per output (0 for holes)
+    out_sign: NDArray[np.int64]  # (n_out,) -1/1 per output, 0 for holes
+    exchange: list[list[tuple[int, int]]]  # [boundary][shard] -> (pub row, count)
+
+    @property
+    def n_segments(self) -> int:
+        return len(self.shards)
+
+    @property
+    def shard_ops(self) -> NDArray[np.int64]:
+        """Total op count per shard (imbalance telemetry)."""
+        return np.bincount(self.plan.assign, minlength=self.plan.k).astype(np.int64)
+
+    @property
+    def imbalance(self) -> float:
+        """Max/mean ops per shard (1.0 = perfectly balanced)."""
+        counts = self.shard_ops
+        mean = float(counts.mean()) if len(counts) else 0.0
+        return float(counts.max()) / mean if mean > 0 else 1.0
+
+    def exchange_rows(self, boundary: int) -> int:
+        """Rows all shards gather at ``boundary`` (k * export_pad)."""
+        return self.plan.k * self.export_pad[boundary]
+
+
+def _edges(prog: DaisProgram) -> tuple[NDArray[np.int64], NDArray[np.int64]]:
+    """All (reader, operand) dependency edges of the program."""
+    oc = prog.opcode.astype(np.int64)
+    uses0 = (oc != -1) & (oc != 5)
+    uses1 = np.isin(oc, _USES_ID1)
+    usesc = np.abs(oc) == 6
+    readers = np.concatenate([np.flatnonzero(uses0), np.flatnonzero(uses1), np.flatnonzero(usesc)])
+    operands = np.concatenate(
+        [
+            prog.id0.astype(np.int64)[uses0],
+            prog.id1.astype(np.int64)[uses1],
+            prog.data_lo.astype(np.int64)[usesc],
+        ]
+    )
+    return readers, operands
+
+
+class _UnionFind:
+    __slots__ = ('parent', 'size')
+
+    def __init__(self, n: int):
+        self.parent = list(range(n))
+        self.size = [1] * n
+
+    def find(self, a: int) -> int:
+        p = self.parent
+        while p[a] != a:
+            p[a] = p[p[a]]
+            a = p[a]
+        return a
+
+    def union(self, a: int, b: int) -> int:
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return self.size[ra]
+        if self.size[ra] < self.size[rb]:
+            ra, rb = rb, ra
+        self.parent[rb] = ra
+        self.size[ra] += self.size[rb]
+        return self.size[ra]
+
+
+def _choose_segments(
+    prog: DaisProgram, sched: LevelSchedule, k: int, max_segments: int
+) -> NDArray[np.int64]:
+    """Greedy level grouping: grow a segment while its intra-segment
+    dependency components stay balanceable across ``k`` shards, cut when the
+    next level would weld the segment into components too large to spread
+    (largest component > 1.5x the fair per-shard share). Chain-shaped programs
+    degenerate to per-level segments — correct, and the measured autotuner
+    rejects them; ``max_segments`` bounds exchange count by thinning cuts.
+    """
+    depth = sched.depth
+    if depth <= 1:
+        return np.asarray([0, max(depth, 0)], dtype=np.int64) if depth else np.asarray([0], dtype=np.int64)
+    level = sched.level.astype(np.int64)
+    readers, operands = _edges(prog)
+    # operand edges grouped by the reader's level
+    r_level = level[readers]
+    order = np.argsort(r_level, kind='stable')
+    readers, operands, r_level = readers[order], operands[order], r_level[order]
+    edge_starts = np.searchsorted(r_level, np.arange(depth + 1))
+    lvl_counts = np.diff(sched.starts)
+
+    cuts = [0]
+    uf = _UnionFind(prog.n_ops)
+    seg_lo = 0  # first level of the open segment
+    seg_ops = int(lvl_counts[0])
+    for l in range(1, depth):
+        # trial-union level l's edges on a clone: if the largest welded
+        # component exceeds 1.5x the fair per-shard share, cut before l so
+        # the open segment stays spreadable; otherwise adopt the clone
+        trial = _UnionFind(0)
+        trial.parent = list(uf.parent)
+        trial.size = list(uf.size)
+        worst = 1
+        for e in range(int(edge_starts[l]), int(edge_starts[l + 1])):
+            v = int(operands[e])
+            if level[v] < seg_lo:
+                continue  # operand in an earlier (closed) segment: exchange edge
+            worst = max(worst, trial.union(int(readers[e]), v))
+        total = seg_ops + int(lvl_counts[l])
+        fair = -(-total // k)
+        if 2 * worst > 3 * fair and seg_ops > 0:
+            cuts.append(l)
+            seg_lo = l
+            seg_ops = int(lvl_counts[l])
+            # components reset implicitly: the rejected trial is dropped, and
+            # level-l ops stay singletons (their operands all live at levels
+            # below l, now outside the new segment — exchange edges)
+            continue
+        seg_ops = total
+        uf = trial
+    cuts.append(depth)
+    if len(cuts) - 1 > max_segments:
+        # thin to max_segments boundaries, keeping the first and last
+        keep = np.unique(np.linspace(0, len(cuts) - 1, max_segments + 1).round().astype(np.int64))
+        cuts = [cuts[i] for i in keep]
+    return np.asarray(cuts, dtype=np.int64)
+
+
+def partition_program(
+    prog: DaisProgram,
+    k: int,
+    max_segments: int = 16,
+) -> PartitionPlan:
+    """Cut ``prog`` into a K-way model-axis :class:`PartitionPlan`.
+
+    Within each segment the intra-segment dependency components are placed
+    LPT-style (largest component first onto the least-loaded shard, with
+    component weight = op count + a liveness term for values escaping the
+    segment, so per-shard op count *and* live-value footprint both balance);
+    cross-segment operand affinity breaks load ties, which keeps values on
+    the shard that produced them and shrinks the exchanged slabs.
+    """
+    if k < 1:
+        raise ValueError(f'model shard count must be >= 1, got {k}')
+    prog.validate()
+    n = prog.n_ops
+    sched = levelize_program(prog)
+    seg_levels = _choose_segments(prog, sched, k, max_segments) if n else np.asarray([0], np.int64)
+    assign = np.zeros(n, dtype=np.int32)
+    if n and k > 1:
+        level = sched.level.astype(np.int64)
+        seg_of = np.searchsorted(seg_levels, level, side='right') - 1
+        readers, operands = _edges(prog)
+        last_read_seg = np.full(n, -1, dtype=np.int64)
+        np.maximum.at(last_read_seg, operands, seg_of[readers])
+        escapes = (last_read_seg > seg_of) | np.isin(np.arange(n), prog.out_idxs[prog.out_idxs >= 0])
+        for g in range(len(seg_levels) - 1):
+            ops_g = np.flatnonzero(seg_of == g)
+            if not len(ops_g):
+                continue
+            uf = _UnionFind(len(ops_g))
+            local = np.full(n, -1, dtype=np.int64)
+            local[ops_g] = np.arange(len(ops_g))
+            in_seg = (seg_of[readers] == g) & (seg_of[operands] == g)
+            for rr, vv in zip(readers[in_seg], operands[in_seg]):
+                uf.union(int(local[rr]), int(local[vv]))
+            roots = np.asarray([uf.find(i) for i in range(len(ops_g))], dtype=np.int64)
+            comp_ids, comp_inv = np.unique(roots, return_inverse=True)
+            n_comp = len(comp_ids)
+            # weight: ops + 0.25 per value that stays live past the segment
+            weights = np.bincount(comp_inv, minlength=n_comp).astype(np.float64)
+            weights += 0.25 * np.bincount(comp_inv, weights=escapes[ops_g].astype(np.float64), minlength=n_comp)
+            # affinity: edges from this segment's ops to already-assigned shards
+            aff = np.zeros((n_comp, k), dtype=np.int64)
+            cross = (seg_of[readers] == g) & (seg_of[operands] < g)
+            for rr, vv in zip(readers[cross], operands[cross]):
+                aff[comp_inv[local[rr]], assign[vv]] += 1
+            load = np.zeros(k, dtype=np.float64)
+            for c in np.argsort(-weights, kind='stable'):
+                s = min(range(k), key=lambda s: (load[s], -aff[c, s], s))
+                load[s] += weights[c]
+                assign[ops_g[comp_inv == c]] = s
+    plan = PartitionPlan(
+        k=int(k),
+        n_ops=n,
+        program_digest=program_plan_digest(prog),
+        assign=assign,
+        seg_levels=seg_levels,
+    )
+    validate_plan(prog, plan)
+    return plan
+
+
+def validate_plan(prog: DaisProgram, plan: PartitionPlan) -> None:
+    """Check a plan against a program; raises ``ValueError`` on any
+    mismatch (fail-closed: a stale or tampered plan must never reach a
+    sharded executor)."""
+    if plan.k < 1:
+        raise ValueError(f'partition plan: shard count {plan.k} < 1')
+    if plan.n_ops != prog.n_ops:
+        raise ValueError(f'partition plan is for a {plan.n_ops}-op program, got {prog.n_ops} ops')
+    digest = program_plan_digest(prog)
+    if plan.program_digest and plan.program_digest != digest:
+        raise ValueError(
+            f'partition plan digest mismatch (plan {plan.program_digest[:12]}… != program {digest[:12]}…); '
+            f'refusing a plan built for a different program'
+        )
+    if len(plan.assign) != prog.n_ops:
+        raise ValueError('partition plan: assignment length mismatch')
+    if prog.n_ops and (plan.assign.min() < 0 or plan.assign.max() >= plan.k):
+        raise ValueError('partition plan: shard assignment out of range')
+    sched = levelize_program(prog)
+    seg = np.asarray(plan.seg_levels, dtype=np.int64)
+    if len(seg) < 1 or (len(seg) > 1 and (np.diff(seg) <= 0).any()):
+        raise ValueError('partition plan: segment levels must be strictly increasing')
+    if prog.n_ops and (seg[0] != 0 or seg[-1] < sched.depth):
+        raise ValueError(f'partition plan: segments cover levels {seg[0]}..{seg[-1]}, program has depth {sched.depth}')
+    if prog.n_ops:
+        level = sched.level.astype(np.int64)
+        seg_of = np.searchsorted(seg, level, side='right') - 1
+        readers, operands = _edges(prog)
+        same = seg_of[readers] == seg_of[operands]
+        if np.any(plan.assign[readers[same]] != plan.assign[operands[same]]):
+            bad = readers[same][plan.assign[readers[same]] != plan.assign[operands[same]]][0]
+            raise ValueError(
+                f'partition plan: intra-segment operand edge crosses shards at op {int(bad)} '
+                f'(closure violated — the plan cannot execute with boundary-only exchanges)'
+            )
+
+
+def plan_to_dict(plan: PartitionPlan) -> dict:
+    """JSON-able plan (the ``partition.json`` payload of an export artifact)."""
+    return {
+        'format': 'da4ml-partition-plan',
+        'version': PLAN_VERSION,
+        'k': int(plan.k),
+        'n_ops': int(plan.n_ops),
+        'program_digest': plan.program_digest,
+        'assign': np.asarray(plan.assign, dtype=np.int32).tolist(),
+        'seg_levels': np.asarray(plan.seg_levels, dtype=np.int64).tolist(),
+    }
+
+
+def plan_from_dict(doc: dict) -> PartitionPlan:
+    """Inverse of :func:`plan_to_dict`; raises ``ValueError`` on a wrong
+    format or a newer plan version."""
+    if doc.get('format') != 'da4ml-partition-plan':
+        raise ValueError(f'not a partition plan document (format={doc.get("format")!r})')
+    if int(doc.get('version', -1)) > PLAN_VERSION:
+        raise ValueError(f'partition plan version {doc.get("version")} is newer than supported {PLAN_VERSION}')
+    return PartitionPlan(
+        k=int(doc['k']),
+        n_ops=int(doc['n_ops']),
+        program_digest=str(doc.get('program_digest', '')),
+        assign=np.asarray(doc['assign'], dtype=np.int32),
+        seg_levels=np.asarray(doc['seg_levels'], dtype=np.int64),
+    )
+
+
+def _empty_cell(n_out_pad: int) -> DaisProgram:
+    """A cell with no ops: all-outputs-hole filler for an idle shard."""
+    z = np.zeros(0, dtype=np.int32)
+    return DaisProgram(
+        n_in=0,
+        n_out=n_out_pad,
+        inp_shifts=z,
+        out_idxs=np.full(n_out_pad, -1, dtype=np.int32),
+        out_shifts=np.zeros(n_out_pad, dtype=np.int32),
+        out_negs=np.zeros(n_out_pad, dtype=np.int32),
+        opcode=z, id0=z, id1=z, data_lo=z, data_hi=z, signed=z, integers=z, fractionals=z,
+        tables=(),
+    )  # fmt: skip
+
+
+def build_shards(prog: DaisProgram, plan: PartitionPlan) -> ShardBuild:
+    """Derive the executable cells + exchange layout from a validated plan.
+
+    Deterministic in (program, plan): an exported plan rebuilds the exact
+    same cells on every replica. Raises ``ValueError`` via
+    :func:`validate_plan` first — never builds from a mismatched plan.
+    """
+    validate_plan(prog, plan)
+    n, k = prog.n_ops, plan.k
+    sched = levelize_program(prog)
+    level = sched.level.astype(np.int64)
+    seg = np.asarray(plan.seg_levels, dtype=np.int64)
+    n_seg = plan.n_segments if n else 0
+    assign = np.asarray(plan.assign, dtype=np.int64)
+    seg_of = np.searchsorted(seg, level, side='right') - 1 if n else np.zeros(0, np.int64)
+    readers, operands = _edges(prog)
+
+    # escape classification per value: exported (read later by another shard,
+    # or a program output — the final gather computes outputs replicated) vs
+    # private (read later, own shard only) vs internal
+    is_out = np.zeros(n, dtype=bool)
+    out_idx = prog.out_idxs.astype(np.int64)
+    is_out[out_idx[out_idx >= 0]] = True
+    later = seg_of[readers] > seg_of[operands]
+    read_later = np.zeros(n, dtype=bool)
+    read_later[operands[later]] = True
+    remote_later = later & (assign[readers] != assign[operands])
+    exported = is_out.copy()
+    exported[operands[remote_later]] = True
+    private = read_later & ~exported
+
+    # per-op operand lists (reader-major) for local remapping
+    dep_order = np.argsort(readers, kind='stable')
+    dep_r, dep_v = readers[dep_order], operands[dep_order]
+    dep_starts = np.searchsorted(dep_r, np.arange(n + 1))
+
+    shards: list[list[SegmentShard]] = []
+    export_pad: list[int] = []
+    private_pad: list[int] = []
+    exchange: list[list[tuple[int, int]]] = []
+    pub_row = np.full(n, -1, dtype=np.int64)  # public-carry row per exported value
+    priv_row = np.full(n, -1, dtype=np.int64)  # private-carry row per private value
+    pub_base = prog.n_in  # rows 0..n_in-1 carry the program's input lanes (xT)
+    priv_base = 0
+
+    order = sched.order.astype(np.int64)
+    for g in range(n_seg):
+        cell_ops = [order[(seg_of[order] == g) & (assign[order] == s)] for s in range(k)]
+        exports = [ops[exported[ops]] for ops in cell_ops]
+        privates = [ops[private[ops]] for ops in cell_ops]
+        m_g = max((len(e) for e in exports), default=0)
+        p_g = max((len(p) for p in privates), default=0)
+        cells: list[SegmentShard] = []
+        bounds: list[tuple[int, int]] = []
+        for s in range(k):
+            ops_s, exp_s, prv_s = cell_ops[s], exports[s], privates[s]
+            bounds.append((pub_base + s * m_g, len(exp_s)))
+            if not len(ops_s):
+                cells.append(SegmentShard(_empty_cell(m_g + p_g), np.zeros(0, np.int64), 0, 0))
+                continue
+            in_set = set(ops_s.tolist())
+            # external lanes: raw input lanes (for this cell's opcode -1 ops),
+            # then received values — public-sourced first, then private, so
+            # the runtime can gather each carry contiguously
+            raw_lanes: dict[int, int] = {}
+            recv_pub: dict[int, int] = {}
+            recv_priv: dict[int, int] = {}
+            for i in ops_s:
+                if prog.opcode[i] == -1:
+                    raw_lanes.setdefault(int(prog.id0[i]), len(raw_lanes))
+                    continue
+                for v in dep_v[dep_starts[i] : dep_starts[i + 1]]:
+                    v = int(v)
+                    if v in in_set:
+                        continue
+                    if pub_row[v] >= 0:
+                        recv_pub.setdefault(v, len(recv_pub))
+                    elif priv_row[v] >= 0:
+                        recv_priv.setdefault(v, len(recv_priv))
+                    else:  # pragma: no cover - closure validated above
+                        raise ValueError(f'partition build: op {int(i)} reads unavailable value {v}')
+            n_raw, n_pub, n_prv = len(raw_lanes), len(recv_pub), len(recv_priv)
+            in_src = np.concatenate(
+                [
+                    np.fromiter(raw_lanes.keys(), np.int64, n_raw),
+                    pub_row[np.fromiter(recv_pub.keys(), np.int64, n_pub)] if n_pub else np.zeros(0, np.int64),
+                    -(1 + priv_row[np.fromiter(recv_priv.keys(), np.int64, n_prv)]) if n_prv else np.zeros(0, np.int64),
+                ]
+            )
+            in_ops = np.concatenate(
+                [
+                    -(1 + np.fromiter(raw_lanes.keys(), np.int64, n_raw)),
+                    np.fromiter(recv_pub.keys(), np.int64, n_pub),
+                    np.fromiter(recv_priv.keys(), np.int64, n_prv),
+                ]
+            )
+            n_ext = n_raw + n_pub + n_prv
+            n_recv = n_pub + n_prv
+            # local op list: receive copies first, then the cell's real ops
+            lmap = np.full(n, -1, dtype=np.int64)
+            recv_vals = list(recv_pub.keys()) + list(recv_priv.keys())
+            for j, v in enumerate(recv_vals):
+                lmap[v] = j
+            lmap[ops_s] = n_recv + np.arange(len(ops_s))
+            n_local = n_recv + len(ops_s)
+            oc_l = np.empty(n_local, np.int32)
+            id0_l = np.full(n_local, -1, np.int32)  # -1: slot unused (validate convention)
+            id1_l = np.full(n_local, -1, np.int32)
+            dlo_l = np.zeros(n_local, np.int32)
+            dhi_l = np.zeros(n_local, np.int32)
+            sg_l = np.empty(n_local, np.int32)
+            it_l = np.empty(n_local, np.int32)
+            fr_l = np.empty(n_local, np.int32)
+            tables: list[NDArray[np.int32]] = []
+            tmap: dict[int, int] = {}
+            for j, v in enumerate(recv_vals):
+                # receive lane: a copy op with the producer's exact metadata,
+                # so the input wrap is an identity on the in-range value and
+                # downstream operand metadata (f, sg, w) reads correctly
+                oc_l[j] = -1
+                id0_l[j] = n_raw + j
+                sg_l[j], it_l[j], fr_l[j] = prog.signed[v], prog.integers[v], prog.fractionals[v]
+            for j, i in enumerate(ops_s, start=n_recv):
+                oc = int(prog.opcode[i])
+                oc_l[j] = oc
+                sg_l[j], it_l[j], fr_l[j] = prog.signed[i], prog.integers[i], prog.fractionals[i]
+                dhi_l[j] = prog.data_hi[i]
+                if oc == -1:
+                    id0_l[j] = raw_lanes[int(prog.id0[i])]
+                    continue
+                if oc != 5:
+                    id0_l[j] = lmap[int(prog.id0[i])]
+                if oc in _USES_ID1:
+                    id1_l[j] = lmap[int(prog.id1[i])]
+                if abs(oc) == 6:
+                    dlo_l[j] = lmap[int(prog.data_lo[i])]
+                elif oc == 8:
+                    t = int(prog.data_lo[i])
+                    dlo_l[j] = tmap.setdefault(t, len(tmap))
+                    if dlo_l[j] == len(tables):
+                        tables.append(prog.tables[t])
+                else:
+                    dlo_l[j] = prog.data_lo[i]
+            out_l = np.full(m_g + p_g, -1, dtype=np.int32)
+            out_l[: len(exp_s)] = lmap[exp_s]
+            out_l[m_g : m_g + len(prv_s)] = lmap[prv_s]
+            cell = DaisProgram(
+                n_in=n_ext,
+                n_out=m_g + p_g,
+                inp_shifts=np.zeros(n_ext, dtype=np.int32),
+                out_idxs=out_l,
+                out_shifts=np.zeros(m_g + p_g, dtype=np.int32),
+                out_negs=np.zeros(m_g + p_g, dtype=np.int32),
+                opcode=oc_l, id0=id0_l, id1=id1_l, data_lo=dlo_l, data_hi=dhi_l,
+                signed=sg_l, integers=it_l, fractionals=fr_l,
+                tables=tuple(tables),
+            )  # fmt: skip
+            cell.validate()
+            cells.append(SegmentShard(cell, in_src, len(exp_s), len(prv_s), in_ops))
+            pub_row[exp_s] = pub_base + s * m_g + np.arange(len(exp_s))
+            priv_row[prv_s] = priv_base + np.arange(len(prv_s))
+        shards.append(cells)
+        export_pad.append(m_g)
+        private_pad.append(p_g)
+        exchange.append(bounds)
+        pub_base += k * m_g
+        priv_base += p_g
+
+    out_src = np.zeros(prog.n_out, dtype=np.int64)
+    out_sign = np.zeros(prog.n_out, dtype=np.int64)
+    for j in range(prog.n_out):
+        idx = int(out_idx[j])
+        if idx < 0:
+            continue
+        if pub_row[idx] < 0:  # pragma: no cover - outputs are always exported
+            raise ValueError(f'partition build: output {j} (op {idx}) was not exported')
+        out_src[j] = pub_row[idx]
+        out_sign[j] = -1 if prog.out_negs[j] else 1
+    return ShardBuild(
+        plan=plan,
+        shards=shards,
+        export_pad=export_pad,
+        private_pad=private_pad,
+        out_src=out_src,
+        out_sign=out_sign,
+        exchange=exchange,
+    )
+
+
+__all__ = [
+    'PLAN_VERSION',
+    'PartitionPlan',
+    'SegmentShard',
+    'ShardBuild',
+    'build_shards',
+    'partition_program',
+    'plan_from_dict',
+    'plan_to_dict',
+    'program_plan_digest',
+    'validate_plan',
+]

@@ -9,6 +9,8 @@ Both ride XLA collectives over ICI; no custom transport.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -18,6 +20,56 @@ def default_mesh(axis_name: str = 'batch', devices=None) -> Mesh:
     """A 1D mesh over all local devices."""
     devices = np.asarray(devices if devices is not None else jax.devices())
     return Mesh(devices.reshape(-1), (axis_name,))
+
+
+def resolve_mesh(axis_name: str = 'batch', tpu_only: bool = True) -> Mesh | None:
+    """The one ``DA4ML_JAX_MESH`` policy, shared by the CMVM search's
+    ``_auto_mesh`` and the runtime (docs/api.md#environment-knobs):
+
+    - ``DA4ML_JAX_MESH=0`` — never build a mesh;
+    - ``DA4ML_JAX_MESH=1`` — build one on any multi-device backend;
+    - unset — multi-device TPU backends only when ``tpu_only`` (the
+      default: CPU/GPU "devices" are usually host threads where sharding
+      only adds dispatch overhead); ``tpu_only=False`` drops the backend
+      check for callers that already decided to shard (forced model
+      sharding, tests on the 8-device CPU mesh).
+
+    Returns a 1-D ``(axis_name,)`` mesh over all local devices, or None.
+    """
+    env = os.environ.get('DA4ML_JAX_MESH', '').strip()
+    if env == '0':
+        return None
+    if tpu_only and env != '1':
+        try:
+            if jax.default_backend() != 'tpu':
+                return None
+        except Exception:
+            return None
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return None
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def model_mesh(k: int) -> Mesh | None:
+    """A 2-D ``('batch', 'model')`` mesh with ``k`` devices on the model
+    axis, or None when the topology cannot host it (fewer than ``k``
+    local devices, device count not divisible by ``k``, ``k < 2``, or
+    meshes disabled via ``DA4ML_JAX_MESH=0``). The sample axis keeps the
+    remaining devices data-parallel."""
+    if k < 2 or os.environ.get('DA4ML_JAX_MESH', '').strip() == '0':
+        return None
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return None
+    n = len(devs)
+    if n < k or n % k:
+        return None
+    return Mesh(np.asarray(devs).reshape(n // k, k), ('batch', 'model'))
 
 
 def batch_sharding(mesh: Mesh, axis_name: str = 'batch') -> NamedSharding:
@@ -106,6 +158,8 @@ def __getattr__(name):
 
 __all__ = [
     'default_mesh',
+    'resolve_mesh',
+    'model_mesh',
     'batch_sharding',
     'local_batch_sharding',
     'shard_batch',

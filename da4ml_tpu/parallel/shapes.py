@@ -82,3 +82,42 @@ def pad_rows(x: NDArray, lo: int = 1, even: bool = False) -> tuple[NDArray, int]
         return x, n
     widths = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
     return np.pad(x, widths), n
+
+
+def canon_multiple(n: int, multiple: int) -> int:
+    """Smallest canonical grid rung >= ``n`` divisible by ``multiple``;
+    plain round-up when the grid has no such rung (multiples off the
+    2^k / 3·2^k / 5·2^k lattice, e.g. 7 devices).
+
+    This is the mesh-aware batch quantum: a batch sharded over ``multiple``
+    devices must split evenly, and landing the padded size on the grid
+    keeps the dispatch on an already-compiled shape
+    (docs/serving.md#shape-canonicalization).
+    """
+    multiple = max(multiple, 1)
+    c = canon_dim(max(n, multiple), lo=1, even=False)
+    # rung spacing is geometric (ratio <= 4/3): a divisible rung, if one
+    # exists, appears within a few steps of doubling past n
+    limit = next_pow2(max(n, multiple)) * 2
+    while c <= limit:
+        if c % multiple == 0:
+            return c
+        c = canon_dim(c + 1, lo=1, even=False)
+    return -(-n // multiple) * multiple
+
+
+def pad_rows_multiple(x: NDArray, multiple: int) -> tuple[NDArray, int]:
+    """Pad the sample axis up to :func:`canon_multiple`; returns ``(padded, n)``.
+
+    The runtime's sharded dispatch path uses this so small or ragged
+    batches still ride the device mesh — padded onto the canonical grid,
+    split evenly across devices, trimmed after — instead of silently
+    falling back to single-device execution.
+    """
+    x = np.asarray(x)
+    n = x.shape[0]
+    target = canon_multiple(n, multiple)
+    if target == n:
+        return x, n
+    widths = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, widths), n

@@ -182,7 +182,16 @@ def _run_batch(fn, xp: NDArray, sharding=None, x64: bool = False) -> NDArray:
     mult = int(sharding.mesh.devices.size) if sharding is not None else 1
     chunk = -(-n // nc)
     if mult > 1:
-        chunk = -(-chunk // mult) * mult
+        if nc == 1:
+            # small/ragged batches ride the mesh too: pad onto the canonical
+            # shape grid (smallest rung divisible by the device count), so
+            # the padded dispatch lands on an already-compiled shape and the
+            # trim below keeps outputs byte-identical
+            from ..parallel.shapes import canon_multiple
+
+            chunk = canon_multiple(n, mult)
+        else:
+            chunk = -(-chunk // mult) * mult
     nc = max(-(-n // chunk), 1)
     pad = chunk * nc - n
     if pad:
@@ -309,6 +318,88 @@ def _store_mode_decision(digest: str, platform: str, mode: str, info: dict) -> N
         pass
 
 
+# model-axis shard decisions: measured winner of the sharded-vs-single race,
+# cached like mode decisions (0 = single-device won, k = adopt a k-way cut)
+_SHARD_DECISIONS: dict[tuple[str, str], int] = {}
+
+
+def _shard_decision_path(d: str, digest: str, platform: str) -> str:
+    return os.path.join(d, f'{digest}.{platform}.shard.json')
+
+
+def _load_shard_decision(digest: str, platform: str) -> int | None:
+    k = _SHARD_DECISIONS.get((digest, platform))
+    if k is not None:
+        return k
+    d = _mode_cache_dir()
+    if not d:
+        return None
+    try:
+        with open(_shard_decision_path(d, digest, platform)) as fh:
+            blob = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    k = blob.get('model_shard')
+    if isinstance(k, int) and k >= 0 and blob.get('platform', platform) == platform:
+        _SHARD_DECISIONS[(digest, platform)] = k
+        return k
+    return None
+
+
+def _store_shard_decision(digest: str, platform: str, k: int, info: dict) -> None:
+    _SHARD_DECISIONS[(digest, platform)] = k
+    d = _mode_cache_dir()
+    if not d:
+        return
+    path = _shard_decision_path(d, digest, platform)
+    tmp = f'{path}.tmp{os.getpid()}'
+    try:
+        with open(tmp, 'w') as fh:
+            json.dump({'model_shard': k, 'platform': platform, **info}, fh)
+        os.replace(tmp, path)
+    except OSError:  # pragma: no cover - unwritable cache dir
+        pass
+
+
+def _model_shard_request() -> tuple[str, int]:
+    """Parse ``DA4ML_RUN_MODEL_SHARD`` into ``(policy, k)``.
+
+    Policies (docs/runtime.md#model-parallel-execution):
+
+    - ``'off'`` (``0``/``off``) — never model-shard;
+    - ``'tpu'`` (unset, the default) — race sharded-vs-single on TPU
+      backends only, where the ICI makes boundary exchanges cheap;
+    - ``'race'`` (``auto``) — race wherever a model mesh exists (the CI
+      setting: the 8-device CPU mesh measures, and single-device wins stay
+      single-device);
+    - ``'force'`` (``on``/``1`` or an integer ``K >= 2``) — adopt a K-way
+      cut without racing (``on`` uses every local device); falls back to
+      single-device with a ``run.shard.fallbacks`` count when the topology
+      cannot host the mesh.
+
+    ``k == 0`` means "resolve from the topology" (all local devices).
+    """
+    env = os.environ.get('DA4ML_RUN_MODEL_SHARD', '').strip().lower()
+    if env in ('0', 'off', 'false', 'no'):
+        return 'off', 0
+    if env in ('', 'default'):
+        return 'tpu', 0
+    if env == 'auto':
+        return 'race', 0
+    if env in ('1', 'on', 'true', 'yes'):
+        return 'force', 0
+    try:
+        k = int(env)
+    except ValueError:
+        telemetry.warn_once(
+            'runtime.model_shard_env',
+            f'DA4ML_RUN_MODEL_SHARD={env!r} is not 0/off, auto, on/1 or an integer K>=2; using the default policy',
+            logger='runtime.jax',
+        )
+        return 'tpu', 0
+    return ('force', k) if k >= 2 else ('off', 0)
+
+
 def validate_batch(data, n_in: int, what: str = 'DaisExecutor') -> NDArray[np.float64]:
     """Validate an inference batch before dispatch, raising the reliability
     taxonomy's :class:`~da4ml_tpu.reliability.errors.InvalidInputError`
@@ -383,7 +474,15 @@ class DaisExecutor:
         force_i64: bool | None = None,
         mode: str = 'auto',
         autotune_min_ops: int | None = None,
+        partition_plan=None,
+        model_shard: bool | None = None,
     ):
+        """``partition_plan`` (an ``ir.partition.PartitionPlan``, e.g. from
+        an export artifact) pins the model-axis cut; ``model_shard`` forces
+        (True) or forbids (False) the model-parallel path regardless of the
+        ``DA4ML_RUN_MODEL_SHARD`` policy — None defers to it. Per-cell
+        executors are built with ``model_shard=False`` (no recursive cuts).
+        """
         prog.validate()
         # below this op count 'auto' keeps the static unroll heuristic; pass 0
         # to always measure — fused whole-model programs are deep even when
@@ -432,6 +531,11 @@ class DaisExecutor:
                 self.fn_int_packed = self.fn_int
             dn = _donate_argnums()
             self._fn_call = jax.jit(packed, donate_argnums=dn) if dn else self.fn_int_packed
+            self.model_shards = 0
+            self._shard_build = None
+            self._fn_sharded_call = None
+            self._shard_sharding = None
+            self._init_model_shard(partition_plan, model_shard)
         self._compile_recorded = False
         telemetry.counter(f'run.mode.{self.mode}').inc()
 
@@ -584,6 +688,270 @@ class DaisExecutor:
         _store_mode_decision(digest, platform, mode, info)
         return mode, prejit
 
+    # -- model-axis sharding ----------------------------------------------
+
+    def _init_model_shard(self, plan, override) -> None:
+        """Resolve the model-parallel policy at construction time.
+
+        A ``partition_plan`` (from an export artifact) is authoritative: it
+        is adopted whenever the topology can host its mesh — the TVM-style
+        compile/serve split, the replica never re-races an export-time
+        decision. Without a plan the ``DA4ML_RUN_MODEL_SHARD`` policy
+        decides: force adopts, race measures sharded-vs-single and picks
+        the winner (cached per program digest, like mode decisions).
+        """
+        if override is False or self.prog.n_ops == 0:
+            return
+        policy, k_req = _model_shard_request()
+        if override is True and policy != 'force':
+            policy, k_req = 'force', k_req
+        if policy == 'off':
+            return
+        from ..parallel import model_mesh
+
+        if plan is not None:
+            mesh = model_mesh(int(plan.k))
+            if mesh is not None:
+                self._adopt_model_shard(int(plan.k), mesh, plan=plan)
+            elif jax.local_device_count() > 1 or policy == 'force':
+                # multi-device host that cannot host the plan's mesh (or a
+                # forced request): count the fallback; single-device hosts
+                # ignore plans silently by design
+                telemetry.counter('run.shard.fallbacks').inc()
+            return
+        if policy == 'tpu':
+            if _platform() != 'tpu':
+                return
+            policy = 'race'
+        k = k_req or jax.local_device_count()
+        mesh = model_mesh(k)
+        if mesh is None:
+            if policy == 'force':
+                telemetry.counter('run.shard.fallbacks').inc()
+                telemetry.warn_once(
+                    'runtime.model_shard_mesh',
+                    f'DA4ML_RUN_MODEL_SHARD requested a {k}-way model mesh but the topology '
+                    f'({jax.local_device_count()} local devices) cannot host it; running single-device',
+                    logger='runtime.jax',
+                )
+            return
+        if policy == 'force':
+            self._adopt_model_shard(k, mesh)
+        else:
+            self._race_model_shard(k, mesh)
+
+    def _cell_raw(self, cell_prog: DaisProgram, inner_mode: str):
+        """Lower one partition cell through the standard per-mode builders
+        without paying a full executor construction (no jit, packing or
+        telemetry — the outer shard_map program owns all of that)."""
+        host = object.__new__(DaisExecutor)
+        host._autotune_min_ops = 0
+        host.prog = cell_prog
+        host.use_i64 = self.use_i64
+        host.dtype = self.dtype
+        host._tables = tuple(jnp.asarray(t, dtype=self.dtype) for t in cell_prog.tables)
+        mode = inner_mode
+        if mode == 'pallas':
+            # per-cell fallback ladder: an unlowerable cell degrades to
+            # level while the other shards keep their mega-kernels
+            mode = self._pallas_or_fallback(cell_prog)
+        return host._builders()[mode]()
+
+    def _build_model_sharded(self, k: int, mesh, plan=None):
+        """Build the ``shard_map`` model-parallel kernel over ``mesh``.
+
+        Levels are grouped into segments (``ir.partition``); each shard runs
+        its per-segment cells through the ordinary lowerings — one pallas
+        mega-kernel per cell when the outer mode is pallas — and segment
+        boundaries exchange each shard's contiguous exported slab with one
+        tiled ``all_gather`` into the replicated public carry. Private
+        carries never leave their shard. Bit-exact by construction: all
+        DAIS ops are integer-exact and the carries are integer buffers.
+
+        Returns ``(raw_fn, build)`` with the single-device raw contract
+        ((batch, n_in) int -> (batch, n_out) int).
+        """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        from ..ir.partition import build_shards, partition_program
+
+        prog = self.prog
+        with telemetry.span('run.partition', k=int(k), n_ops=prog.n_ops):
+            if plan is None:
+                plan = partition_program(prog, int(k))
+            build = build_shards(prog, plan)  # validates: digest fail-closed
+        k = int(plan.k)
+        dtype = self.dtype
+        inner_mode = 'pallas' if self.mode == 'pallas' else 'level'
+        cell_fns = [
+            [self._cell_raw(c.prog, inner_mode) if c.prog.n_ops else None for c in row] for row in build.shards
+        ]
+
+        n_in, n_seg = prog.n_in, build.n_segments
+        pub_bases, base = [], n_in
+        for m in build.export_pad:
+            pub_bases.append(base)
+            base += k * m
+        pub_total = max(base, 1)
+        priv_bases, pbase = [], 0
+        for p in build.private_pad:
+            priv_bases.append(pbase)
+            pbase += p
+        priv_total = max(pbase, 1)
+        out_src = np.asarray(build.out_src, dtype=np.int64)
+        out_sign = np.asarray(build.out_sign, dtype=np.int64 if self.use_i64 else np.int32)
+
+        def body(xs):
+            xT = xs.T.astype(dtype)
+            b = xT.shape[1]
+            s_idx = jax.lax.axis_index('model')
+            pub = jnp.zeros((pub_total, b), dtype)
+            if n_in:
+                pub = jax.lax.dynamic_update_slice(pub, xT, (0, 0))
+            priv = jnp.zeros((priv_total, b), dtype)
+            for g in range(n_seg):
+                m_g, p_g = build.export_pad[g], build.private_pad[g]
+                if m_g + p_g == 0:
+                    continue  # nothing escapes this segment: dead by liveness
+                branches = []
+                for s in range(k):
+                    fn = cell_fns[g][s]
+                    if fn is None:
+
+                        def branch(carry, m=m_g + p_g, b=b):
+                            return jnp.zeros((m, b), dtype)
+
+                    else:
+                        src = np.asarray(build.shards[g][s].in_src, dtype=np.int64)
+                        pub_idx = np.where(src >= 0, src, 0)
+                        prv_idx = np.where(src < 0, -1 - src, 0)
+                        is_pub = (src >= 0)[:, None]
+
+                        def branch(carry, fn=fn, pub_idx=pub_idx, prv_idx=prv_idx, is_pub=is_pub):
+                            pub_c, priv_c = carry
+                            xin = jnp.where(
+                                is_pub,
+                                jnp.take(pub_c, pub_idx, axis=0),
+                                jnp.take(priv_c, prv_idx, axis=0),
+                            )
+                            return fn(xin.T).T.astype(dtype)
+
+                    branches.append(branch)
+                slab = jax.lax.switch(s_idx, branches, (pub, priv))
+                if m_g:
+                    gathered = jax.lax.all_gather(slab[:m_g], 'model', axis=0, tiled=True)
+                    pub = jax.lax.dynamic_update_slice(pub, gathered, (pub_bases[g], 0))
+                if p_g:
+                    priv = jax.lax.dynamic_update_slice(priv, slab[m_g:], (priv_bases[g], 0))
+            outs = jnp.take(pub, out_src, axis=0) * out_sign[:, None]
+            return outs.T
+
+        raw = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=PartitionSpec('batch', None),
+            out_specs=PartitionSpec('batch', None),
+            check_rep=False,
+        )
+        return raw, build
+
+    def _finish_adopt(self, k: int, mesh, raw, build) -> None:
+        """Install a built sharded kernel as the ``__call__`` dispatch target
+        and emit the run.shard.* build telemetry."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        packed = raw
+        if self._in_group or self._out_group:
+            packed = _wrap_packed(raw, self.prog.n_in, self.prog.n_out, self._in_group, self._out_group, self.dtype)
+        self._fn_sharded_call = _maybe_scoped(jax.jit(packed), self.use_i64)
+        self._shard_sharding = NamedSharding(mesh, PartitionSpec('batch', None))
+        self._shard_build = build
+        self.model_shards = int(k)
+        itemsize = 8 if self.use_i64 else 4
+        telemetry.counter('run.shard.partitions').inc(int(k))
+        telemetry.gauge('run.shard.imbalance').set(build.imbalance)
+        for g in range(build.n_segments):
+            # per-sample bytes every boundary moves over the interconnect
+            telemetry.histogram('run.shard.exchange_bytes', telemetry.BYTES_BUCKETS).observe(
+                build.exchange_rows(g) * itemsize
+            )
+
+    def _adopt_model_shard(self, k: int, mesh, plan=None) -> None:
+        """Forced adoption (explicit K, ``on``, or an artifact plan): build
+        the sharded kernel, falling back to single-device — with a
+        ``run.shard.fallbacks`` count — instead of failing the executor."""
+        try:
+            raw, build = self._build_model_sharded(k, mesh, plan)
+        except Exception as e:
+            telemetry.counter('run.shard.fallbacks').inc()
+            telemetry.warn_once(
+                'runtime.model_shard_build',
+                f'model-parallel build failed ({type(e).__name__}: {e}); running single-device',
+                logger='runtime.jax',
+            )
+            return
+        self._finish_adopt(k, mesh, raw, build)
+
+    def _race_model_shard(self, k: int, mesh) -> None:
+        """Measured sharded-vs-single race, decided and cached exactly like
+        the mode autotune: compile both, time one warm synthetic batch
+        best-of-2, adopt sharded only when it wins the clock."""
+        digest, platform = self._digest(), _platform()
+        cached = _load_shard_decision(digest, platform)
+        if cached == 0:
+            return
+        if cached:
+            self._adopt_model_shard(int(cached), mesh)
+            return
+        info: dict = {}
+        try:
+            bsz = int(os.environ.get('DA4ML_RUN_AUTOTUNE_BATCH', '') or 4096)
+        except ValueError:
+            bsz = 4096
+        from ..parallel.shapes import canon_multiple
+
+        bsz = canon_multiple(bsz, int(mesh.devices.size))
+        np_dt = np.int64 if self.use_i64 else np.int32
+        n_in = max(self.prog.n_in, 1)
+        x = ((np.arange(bsz * n_in, dtype=np.int64).reshape(bsz, -1) * 2654435761) % 255 - 127).astype(np_dt)
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            raw, build = self._build_model_sharded(k, mesh)
+            jitted = jax.jit(raw)
+            xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec('batch', None)))
+            jax.block_until_ready(jitted(xs))
+        except Exception as e:
+            telemetry.counter('run.shard.fallbacks').inc()
+            telemetry.warn_once(
+                'runtime.model_shard_race',
+                f'model-parallel race candidate failed to build ({type(e).__name__}: {e}); '
+                f'keeping single-device execution',
+                logger='runtime.jax',
+            )
+            info['shard_error'] = f'{type(e).__name__}: {e}'[:200]
+            _store_shard_decision(digest, platform, 0, info)
+            return
+        t_shard = float('inf')
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jitted(xs))
+            t_shard = max(min(t_shard, time.perf_counter() - t0), 1e-9)
+        jax.block_until_ready(self.fn_int(x))  # warm (compile paid once either way)
+        t_single = float('inf')
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(self.fn_int(x))
+            t_single = max(min(t_single, time.perf_counter() - t0), 1e-9)
+        info['k'] = int(k)
+        info['sharded_samples_per_s'] = round(bsz / t_shard, 1)
+        info['single_samples_per_s'] = round(bsz / t_single, 1)
+        win = int(k) if t_shard < t_single else 0
+        _store_shard_decision(digest, platform, win, info)
+        if win:
+            self._finish_adopt(k, mesh, raw, build)
+
     # -- kernel builders ---------------------------------------------------
 
     def _build(self):
@@ -691,6 +1059,8 @@ class DaisExecutor:
                     continue
                 v = buf[idx]
                 outs.append(-v if prog.out_negs[j] else v)
+            if not outs:
+                return jnp.zeros((x.shape[0], 0), dtype=dtype)
             return jnp.stack(outs, axis=-1)
 
         return fn
@@ -796,6 +1166,10 @@ class DaisExecutor:
             # x: (batch, n_in) integers
             batch = x.shape[0]
             xT = x.T.astype(dtype)  # [n_in, batch]
+            if prog.n_in == 0:
+                # all-const program (e.g. a partition cell of pure consts):
+                # keep one dummy lane so the traced copy branch can index
+                xT = jnp.zeros((1, batch), dtype=dtype)
 
             def step(buf, p):
                 x0 = buf[p['id0']]
@@ -880,6 +1254,8 @@ class DaisExecutor:
                     continue
                 v = buf[idx]
                 outs.append(-v if prog.out_negs[j] else v)
+            if not outs:
+                return jnp.zeros((batch, 0), dtype=dtype)
             return jnp.stack(outs, axis=-1)
 
         return fn
@@ -1143,8 +1519,14 @@ class DaisExecutor:
         t0 = time.perf_counter()
         with telemetry.span('run.call', mode=self.mode, n_samples=len(data)) as sp:
             xp = self._pack_inputs_np(self._int_inputs(data))
+            fn, sharding = self._fn_call, _active_sharding()
+            if self.model_shards:
+                # model-parallel dispatch: the shard_map kernel owns the 2-D
+                # ('batch','model') placement; batch padding in _run_batch
+                # keeps the sample axis divisible across the mesh
+                fn, sharding = self._fn_sharded_call, self._shard_sharding
             with _prof.annotate('run.call', sp.span_id):
-                raw = _run_batch(self._fn_call, xp, sharding=_active_sharding(), x64=self.use_i64)
+                raw = _run_batch(fn, xp, sharding=sharding, x64=self.use_i64)
             out = self._unpack_outputs_np(np.asarray(raw))
             res = out.astype(np.float64) * self._out_scale()
         _record_call(self, len(data), time.perf_counter() - t0, nbytes=xp.nbytes + out.nbytes)

@@ -297,7 +297,7 @@ def fleet_chaos_drill(
     from .engine import _as_binaries
     from ..ir.dais_binary import decode
 
-    binaries, _src = _as_binaries(model)
+    binaries, _src, _plan = _as_binaries(model)
     n_in = decode(binaries[0]).n_in
     oracle = _numpy_oracle(binaries)
     pool = make_request_pool(oracle, n_in, rows_choices=(1, 2, 4, 8), pool=32)

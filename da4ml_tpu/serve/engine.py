@@ -100,6 +100,7 @@ class _ModelState:
     name: str
     binaries: list[NDArray[np.int32]]
     source: str | None
+    partition: object = None  # artifact PartitionPlan (model-axis cut) or None
     version: int = 1
     queue: AdmissionQueue = field(default=None)  # type: ignore[assignment]
     lock: threading.Lock = field(default_factory=lambda: make_lock('serve.engine.model'))
@@ -114,38 +115,49 @@ class _ModelState:
     served_s_total: float = 0.0
 
 
-def _as_binaries(source) -> tuple[list[NDArray[np.int32]], str | None]:
-    """Normalize a model source into its per-stage DAIS binaries.
+def _as_binaries(source) -> tuple[list[NDArray[np.int32]], str | None, object]:
+    """Normalize a model source into ``(binaries, source_path, partition)``.
 
     Accepts a saved CombLogic/Pipeline ``.json`` path, an export artifact
     directory (``da4ml-tpu export``, digest-checked on load), a live
     ``CombLogic``/``Pipeline``, or raw binaries (one int32 array or a
-    list of them).
+    list of them). ``partition`` is the artifact's model-axis
+    :class:`~..ir.partition.PartitionPlan` when one is stamped into it
+    (docs/runtime.md#model-parallel-execution), else None.
     """
     from ..ir.comb import CombLogic, Pipeline
 
     if isinstance(source, (str, Path)):
         path = Path(source)
         if path.is_dir():
-            from .export import load_artifact
+            from .export import load_artifact, load_partition_plan
 
-            binary, _meta = load_artifact(path)  # raises ValueError on digest mismatch
-            return [binary], str(path)
+            binary, meta = load_artifact(path)  # raises ValueError on digest mismatch
+            return [binary], str(path), load_partition_plan(path, meta)
         import json
 
         data = json.loads(path.read_text())
         obj = Pipeline.from_dict(data) if 'stages' in data else CombLogic.from_dict(data)
-        bins, _ = _as_binaries(obj)
-        return bins, str(path)
+        bins, _, _ = _as_binaries(obj)
+        return bins, str(path), None
     if isinstance(source, Pipeline):
-        return [s.to_binary() for s in source.stages], None
+        return [s.to_binary() for s in source.stages], None, None
     if isinstance(source, CombLogic):
-        return [source.to_binary()], None
+        return [source.to_binary()], None, None
     if isinstance(source, np.ndarray):
-        return [np.asarray(source, dtype=np.int32)], None
+        return [np.asarray(source, dtype=np.int32)], None, None
     if isinstance(source, (list, tuple)):
-        return [np.asarray(b, dtype=np.int32) for b in source], None
+        return [np.asarray(b, dtype=np.int32) for b in source], None, None
     raise TypeError(f'cannot load a serve model from {type(source).__name__}')
+
+
+def _same_plan(a, b) -> bool:
+    """True when two partition plans (or None) describe the same cut."""
+    if a is None or b is None:
+        return a is b
+    from ..ir.partition import plan_to_dict
+
+    return plan_to_dict(a) == plan_to_dict(b)
 
 
 #: live engines, for the /healthz–/statusz serve-plane checks
@@ -172,13 +184,13 @@ class ServeEngine:
 
     def load_model(self, name: str, source, prewarm: bool | None = None) -> None:
         """Load (or replace) a model and start its batcher thread."""
-        binaries, src = _as_binaries(source)
+        binaries, src, plan = _as_binaries(source)
         prog0, progL = decode(binaries[0]), decode(binaries[-1])
         with self._lock:
             existing = self._models.get(name)
             if existing is not None:
                 raise ValueError(f'model {name!r} already loaded (use reload())')
-            state = _ModelState(name=name, binaries=binaries, source=src)
+            state = _ModelState(name=name, binaries=binaries, source=src, partition=plan)
             state.n_in, state.n_out = prog0.n_in, progL.n_out
             state.queue = AdmissionQueue(self.config.queue_cap_rows, self.config.shed_policy)
             self._models[name] = state
@@ -203,7 +215,7 @@ class ServeEngine:
                 source = state.binaries  # rebuild in place (executor refresh)
             else:
                 source = state.source
-        binaries, src = _as_binaries(source)
+        binaries, src, plan = _as_binaries(source)
         prog0, progL = decode(binaries[0]), decode(binaries[-1])
         if (prog0.n_in, progL.n_out) != (state.n_in, state.n_out):
             raise ValueError(
@@ -213,10 +225,14 @@ class ServeEngine:
         new_version = state.version + 1
         # same-program reload (e.g. re-pointing at an export artifact of the
         # live model): the warm executor is reused as-is — zero new XLA
-        # compiles, the canonical grid stays warm
+        # compiles, the canonical grid stays warm. A changed partition plan
+        # changes the compiled program, so it forces a rebuild like changed
+        # binaries would.
         executor = None
-        same = len(binaries) == len(state.binaries) and all(
-            np.array_equal(a, b) for a, b in zip(binaries, state.binaries)
+        same = (
+            len(binaries) == len(state.binaries)
+            and all(np.array_equal(a, b) for a, b in zip(binaries, state.binaries))
+            and _same_plan(plan, state.partition)
         )
         if same:
             with self._exec_lock:
@@ -226,7 +242,7 @@ class ServeEngine:
         if executor is not None:
             warm = set(state.warm_rows)
         else:
-            executor = self._build_executor(binaries)
+            executor = self._build_executor(binaries, plan)
             warm = set()
             if self.config.prewarm:
                 warm = self._warm_executor(executor, state.n_in)
@@ -234,6 +250,7 @@ class ServeEngine:
             state.binaries = binaries
             state.version = new_version
             state.warm_rows = warm
+            state.partition = plan
             if src is not None:
                 state.source = src
         with self._exec_lock:
@@ -299,11 +316,14 @@ class ServeEngine:
 
     # -- executors ------------------------------------------------------------
 
-    def _build_executor(self, binaries: list[NDArray[np.int32]]):
+    def _build_executor(self, binaries: list[NDArray[np.int32]], plan=None):
         from ..runtime.jax_backend import DaisExecutor, PipelineExecutor
 
         if len(binaries) == 1:
-            return DaisExecutor(decode(binaries[0]))
+            # the artifact's export-time partition plan (if any) rides along;
+            # hosts that cannot host the model mesh ignore it inside the
+            # executor (docs/runtime.md#model-parallel-execution)
+            return DaisExecutor(decode(binaries[0]), partition_plan=plan)
         return PipelineExecutor([decode(b) for b in binaries])
 
     def _executor_for(self, state: _ModelState):
@@ -314,7 +334,7 @@ class ServeEngine:
             if entry is not None and entry[0] == state.version:
                 self._executors[state.name] = self._executors.pop(state.name)  # LRU touch (dict keeps insertion order)
                 return entry[1]
-        executor = self._build_executor(state.binaries)
+        executor = self._build_executor(state.binaries, state.partition)
         with self._exec_lock:
             while len(self._executors) >= self.config.executor_cache_cap:
                 oldest = next(iter(self._executors))

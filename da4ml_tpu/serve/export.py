@@ -74,17 +74,27 @@ def _export_stablehlo(fused: NDArray[np.int32], outdir: Path) -> tuple[str | Non
         return None, f'{type(e).__name__}: {e}'
 
 
-def export_model(source, outdir, name: str = 'model', stablehlo: bool = True) -> dict:
+def export_model(
+    source, outdir, name: str = 'model', stablehlo: bool = True, model_shards: int | None = None
+) -> dict:
     """Write a self-contained serving artifact for ``source`` into ``outdir``.
 
     ``source`` is anything ``ServeEngine`` accepts (saved ``.json`` path,
     live CombLogic/Pipeline, raw binaries). Returns the metadata dict.
+
+    ``model_shards=K`` (K >= 2) additionally computes the K-way model-axis
+    :class:`~..ir.partition.PartitionPlan` at export time and stamps it into
+    the artifact as ``partition.json`` — digest-covered by ``meta.json`` —
+    so a serving replica hot-loads the exact export-time cut with no
+    re-partitioning (docs/runtime.md#model-parallel-execution). Hosts whose
+    topology cannot host the mesh load the same artifact and ignore the
+    plan.
     """
     from ..ir.dais_binary import decode
     from ..ir.fuse import fuse_binaries
     from .engine import _as_binaries
 
-    binaries, _ = _as_binaries(source)
+    binaries, _, _ = _as_binaries(source)
     outdir = Path(outdir)
     outdir.mkdir(parents=True, exist_ok=True)
     with telemetry.span('serve.export', stages=len(binaries)):
@@ -102,6 +112,16 @@ def export_model(source, outdir, name: str = 'model', stablehlo: bool = True) ->
                 separators=(',', ':'),
             )
         )
+        partition_name = partition_sha = None
+        if model_shards is not None and int(model_shards) >= 2:
+            from ..ir.partition import partition_program, plan_to_dict
+
+            with telemetry.span('run.partition', k=int(model_shards), n_ops=prog.n_ops):
+                plan = partition_program(prog, int(model_shards))
+            payload = json.dumps(plan_to_dict(plan), separators=(',', ':'))
+            (outdir / 'partition.json').write_text(payload)
+            partition_name = 'partition.json'
+            partition_sha = hashlib.sha256(payload.encode()).hexdigest()
         hlo_name, hlo_error = _export_stablehlo(fused, outdir) if stablehlo else (None, 'disabled')
         meta = {
             'format': ARTIFACT_FORMAT,
@@ -112,6 +132,9 @@ def export_model(source, outdir, name: str = 'model', stablehlo: bool = True) ->
             'source_stages': len(binaries),
             'fused_ops': int(prog.n_ops),
             'digest': digest,
+            'partition': partition_name,
+            'partition_digest': partition_sha,
+            'model_shards': int(model_shards) if partition_name else None,
             'stablehlo': hlo_name,
             'stablehlo_error': hlo_error,
             'created_unix': int(time.time()),
@@ -143,7 +166,35 @@ def load_artifact(path) -> tuple[NDArray[np.int32], dict]:
             f'{path}: artifact digest mismatch (meta {str(meta.get("digest"))[:12]}… != '
             f'program {digest[:12]}…); refusing to serve a tampered or half-written artifact'
         )
+    if meta.get('partition'):
+        # the partition plan is covered by the same fail-closed contract:
+        # verify its bytes here even on hosts that will ignore the plan
+        payload = (path / str(meta['partition'])).read_bytes()
+        sha = hashlib.sha256(payload).hexdigest()
+        if sha != meta.get('partition_digest'):
+            raise ValueError(
+                f'{path}: partition plan digest mismatch (meta {str(meta.get("partition_digest"))[:12]}… != '
+                f'plan {sha[:12]}…); refusing a tampered partition plan'
+            )
     return binary, meta
+
+
+def load_partition_plan(path, meta: dict | None = None):
+    """The artifact's :class:`~..ir.partition.PartitionPlan`, or None.
+
+    Assumes ``load_artifact`` already verified ``partition_digest``; parses
+    and shape-checks the plan document (``ValueError`` on a malformed or
+    newer-versioned plan).
+    """
+    path = Path(path)
+    if meta is None:
+        meta = json.loads((path / 'meta.json').read_text())
+    if not meta.get('partition'):
+        return None
+    from ..ir.partition import plan_from_dict
+
+    doc = json.loads((path / str(meta['partition'])).read_text())
+    return plan_from_dict(doc)
 
 
 __all__ = [
@@ -152,5 +203,6 @@ __all__ = [
     'export_model',
     'is_artifact',
     'load_artifact',
+    'load_partition_plan',
     'program_digest',
 ]

@@ -85,6 +85,10 @@ METRICS: dict[str, str] = {
     'run.pallas.fallbacks': "mode='pallas' requests degraded to 'level' (pallas missing, unlowered family, or build failure)",
     'run.device_s': 'device wall clock per DAIS inference batch',
     'run.hbm_bytes': 'estimated device-resident bytes per DAIS inference batch',
+    'run.shard.partitions': 'model-axis shards adopted per partitioned executor',
+    'run.shard.exchange_bytes': 'bytes all-gathered per segment boundary of a model-sharded program',
+    'run.shard.imbalance': 'max/mean per-shard op count of the adopted partition plan',
+    'run.shard.fallbacks': 'model-shard requests degraded to single-device (mesh unavailable or build failure)',
     'runtime.samples': 'samples served by the legacy runtime entry point',
     'runtime.run_s': 'wall clock per legacy runtime batch',
     'emit.async_batches': 'asynchronously emitted device batches',

@@ -1,0 +1,324 @@
+"""Model-axis partitioner + sharded runtime (8-device virtual CPU mesh).
+
+Covers the GSPMD model-parallel plane end to end: the level-segment
+partitioner (`ir.partition`), the shard_map execution path inside
+`DaisExecutor`, the export-time plan stamped into serving artifacts, and
+the mesh/shape helpers in `parallel`. Every parity assertion is bit-exact:
+the sharded program must be indistinguishable from single-device execution.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from da4ml_tpu.ir import synth
+from da4ml_tpu.ir.dais_binary import encode
+from da4ml_tpu.ir.partition import (
+    build_shards,
+    partition_program,
+    plan_from_dict,
+    plan_to_dict,
+    validate_plan,
+)
+from da4ml_tpu.runtime import jax_backend as jb
+from da4ml_tpu.runtime import numpy_backend as nb
+from da4ml_tpu.runtime.jax_backend import DaisExecutor
+
+# (seed, kwargs) — uneven levels, wide-i64, shallow/wide: the shapes that
+# stress segment choice, the int64 carry path, and per-level balance
+CORPUS = [
+    (11, dict(n_ops=200, n_in=8, n_out=6)),
+    (12, dict(n_ops=260, n_in=12, n_out=9, wide=True, n_levels=10)),
+    (13, dict(n_ops=220, n_in=6, n_out=5, n_levels=25)),
+    (14, dict(n_ops=180, n_in=10, n_out=4, n_levels=4)),
+]
+
+
+def _prog(seed: int, kwargs: dict):
+    return synth.random_program(np.random.default_rng(seed), **kwargs)
+
+
+@pytest.fixture
+def shard_env(monkeypatch, tmp_path):
+    """Isolated shard/mode decision caches; mode autotune off."""
+    import jax
+
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update('jax_compilation_cache_dir', str(tmp_path))
+    monkeypatch.setenv('DA4ML_RUN_AUTOTUNE', '0')
+    saved_s, saved_m = dict(jb._SHARD_DECISIONS), dict(jb._MODE_DECISIONS)
+    jb._SHARD_DECISIONS.clear()
+    yield tmp_path
+    jb._SHARD_DECISIONS.clear()
+    jb._SHARD_DECISIONS.update(saved_s)
+    jb._MODE_DECISIONS.clear()
+    jb._MODE_DECISIONS.update(saved_m)
+    jax.config.update('jax_compilation_cache_dir', old)
+
+
+# ---------------------------------------------------------------------------
+# plan construction + serialization
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('k', [1, 2, 4, 8])
+def test_partition_plan_well_formed(k):
+    prog = _prog(*CORPUS[0])
+    plan = partition_program(prog, k)
+    validate_plan(prog, plan)  # digest, ranges, closure — must not raise
+    build = build_shards(prog, plan)
+    assert build.plan is plan
+    assert int(build.shard_ops.sum()) == prog.n_ops
+    if k > 1:
+        assert build.imbalance >= 1.0
+        # every boundary's exchange is k * padded-slab rows
+        for g in range(plan.n_segments - 1):
+            assert build.exchange_rows(g) == k * build.export_pad[g]
+
+
+def test_plan_roundtrip_and_validation():
+    prog = _prog(*CORPUS[1])
+    plan = partition_program(prog, 4)
+    doc = json.loads(json.dumps(plan_to_dict(plan)))
+    plan2 = plan_from_dict(doc)
+    assert plan2.k == plan.k and plan2.program_digest == plan.program_digest
+    assert np.array_equal(plan2.assign, plan.assign)
+    assert np.array_equal(plan2.seg_levels, plan.seg_levels)
+    validate_plan(prog, plan2)
+    # a plan built for a different program is refused fail-closed
+    other = _prog(*CORPUS[2])
+    with pytest.raises(ValueError, match='digest|ops'):
+        validate_plan(other, plan2)
+    # as is a tampered assignment
+    bad = plan2._replace(assign=np.asarray([plan2.k] + list(plan2.assign[1:])))
+    with pytest.raises(ValueError):
+        validate_plan(prog, bad)
+
+
+# ---------------------------------------------------------------------------
+# sharded execution parity (forced k-way over the synth corpus)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize('case,k', [(0, 2), (1, 4), (2, 8), (3, 4)])
+def test_model_shard_parity_fuzz(shard_env, monkeypatch, case, k):
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', str(k))
+    seed, kwargs = CORPUS[case]
+    prog = _prog(seed, kwargs)
+    data = synth.random_inputs(np.random.default_rng(seed + 100), prog, 64)
+    ref = np.asarray(nb.run_program(prog, data))
+
+    ex = DaisExecutor(prog)
+    assert ex.model_shards == k, 'forced policy must adopt the k-way cut'
+    np.testing.assert_array_equal(np.asarray(ex(data)), ref)
+
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', '0')
+    single = DaisExecutor(prog)
+    assert single.model_shards == 0
+    np.testing.assert_array_equal(np.asarray(single(data)), ref)
+
+
+def test_model_shard_pallas_per_shard(shard_env, monkeypatch):
+    """mode='pallas' lowers one mega-kernel per shard cell; parity holds."""
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', '4')
+    monkeypatch.setenv('DA4ML_PALLAS_INTERPRET', '1')
+    prog = _prog(*CORPUS[0])
+    data = synth.random_inputs(np.random.default_rng(7), prog, 32)
+    ex = DaisExecutor(prog, mode='pallas')
+    assert ex.model_shards == 4 and ex.mode == 'pallas'
+    np.testing.assert_array_equal(np.asarray(ex(data)), np.asarray(nb.run_program(prog, data)))
+
+
+def test_model_shard_vmem_exceeding_program(shard_env, monkeypatch):
+    """A program whose pallas footprint busts one chip's VMEM budget still
+    runs in mode='pallas' once 4-way partitioned (each cell fits)."""
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', '4')
+    monkeypatch.setenv('DA4ML_PALLAS_INTERPRET', '1')
+    monkeypatch.setenv('DA4ML_PALLAS_VMEM', str(64 << 10))
+    prog = _prog(*CORPUS[2])
+    data = synth.random_inputs(np.random.default_rng(8), prog, 16)
+    ex = DaisExecutor(prog, mode='pallas')
+    assert ex.model_shards == 4
+    np.testing.assert_array_equal(np.asarray(ex(data)), np.asarray(nb.run_program(prog, data)))
+
+
+def test_ragged_batch_parity(shard_env, monkeypatch):
+    """Small/ragged batches are padded onto the canonical grid, split across
+    the mesh, and trimmed — byte-identical to single-device execution."""
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', '4')
+    prog = _prog(*CORPUS[1])
+    ex = DaisExecutor(prog)
+    assert ex.model_shards == 4
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', '0')
+    single = DaisExecutor(prog)
+    rng = np.random.default_rng(9)
+    for n in (1, 3, 7, 13):
+        data = synth.random_inputs(rng, prog, n)
+        a, b = np.asarray(ex(data)), np.asarray(single(data))
+        assert a.shape[0] == n
+        assert a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# policy + race decision cache
+# ---------------------------------------------------------------------------
+
+
+def test_model_shard_policy_parsing(monkeypatch):
+    cases = {
+        '0': ('off', 0),
+        'off': ('off', 0),
+        '': ('tpu', 0),
+        'default': ('tpu', 0),
+        'auto': ('race', 0),
+        'on': ('force', 0),
+        '1': ('force', 0),
+        '4': ('force', 4),
+        'bogus': ('tpu', 0),
+    }
+    for env, want in cases.items():
+        monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', env)
+        assert jb._model_shard_request() == want, env
+
+
+def test_race_decision_cache_controls_adoption(shard_env, monkeypatch):
+    """The race obeys its cached measurement: 0 = single-device won (never
+    shard), k = sharded won (adopt without re-measuring)."""
+    prog = _prog(*CORPUS[3])
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', '0')
+    digest, platform = DaisExecutor(prog)._digest(), jb._platform()
+
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', 'auto')
+    jb._SHARD_DECISIONS[(digest, platform)] = 0
+    assert DaisExecutor(prog).model_shards == 0, 'measured loser must never be adopted'
+    jb._SHARD_DECISIONS[(digest, platform)] = 4
+    assert DaisExecutor(prog).model_shards == 4, 'measured winner adopts from cache'
+
+
+def test_race_measures_and_persists(shard_env, monkeypatch):
+    """policy 'auto' with a cold cache measures both sides and persists the
+    verdict next to the mode decisions."""
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', 'auto')
+    monkeypatch.setenv('DA4ML_RUN_AUTOTUNE_BATCH', '64')
+    prog = _prog(*CORPUS[0])
+    ex = DaisExecutor(prog)
+    assert len(jb._SHARD_DECISIONS) == 1
+    ((digest, platform), win) = next(iter(jb._SHARD_DECISIONS.items()))
+    assert win in (0, 8)
+    assert ex.model_shards == win
+    blob = json.loads((shard_env / 'da4ml-run-modes' / f'{digest}.{platform}.shard.json').read_text())
+    assert blob['model_shard'] == win
+    assert blob['sharded_samples_per_s'] > 0 and blob['single_samples_per_s'] > 0
+
+
+# ---------------------------------------------------------------------------
+# mesh + shape helpers
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_mesh_policy(monkeypatch):
+    from da4ml_tpu.parallel import resolve_mesh
+
+    monkeypatch.delenv('DA4ML_JAX_MESH', raising=False)
+    assert resolve_mesh() is None, 'default policy is TPU-only'
+    mesh = resolve_mesh(tpu_only=False)
+    assert mesh is not None and mesh.devices.size == 8 and mesh.axis_names == ('batch',)
+    monkeypatch.setenv('DA4ML_JAX_MESH', '1')
+    assert resolve_mesh() is not None
+    monkeypatch.setenv('DA4ML_JAX_MESH', '0')
+    assert resolve_mesh(tpu_only=False) is None
+
+
+def test_model_mesh_topology(monkeypatch):
+    from da4ml_tpu.parallel import model_mesh
+
+    monkeypatch.delenv('DA4ML_JAX_MESH', raising=False)
+    for k in (2, 4, 8):
+        mesh = model_mesh(k)
+        assert mesh is not None and mesh.axis_names == ('batch', 'model')
+        assert mesh.devices.shape == (8 // k, k)
+    assert model_mesh(1) is None
+    assert model_mesh(3) is None, '8 % 3 != 0: no even split'
+    assert model_mesh(16) is None, 'more shards than devices'
+    monkeypatch.setenv('DA4ML_JAX_MESH', '0')
+    assert model_mesh(4) is None
+
+
+def test_canon_multiple_grid():
+    from da4ml_tpu.parallel.shapes import canon_multiple, pad_rows_multiple
+
+    assert canon_multiple(5, 8) == 8
+    assert canon_multiple(9, 8) == 16
+    assert canon_multiple(16, 8) == 16
+    assert canon_multiple(17, 5) == 20
+    # off-grid multiples fall back to plain round-up
+    assert canon_multiple(10, 7) == 14
+    assert canon_multiple(100, 7) == 105
+    padded, n = pad_rows_multiple(np.ones((5, 3)), 8)
+    assert padded.shape == (8, 3) and n == 5 and padded[5:].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# export artifact + serve hot-load
+# ---------------------------------------------------------------------------
+
+
+def test_export_plan_roundtrip_and_tamper(tmp_path):
+    from da4ml_tpu.serve.export import export_model, load_artifact, load_partition_plan
+
+    prog = _prog(*CORPUS[0])
+    outdir = tmp_path / 'art'
+    meta = export_model(encode(prog), outdir, model_shards=4, stablehlo=False)
+    assert meta['model_shards'] == 4 and meta['partition'] == 'partition.json'
+    plan = load_partition_plan(outdir)
+    assert plan is not None and plan.k == 4
+    validate_plan(prog, plan)
+
+    # artifacts without a plan stay plan-free
+    meta2 = export_model(encode(prog), tmp_path / 'plain', stablehlo=False)
+    assert meta2['partition'] is None and load_partition_plan(tmp_path / 'plain') is None
+
+    # flipping one shard assignment in partition.json must be refused
+    pj = outdir / 'partition.json'
+    doc = json.loads(pj.read_text())
+    doc['assign'][0] = (doc['assign'][0] + 1) % 4
+    pj.write_text(json.dumps(doc, separators=(',', ':')))
+    with pytest.raises(ValueError, match='partition plan digest mismatch'):
+        load_artifact(outdir)
+
+
+def test_serve_hot_loads_model_sharded(shard_env, monkeypatch, tmp_path):
+    """A warm replica adopts the artifact's export-time plan (no race) and a
+    same-artifact reload reuses the warm executor — zero new compiles."""
+    from da4ml_tpu.serve.engine import ServeConfig, ServeEngine
+    from da4ml_tpu.serve.export import export_model
+
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', 'auto')
+    prog = _prog(*CORPUS[0])
+    outdir = tmp_path / 'art'
+    export_model(encode(prog), outdir, model_shards=4, stablehlo=False)
+
+    eng = ServeEngine(ServeConfig(prewarm=False))
+    eng.load_model('m', str(outdir))
+    ex = eng._executor_for(eng._state('m'))
+    assert ex.model_shards == 4, 'artifact plan is authoritative — no re-race'
+    assert not jb._SHARD_DECISIONS, 'plan adoption must not run the race'
+
+    data = synth.random_inputs(np.random.default_rng(3), prog, 24)
+    np.testing.assert_array_equal(np.asarray(ex(data)), np.asarray(nb.run_program(prog, data)))
+
+    eng.reload('m', str(outdir))
+    assert eng._executor_for(eng._state('m')) is ex, 'same artifact: warm executor reused'
+
+
+def test_single_device_host_ignores_plan(shard_env, monkeypatch):
+    """A host whose topology cannot host the plan's mesh serves the same
+    artifact single-device (the plan is advisory off-mesh)."""
+    prog = _prog(*CORPUS[3])
+    plan = partition_program(prog, 3)  # 8 % 3 != 0: unhostable here
+    monkeypatch.setenv('DA4ML_RUN_MODEL_SHARD', 'auto')
+    ex = DaisExecutor(prog, partition_plan=plan)
+    assert ex.model_shards == 0
+    data = synth.random_inputs(np.random.default_rng(4), prog, 8)
+    np.testing.assert_array_equal(np.asarray(ex(data)), np.asarray(nb.run_program(prog, data)))
